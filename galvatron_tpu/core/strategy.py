@@ -1,0 +1,255 @@
+"""Per-layer hybrid-parallelism strategy representation and codecs.
+
+The reference encodes a model-wide hybrid strategy as per-layer integer vectors
+{pp_deg, tp_sizes_enc, tp_consecutive_flags, dp_types_enc, checkpoint_flags_enc}
+(reference: galvatron/core/hybrid_parallel_config.py:13-87) plus a compact
+string form ``pp-tp-dp[f][*][-c]`` (galvatron/utils/strategy_utils.py:3-48) and
+a JSON interchange file ``galvatron_config_*.json`` with comma-joined strings
+(galvatron/core/search_engine.py:326-367).
+
+Here a strategy is a small frozen dataclass per transformer layer, a model-wide
+``HybridParallelConfig``, and loss-free codecs to/from the reference-compatible
+JSON schema so searched configs round-trip between the search engine and the
+runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+DP_TYPES = ("ddp", "zero2", "zero3")
+# Integer encoding used in config JSON, matching the reference's dp_types_enc
+# (0 = default dp type, 1 = fsdp/zero3; we extend with explicit names).
+_DP_TYPE_TO_INT = {"ddp": 0, "zero2": 0, "zero3": 1}
+
+
+def _is_pow2(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+@dataclass(frozen=True)
+class LayerStrategy:
+    """Hybrid-parallelism strategy for one transformer layer.
+
+    Attributes:
+      tp: tensor-parallel degree (power of two).
+      tp_consec: if True, TP occupies the minor (adjacent-device) mesh axes —
+        the reference's "consecutive" rank layout; if False the major axes
+        (strided layout). (reference: galvatron/core/comm_groups.py:58-89)
+      dp_type: 'ddp' (replicated params), 'zero2' (sharded optimizer state),
+        'zero3' (fully sharded params — FSDP FULL_SHARD equivalent).
+        (reference: galvatron/core/parallel.py:30-32)
+      ckpt: activation rematerialization for this layer
+        (reference: checkpoint_wrapper wrapping, galvatron/core/parallel.py:109-132)
+      sp: Megatron-style sequence parallelism — activations sequence-sharded
+        over the TP axes between blocks (reference: site_package/megatron/core/
+        tensor_parallel/mappings_group.py:192-293).
+      cp: context-parallel (ring attention) degree over the minor data axes;
+        1 disables. A TPU-native capability the reference lacks (SURVEY §5).
+    """
+
+    tp: int = 1
+    tp_consec: bool = True
+    dp_type: str = "ddp"
+    ckpt: bool = False
+    sp: bool = False
+    cp: int = 1
+
+    def __post_init__(self):
+        if not _is_pow2(self.tp):
+            raise ValueError(f"tp degree must be a power of two, got {self.tp}")
+        if not _is_pow2(self.cp):
+            raise ValueError(f"cp degree must be a power of two, got {self.cp}")
+        if self.dp_type not in DP_TYPES:
+            raise ValueError(f"dp_type must be one of {DP_TYPES}, got {self.dp_type}")
+
+    def with_(self, **kw) -> "LayerStrategy":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass
+class HybridParallelConfig:
+    """Model-wide hybrid strategy: one LayerStrategy per transformer layer plus
+    global choices (reference: galvatron/core/hybrid_parallel_config.py:13-87).
+    """
+
+    pp: int = 1
+    layer_strategies: List[LayerStrategy] = field(default_factory=list)
+    # layers per pipeline stage; len == pp, sum == len(layer_strategies)
+    pp_division: Optional[List[int]] = None
+    chunks: int = 1  # micro-batch count for pipeline / grad accumulation
+    pipeline_type: str = "gpipe"  # 'gpipe' | 'pipedream_flush'
+    vocab_tp: int = 1  # TP degree for embedding & LM head (vocab-parallel)
+    vocab_sp: bool = False
+    embed_dp_type: str = "ddp"  # 'embed_sdp' analogue: zero3 to shard embeddings
+    mixed_precision: str = "bf16"  # 'fp32' | 'bf16' (bf16 compute, fp32 master)
+    default_dp_type: str = "ddp"
+
+    def __post_init__(self):
+        if self.pipeline_type not in ("gpipe", "pipedream_flush"):
+            raise ValueError(f"unknown pipeline_type {self.pipeline_type}")
+        if self.pp_division is None and self.layer_strategies:
+            self.pp_division = balanced_division(len(self.layer_strategies), self.pp)
+
+    @property
+    def num_layers(self) -> int:
+        return len(self.layer_strategies)
+
+    def max_tp(self) -> int:
+        degs = [s.tp * s.cp for s in self.layer_strategies] + [self.vocab_tp]
+        return max(degs) if degs else 1
+
+    def validate(self, world_size: int) -> None:
+        """Strategy validity checks (reference: check_hp_config,
+        galvatron/core/hybrid_parallel_config.py:109-128)."""
+        if not _is_pow2(world_size):
+            raise ValueError(f"world size must be a power of two, got {world_size}")
+        if world_size % self.pp != 0:
+            raise ValueError(f"pp={self.pp} must divide world size {world_size}")
+        per_stage = world_size // self.pp
+        for i, s in enumerate(self.layer_strategies):
+            if s.tp * s.cp > per_stage:
+                raise ValueError(
+                    f"layer {i}: tp*cp={s.tp * s.cp} exceeds per-stage devices {per_stage}"
+                )
+        if self.vocab_tp > per_stage:
+            raise ValueError(f"vocab_tp={self.vocab_tp} exceeds per-stage devices")
+        if self.pp_division is not None:
+            if len(self.pp_division) != self.pp:
+                raise ValueError("pp_division length must equal pp")
+            if sum(self.pp_division) != self.num_layers:
+                raise ValueError("pp_division must sum to the layer count")
+        if self.pp > 1 and self.chunks < 1:
+            raise ValueError("chunks must be >= 1")
+
+    # --- JSON codec (reference schema: comma-joined per-layer strings;
+    # galvatron/utils/config_utils.py:34-50, search_engine.py:326-367) ---
+
+    def to_json_dict(self) -> Dict[str, Any]:
+        ls = self.layer_strategies
+        return {
+            "pp_deg": self.pp,
+            "tp_sizes_enc": ",".join(str(s.tp) for s in ls),
+            "tp_consecutive_flags": ",".join(str(int(s.tp_consec)) for s in ls),
+            "dp_types_enc": ",".join(str(_DP_TYPE_TO_INT[s.dp_type]) for s in ls),
+            # authoritative per-layer dp types (dp_types_enc's 0/1 is kept for
+            # reference-schema compatibility but cannot distinguish ddp/zero2)
+            "dp_type_names": ",".join(s.dp_type for s in ls),
+            "checkpoint": ",".join(str(int(s.ckpt)) for s in ls),
+            "sp_flags": ",".join(str(int(s.sp)) for s in ls),
+            "cp_sizes_enc": ",".join(str(s.cp) for s in ls),
+            "pp_division": ",".join(str(n) for n in (self.pp_division or [])),
+            "chunks": self.chunks,
+            "pipeline_type": self.pipeline_type,
+            "vocab_tp": self.vocab_tp,
+            "vocab_sp": int(self.vocab_sp),
+            "embed_dp_type": self.embed_dp_type,
+            "default_dp_type": self.default_dp_type,
+            "mixed_precision": self.mixed_precision,
+        }
+
+    @classmethod
+    def from_json_dict(cls, d: Dict[str, Any]) -> "HybridParallelConfig":
+        def ints(key, default=None):
+            v = d.get(key, default)
+            if v is None or v == "":
+                return None
+            if isinstance(v, str):
+                return [int(x) for x in v.split(",")]
+            return [int(x) for x in v]
+
+        tps = ints("tp_sizes_enc") or []
+        n = len(tps)
+        consec = ints("tp_consecutive_flags") or [1] * n
+        default_dp = d.get("default_dp_type", "ddp")
+        dp_enc = ints("dp_types_enc") or [0] * n
+        dp_names = d.get("dp_type_names")
+        dp_names = dp_names.split(",") if dp_names else None
+        ckpt = ints("checkpoint") or [0] * n
+        sp = ints("sp_flags") or [0] * n
+        cp = ints("cp_sizes_enc") or [1] * n
+        strategies = [
+            LayerStrategy(
+                tp=tps[i],
+                tp_consec=bool(consec[i]),
+                dp_type=dp_names[i] if dp_names else ("zero3" if dp_enc[i] == 1 else default_dp),
+                ckpt=bool(ckpt[i]),
+                sp=bool(sp[i]),
+                cp=cp[i],
+            )
+            for i in range(n)
+        ]
+        return cls(
+            pp=int(d.get("pp_deg", 1)),
+            layer_strategies=strategies,
+            pp_division=ints("pp_division"),
+            chunks=int(d.get("chunks", 1)),
+            pipeline_type=d.get("pipeline_type", "gpipe"),
+            vocab_tp=int(d.get("vocab_tp", 1)),
+            vocab_sp=bool(int(d.get("vocab_sp", 0))),
+            embed_dp_type=d.get("embed_dp_type", "ddp"),
+            default_dp_type=default_dp,
+            mixed_precision=d.get("mixed_precision", "bf16"),
+        )
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_json_dict(), f, indent=2)
+
+    @classmethod
+    def load(cls, path: str) -> "HybridParallelConfig":
+        with open(path) as f:
+            return cls.from_json_dict(json.load(f))
+
+    @classmethod
+    def uniform(
+        cls,
+        num_layers: int,
+        pp: int = 1,
+        tp: int = 1,
+        dp_type: str = "ddp",
+        ckpt: bool = False,
+        sp: bool = False,
+        cp: int = 1,
+        tp_consec: bool = True,
+        **kw,
+    ) -> "HybridParallelConfig":
+        s = LayerStrategy(tp=tp, tp_consec=tp_consec, dp_type=dp_type, ckpt=ckpt, sp=sp, cp=cp)
+        return cls(pp=pp, layer_strategies=[s] * num_layers, vocab_tp=kw.pop("vocab_tp", tp), **kw)
+
+
+def balanced_division(num_layers: int, pp: int) -> List[int]:
+    """Even layer split across stages, remainder to the middle stages — the
+    uniform fallback of the reference's memory-balanced division
+    (galvatron/core/search_engine.py:586-654; the memory-aware version lives in
+    galvatron_tpu.search.search_engine)."""
+    base, rem = divmod(num_layers, pp)
+    division = [base] * pp
+    # give the extra layers to the later-middle stages (first/last stages carry
+    # embedding / head memory; reference biases the same way)
+    order = sorted(range(pp), key=lambda s: (abs(s - (pp - 1) / 2), -s))
+    for i in range(rem):
+        division[order[i]] += 1
+    return division
+
+
+def form_strategy(s: LayerStrategy, pp: int = 1, dp: int = 1) -> str:
+    """Compact human-readable strategy string, reference style ``pp-tp-dp[f][*][-c]``
+    (galvatron/utils/strategy_utils.py:3-48)."""
+    tag = f"{pp}-{s.tp}-{dp}"
+    if s.dp_type == "zero3":
+        tag += "f"
+    elif s.dp_type == "zero2":
+        tag += "z"
+    if not s.tp_consec:
+        tag += "*"
+    if s.sp:
+        tag += "s"
+    if s.cp > 1:
+        tag += f"r{s.cp}"
+    if s.ckpt:
+        tag += "-c"
+    return tag

@@ -1,0 +1,422 @@
+"""Functional decoder-only Transformer covering the reference model zoo.
+
+One configurable implementation replaces the reference's five per-family
+variants (galvatron/models/{gpt_hf,llama_hf,gpt_fa,llama_fa,baichuan}):
+
+- GPT-2 style: learned positions + LayerNorm + GeLU MLP + tied embeddings
+  (reference: models/gpt_hf/GPTModel_sequential.py, GPTModel_tensor_parallel.py)
+- LLaMA style: RoPE + RMSNorm + SwiGLU + GQA
+  (reference: models/llama_hf/LlamaModel_tensor_parallel.py:10-75)
+- Baichuan style: LLaMA-like, ALiBi option for the 13B variant
+  (reference: models/baichuan/BaiChuanModel_sequential.py)
+
+Everything is pure functions over parameter pytrees — no Module wrapping — so
+per-layer hybrid strategies are just per-layer sharding specs applied to the
+same code (SURVEY §7 design stance). Each parameter has a logical-axes
+annotation consumed by galvatron_tpu.parallel.sharding.
+
+Attention dispatch mirrors the reference's core-vs-flash switch
+(galvatron/core/tensor_parallel/transformer.py:805-820): "xla" einsum path,
+"flash" Pallas kernel, "ring" context-parallel ring attention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = None  # None → MHA; < num_heads → GQA
+    ffn_dim: Optional[int] = None  # None → 4h (gelu) or llama 8h/3 rounding
+    max_seq_len: int = 2048
+    pos_embed: str = "rope"  # 'rope' | 'learned' | 'alibi'
+    norm_type: str = "rms"  # 'rms' | 'layernorm'
+    act_fn: str = "swiglu"  # 'swiglu' | 'gelu'
+    tie_word_embeddings: bool = False
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    attn_impl: str = "xla"  # 'xla' | 'flash' | 'ring'
+    dtype: Any = jnp.bfloat16  # compute dtype
+    param_dtype: Any = jnp.float32
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn(self) -> int:
+        if self.ffn_dim is not None:
+            return self.ffn_dim
+        if self.act_fn == "swiglu":
+            # llama convention: 2/3 * 4h rounded up to multiple of 256
+            f = int(2 * 4 * self.hidden_size / 3)
+            return (f + 255) // 256 * 256
+        return 4 * self.hidden_size
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Parameter initialization + logical-axes annotations
+# ---------------------------------------------------------------------------
+
+
+def _dense_init(key, in_dim, out_dim, dtype):
+    scale = 1.0 / np.sqrt(in_dim)
+    return jax.random.uniform(key, (in_dim, out_dim), dtype, -scale, scale)
+
+
+def init_layer_params(key, cfg: ModelConfig) -> Params:
+    h, hd = cfg.hidden_size, cfg.head_dim
+    q_out = cfg.num_heads * hd
+    kv_out = cfg.kv_heads * hd
+    ks = jax.random.split(key, 8)
+    p: Params = {
+        "attn_norm": {"scale": jnp.ones((h,), cfg.param_dtype)},
+        "attn": {
+            "wq": _dense_init(ks[0], h, q_out, cfg.param_dtype),
+            "wk": _dense_init(ks[1], h, kv_out, cfg.param_dtype),
+            "wv": _dense_init(ks[2], h, kv_out, cfg.param_dtype),
+            "wo": _dense_init(ks[3], q_out, h, cfg.param_dtype),
+        },
+        "mlp_norm": {"scale": jnp.ones((h,), cfg.param_dtype)},
+    }
+    if cfg.act_fn == "swiglu":
+        p["mlp"] = {
+            "w1": _dense_init(ks[4], h, cfg.ffn, cfg.param_dtype),
+            "w3": _dense_init(ks[5], h, cfg.ffn, cfg.param_dtype),
+            "w2": _dense_init(ks[6], cfg.ffn, h, cfg.param_dtype),
+        }
+    else:
+        p["mlp"] = {
+            "w1": _dense_init(ks[4], h, cfg.ffn, cfg.param_dtype),
+            "w2": _dense_init(ks[6], cfg.ffn, h, cfg.param_dtype),
+        }
+    if cfg.norm_type == "layernorm":
+        p["attn_norm"]["bias"] = jnp.zeros((h,), cfg.param_dtype)
+        p["mlp_norm"]["bias"] = jnp.zeros((h,), cfg.param_dtype)
+    return p
+
+
+def layer_annotations(cfg: ModelConfig) -> Params:
+    """Logical axes per layer param: 'tp' = Megatron-sharded dim (column-out /
+    row-in), 'fsdp' = the dim ZeRO shards (reference: FSDP flat-param sharding,
+    galvatron/core/parallel.py:174-207)."""
+    a: Params = {
+        "attn_norm": {"scale": ("fsdp",)},
+        "attn": {
+            "wq": ("fsdp", "tp"),
+            "wk": ("fsdp", "tp"),
+            "wv": ("fsdp", "tp"),
+            "wo": ("tp", "fsdp"),
+        },
+        "mlp_norm": {"scale": ("fsdp",)},
+    }
+    if cfg.act_fn == "swiglu":
+        a["mlp"] = {"w1": ("fsdp", "tp"), "w3": ("fsdp", "tp"), "w2": ("tp", "fsdp")}
+    else:
+        a["mlp"] = {"w1": ("fsdp", "tp"), "w2": ("tp", "fsdp")}
+    if cfg.norm_type == "layernorm":
+        a["attn_norm"]["bias"] = ("fsdp",)
+        a["mlp_norm"]["bias"] = ("fsdp",)
+    return a
+
+
+def init_model_params(key, cfg: ModelConfig) -> Params:
+    ks = jax.random.split(key, cfg.num_layers + 3)
+    params: Params = {
+        "embed": {
+            "tok": jax.random.normal(ks[0], (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+            * 0.02
+        },
+        "layers": [init_layer_params(ks[i + 1], cfg) for i in range(cfg.num_layers)],
+        "final_norm": {"scale": jnp.ones((cfg.hidden_size,), cfg.param_dtype)},
+    }
+    if cfg.pos_embed == "learned":
+        params["embed"]["pos"] = (
+            jax.random.normal(ks[-2], (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype) * 0.02
+        )
+    if cfg.norm_type == "layernorm":
+        params["final_norm"]["bias"] = jnp.zeros((cfg.hidden_size,), cfg.param_dtype)
+    if not cfg.tie_word_embeddings:
+        params["head"] = {
+            "w": _dense_init(ks[-1], cfg.hidden_size, cfg.vocab_size, cfg.param_dtype)
+        }
+    return params
+
+
+def model_annotations(cfg: ModelConfig) -> Params:
+    """Embedding is vocab-parallel over its TP axes (reference:
+    VocabParallelEmbedding, site_package/megatron/core/tensor_parallel/
+    layers.py:157; vocab_tp flag galvatron/core/arguments.py:128-130)."""
+    a: Params = {
+        "embed": {"tok": ("tp", "fsdp")},
+        "layers": [layer_annotations(cfg) for _ in range(cfg.num_layers)],
+        "final_norm": {"scale": ("fsdp",)},
+    }
+    if cfg.pos_embed == "learned":
+        a["embed"]["pos"] = ("fsdp", None)
+    if cfg.norm_type == "layernorm":
+        a["final_norm"]["bias"] = ("fsdp",)
+    if not cfg.tie_word_embeddings:
+        a["head"] = {"w": ("fsdp", "tp")}
+    return a
+
+
+# ---------------------------------------------------------------------------
+# Forward pieces
+# ---------------------------------------------------------------------------
+
+
+def norm(x, p, cfg: ModelConfig):
+    dt = x.dtype
+    x32 = x.astype(jnp.float32)
+    if cfg.norm_type == "rms":
+        x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + cfg.norm_eps)
+        out = x32 * p["scale"].astype(jnp.float32)
+    else:
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+        out = (x32 - mu) * jax.lax.rsqrt(var + cfg.norm_eps)
+        out = out * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return out.astype(dt)
+
+
+def rope_tables(cfg: ModelConfig, seq_len: int, offset: int = 0):
+    pos = np.arange(offset, offset + seq_len)
+    inv = 1.0 / (cfg.rope_theta ** (np.arange(0, cfg.head_dim, 2) / cfg.head_dim))
+    freqs = np.outer(pos, inv)  # (S, hd/2)
+    return jnp.asarray(np.cos(freqs), jnp.float32), jnp.asarray(np.sin(freqs), jnp.float32)
+
+
+def apply_rope(x, cos, sin):
+    """x: (B, S, n, hd). Rotate-half convention (reference: rotary_pos_embedding
+    apply_rotary_pos_emb, site_package/megatron/core/models/common/embeddings/
+    rotary_pos_embedding.py:144)."""
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dt)
+
+
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    # standard ALiBi slope schedule (press et al.); baichuan-13B path
+    def pow2slopes(n):
+        start = 2 ** (-(2 ** -(np.log2(n) - 3)))
+        return start * (start ** np.arange(n))
+
+    if np.log2(n_heads).is_integer():
+        return pow2slopes(n_heads)
+    k = 2 ** int(np.floor(np.log2(n_heads)))
+    return np.concatenate([pow2slopes(k), pow2slopes(2 * k)[0::2][: n_heads - k]])
+
+
+def _repeat_kv(x, n_rep: int):
+    if n_rep == 1:
+        return x
+    b, s, kvh, hd = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, kvh, n_rep, hd)).reshape(
+        b, s, kvh * n_rep, hd
+    )
+
+
+def attention_xla(q, k, v, cfg: ModelConfig, bias=None):
+    """Reference einsum attention (the 'CoreAttention' path, reference:
+    galvatron/core/tensor_parallel/transformer.py:298-435)."""
+    b, s, nh, hd = q.shape
+    k = _repeat_kv(k, nh // k.shape[2])
+    v = _repeat_kv(v, nh // v.shape[2])
+    scores = jnp.einsum("bqnh,bknh->bnqk", q, k).astype(jnp.float32) / np.sqrt(hd)
+    if bias is not None:
+        scores = scores + bias
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(causal[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnqk,bknh->bqnh", probs, v)
+
+
+def attention(q, k, v, cfg: ModelConfig, bias=None):
+    if cfg.attn_impl == "flash" and bias is None:
+        from galvatron_tpu.ops.flash_attention import flash_attention
+
+        nh = q.shape[2]
+        k = _repeat_kv(k, nh // k.shape[2])
+        v = _repeat_kv(v, nh // v.shape[2])
+        return flash_attention(q, k, v, causal=True)
+    return attention_xla(q, k, v, cfg, bias=bias)
+
+
+def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None):
+    b, s, h = x.shape
+    hd = cfg.head_dim
+    q = (x @ p["wq"].astype(x.dtype)).reshape(b, s, cfg.num_heads, hd)
+    k = (x @ p["wk"].astype(x.dtype)).reshape(b, s, cfg.kv_heads, hd)
+    v = (x @ p["wv"].astype(x.dtype)).reshape(b, s, cfg.kv_heads, hd)
+    if cfg.pos_embed == "rope":
+        cos, sin = cos_sin
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+    bias = None
+    if cfg.pos_embed == "alibi":
+        pos = jnp.arange(s)
+        rel = pos[None, :] - pos[:, None]  # (q, k) negative below diag
+        bias = (alibi[:, None, None] * rel[None]).astype(jnp.float32)[None]  # (1,n,q,k)
+    o = attention(q, k, v, cfg, bias=bias)
+    return o.reshape(b, s, cfg.num_heads * hd) @ p["wo"].astype(x.dtype)
+
+
+def mlp_block(x, p, cfg: ModelConfig):
+    """SwiGLU or GeLU MLP (reference: ParallelMLP, galvatron/core/
+    tensor_parallel/transformer.py:78-159)."""
+    if cfg.act_fn == "swiglu":
+        return (
+            jax.nn.silu(x @ p["w1"].astype(x.dtype)) * (x @ p["w3"].astype(x.dtype))
+        ) @ p["w2"].astype(x.dtype)
+    return jax.nn.gelu(x @ p["w1"].astype(x.dtype), approximate=True) @ p["w2"].astype(x.dtype)
+
+
+def decoder_layer(x, p, cfg: ModelConfig, cos_sin=None, alibi=None):
+    x = x + attn_block(norm(x, p["attn_norm"], cfg), p["attn"], cfg, cos_sin, alibi)
+    x = x + mlp_block(norm(x, p["mlp_norm"], cfg), p["mlp"], cfg)
+    return x
+
+
+def embed(tokens, params, cfg: ModelConfig):
+    x = params["embed"]["tok"].astype(cfg.dtype)[tokens]
+    if cfg.pos_embed == "learned":
+        s = tokens.shape[1]
+        x = x + params["embed"]["pos"].astype(cfg.dtype)[:s][None]
+    return x
+
+
+def lm_head(x, params, cfg: ModelConfig):
+    if cfg.tie_word_embeddings:
+        w = params["embed"]["tok"].astype(x.dtype).T
+    else:
+        w = params["head"]["w"].astype(x.dtype)
+    return x @ w
+
+
+def forward(params, tokens, cfg: ModelConfig, layer_hook=None):
+    """Full forward → logits. ``layer_hook(i, x)`` lets the hybrid-parallel
+    runtime insert per-layer sharding constraints and remat (the
+    Module_with_relocation + checkpoint_wrapper equivalent, reference:
+    galvatron/core/parallel.py:109-172)."""
+    cos_sin = rope_tables(cfg, tokens.shape[1]) if cfg.pos_embed == "rope" else None
+    alibi = jnp.asarray(alibi_slopes(cfg.num_heads)) if cfg.pos_embed == "alibi" else None
+    x = embed(tokens, params, cfg)
+    for i, lp in enumerate(params["layers"]):
+        if layer_hook is not None:
+            x = layer_hook(i, x, lp)
+        else:
+            x = decoder_layer(x, lp, cfg, cos_sin, alibi)
+    x = norm(x, params["final_norm"], cfg)
+    return lm_head(x, params, cfg)
+
+
+def cross_entropy_sum(logits, labels, ignore_index: int = -100):
+    """(nll_sum, valid_token_count) in fp32 — the accumulation-safe form:
+    micro-batch sums combine exactly into the global token-mean even when
+    ignore_index masks are unevenly distributed across chunks."""
+    logits = logits.astype(jnp.float32)
+    mask = labels != ignore_index
+    safe = jnp.where(mask, labels, 0)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    nll = (lse - picked) * mask
+    return nll.sum(), mask.sum()
+
+
+def cross_entropy_loss(logits, labels, ignore_index: int = -100):
+    """Token-mean cross entropy in fp32. Written shard-friendly: when logits
+    are vocab-sharded (vocab_tp), XLA keeps the log-sum-exp partial per shard
+    and psums scalars — the vocab-parallel cross entropy of the reference
+    (site_package/megatron/core/tensor_parallel/cross_entropy.py:18-155)
+    without the hand-written autograd Function."""
+    s, n = cross_entropy_sum(logits, labels, ignore_index)
+    return s / jnp.maximum(n, 1)
+
+
+def lm_loss_sum(params, batch, cfg: ModelConfig, layer_hook=None):
+    """(nll_sum, token_count) next-token loss pieces on a (B, S+1) token batch
+    (reference synthetic-data convention: models/llama_hf/dataloader.py:5-30)."""
+    tokens = batch[:, :-1]
+    labels = batch[:, 1:]
+    logits = forward(params, tokens, cfg, layer_hook=layer_hook)
+    return cross_entropy_sum(logits, labels)
+
+
+def lm_loss(params, batch, cfg: ModelConfig, layer_hook=None):
+    s, n = lm_loss_sum(params, batch, cfg, layer_hook=layer_hook)
+    return s / jnp.maximum(n, 1)
+
+
+# Preset configs mirroring the reference model zoo sizes
+# (galvatron/models/llama_hf/arguments.py:6, gpt_hf/arguments.py:6)
+PRESETS: Dict[str, ModelConfig] = {
+    "llama-0.3b": ModelConfig(
+        vocab_size=32000, hidden_size=1024, num_layers=24, num_heads=16, max_seq_len=2048
+    ),
+    "llama-7b": ModelConfig(
+        vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
+        ffn_dim=11008, max_seq_len=2048,
+    ),
+    "llama-13b": ModelConfig(
+        vocab_size=32000, hidden_size=5120, num_layers=40, num_heads=40,
+        ffn_dim=13824, max_seq_len=2048,
+    ),
+    "llama-30b": ModelConfig(
+        vocab_size=32000, hidden_size=6656, num_layers=60, num_heads=52,
+        ffn_dim=17920, max_seq_len=2048,
+    ),
+    "gpt-0.3b": ModelConfig(
+        vocab_size=50257, hidden_size=1024, num_layers=24, num_heads=16,
+        max_seq_len=1024, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
+        tie_word_embeddings=True,
+    ),
+    "gpt-1.5b": ModelConfig(
+        vocab_size=50257, hidden_size=1600, num_layers=48, num_heads=25,
+        max_seq_len=1024, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
+        tie_word_embeddings=True,
+    ),
+    "gpt-2.7b": ModelConfig(
+        vocab_size=50257, hidden_size=2560, num_layers=32, num_heads=32,
+        max_seq_len=2048, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
+        tie_word_embeddings=True,
+    ),
+    "gpt-6.7b": ModelConfig(
+        vocab_size=50257, hidden_size=4096, num_layers=32, num_heads=32,
+        max_seq_len=2048, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
+        tie_word_embeddings=True,
+    ),
+    "baichuan-7b": ModelConfig(
+        vocab_size=64000, hidden_size=4096, num_layers=32, num_heads=32,
+        ffn_dim=11008, max_seq_len=4096,
+    ),
+    "baichuan-13b": ModelConfig(
+        vocab_size=64000, hidden_size=5120, num_layers=40, num_heads=40,
+        ffn_dim=13696, max_seq_len=4096, pos_embed="alibi",
+    ),
+}

@@ -1,0 +1,130 @@
+"""Device-mesh construction and per-layer axis assignment.
+
+The reference materializes one NCCL process group per (tp_size, consecutive)
+combination plus dual DP groups and redistribution groups between layers
+(galvatron/core/comm_groups.py:58-254). On TPU we instead build ONE
+``jax.sharding.Mesh`` whose non-pipeline extent is factored into **binary
+axes**: world W, pipeline degree P gives mesh shape ``(P, 2, 2, ..., 2)`` with
+axis names ``("pp", "x0", "x1", ..., "x{m-1}")`` where ``m = log2(W / P)``.
+
+A layer strategy then maps to a *subset* of the binary axes:
+
+- TP degree ``2^k`` with ``tp_consec=True`` takes the **minor** k axes
+  (``x{m-k}..x{m-1}``) — adjacent device ids, the reference's "consecutive"
+  rank layout which lands on the fastest ICI links; ``tp_consec=False`` takes
+  the **major** k axes — strided ranks (reference: gen_tp_group_dist,
+  galvatron/core/comm_groups.py:58-89).
+- The complementary axes are the DP axes (dual construction, reference:
+  gen_dp_group_dist, comm_groups.py:91-122).
+- Context parallelism (ring attention) takes the minor axes of the DP block.
+
+Because ``PartitionSpec`` entries accept *tuples* of axis names, a per-layer
+choice of TP/DP axes is just a per-layer ``NamedSharding`` — XLA inserts the
+activation resharding collectives between layers with different TP that the
+reference hand-codes in galvatron/core/redistribute.py.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+
+
+def _log2(n: int) -> int:
+    k = int(round(math.log2(n)))
+    if 2**k != n:
+        raise ValueError(f"{n} is not a power of two")
+    return k
+
+
+@dataclass(frozen=True)
+class MeshAxes:
+    """Axis-name bookkeeping for the factored mesh."""
+
+    pp: str
+    data_axes: Tuple[str, ...]  # binary axes, major → minor
+
+    @property
+    def all_axes(self) -> Tuple[str, ...]:
+        return (self.pp,) + self.data_axes
+
+    def tp_axes(self, tp: int, consec: bool = True) -> Tuple[str, ...]:
+        """Axes carrying tensor parallelism for a layer with degree ``tp``."""
+        k = _log2(tp)
+        if k > len(self.data_axes):
+            raise ValueError(f"tp={tp} exceeds mesh data extent 2^{len(self.data_axes)}")
+        if k == 0:
+            return ()
+        return self.data_axes[-k:] if consec else self.data_axes[:k]
+
+    def dp_axes(self, tp: int, consec: bool = True, cp: int = 1) -> Tuple[str, ...]:
+        """Axes carrying (sharded-)data parallelism: the complement of TP∪CP."""
+        used = set(self.tp_axes(tp, consec)) | set(self.cp_axes(tp, consec, cp))
+        return tuple(a for a in self.data_axes if a not in used)
+
+    def cp_axes(self, tp: int, consec: bool = True, cp: int = 1) -> Tuple[str, ...]:
+        """Context-parallel (ring attention) axes: minor axes of the non-TP block."""
+        if cp == 1:
+            return ()
+        k = _log2(cp)
+        rest = [a for a in self.data_axes if a not in set(self.tp_axes(tp, consec))]
+        if k > len(rest):
+            raise ValueError(f"cp={cp} exceeds remaining mesh extent")
+        return tuple(rest[-k:])
+
+
+def build_mesh(
+    pp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_prefix: str = "x",
+) -> Tuple[Mesh, MeshAxes]:
+    """Build the factored mesh over all (or given) devices.
+
+    Device order follows ``jax.devices()`` — on real TPU slices jax returns
+    devices in torus-major order so minor mesh axes correspond to
+    ICI-adjacent chips, matching the 'consecutive ranks = intra-node NVLink'
+    empirical layout the reference profiles (SURVEY §5, hardware_configs).
+    """
+    if devices is None:
+        devices = jax.devices()
+    world = len(devices)
+    if world % pp != 0:
+        raise ValueError(f"pp={pp} must divide world size {world}")
+    m = _log2(world // pp)
+    shape = (pp,) + (2,) * m
+    dev_array = np.asarray(devices).reshape(shape)
+    names = ("pp",) + tuple(f"{axis_prefix}{i}" for i in range(m))
+    mesh = Mesh(dev_array, names)
+    return mesh, MeshAxes(pp="pp", data_axes=names[1:])
+
+
+def data_parallel_degree(axes: MeshAxes, s: LayerStrategy) -> int:
+    return 2 ** len(axes.dp_axes(s.tp, s.tp_consec, s.cp))
+
+
+def batch_spec(axes: MeshAxes, s: LayerStrategy) -> P:
+    """Sharding for a (batch, seq, ...) activation entering a layer.
+
+    Batch over DP axes always; sequence over TP axes when Megatron-SP is on
+    (reference: mappings_group scatter/gather, SURVEY §2.3 'SP'), and over CP
+    axes when ring attention is on.
+    """
+    dp = axes.dp_axes(s.tp, s.tp_consec, s.cp)
+    seq_axes: Tuple[str, ...] = ()
+    if s.sp:
+        seq_axes += axes.tp_axes(s.tp, s.tp_consec)
+    if s.cp > 1:
+        seq_axes += axes.cp_axes(s.tp, s.tp_consec, s.cp)
+    return P(dp or None, seq_axes or None)
+
+
+def global_batch_spec(axes: MeshAxes) -> P:
+    """Sharding for the raw token batch: all data axes (dataloader layout)."""
+    return P(axes.data_axes or None, None)

@@ -1,0 +1,24 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference has no simulated-cluster story (SURVEY §4 — it always requires
+real GPUs); JAX gives us one: ``--xla_force_host_platform_device_count``.
+jax is already imported at interpreter start by the environment's
+sitecustomize, so the platform is forced programmatically (the backend client
+is created lazily, so this still takes effect)."""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_mesh():
+    assert len(jax.devices()) == 8, "tests expect the 8-device CPU simulation"

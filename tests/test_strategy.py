@@ -1,0 +1,120 @@
+"""Strategy codec + mesh axis-assignment unit tests (build plan step 1-2)."""
+
+import numpy as np
+import pytest
+
+from galvatron_tpu.core.strategy import (
+    HybridParallelConfig,
+    LayerStrategy,
+    balanced_division,
+    form_strategy,
+)
+
+
+def test_layer_strategy_validation():
+    with pytest.raises(ValueError):
+        LayerStrategy(tp=3)
+    with pytest.raises(ValueError):
+        LayerStrategy(dp_type="zero9")
+    s = LayerStrategy(tp=4, dp_type="zero3", ckpt=True)
+    assert s.with_(tp=2).tp == 2
+
+
+def test_json_roundtrip(tmp_path):
+    strategies = [
+        LayerStrategy(tp=1, dp_type="zero3", ckpt=True),
+        LayerStrategy(tp=2, tp_consec=False, dp_type="ddp"),
+        LayerStrategy(tp=4, dp_type="zero2", sp=True),
+        LayerStrategy(tp=2, cp=2),
+    ]
+    hp = HybridParallelConfig(
+        pp=2, layer_strategies=strategies, chunks=4,
+        pipeline_type="pipedream_flush", vocab_tp=2, default_dp_type="zero2",
+    )
+    path = tmp_path / "cfg.json"
+    hp.save(str(path))
+    hp2 = HybridParallelConfig.load(str(path))
+    assert hp2.pp == 2 and hp2.chunks == 4
+    assert hp2.pipeline_type == "pipedream_flush"
+    assert hp2.vocab_tp == 2
+    assert [s.tp for s in hp2.layer_strategies] == [1, 2, 4, 2]
+    assert [s.tp_consec for s in hp2.layer_strategies] == [True, False, True, True]
+    # dp_type_names preserves the exact per-layer dp types
+    assert [s.dp_type for s in hp2.layer_strategies] == ["zero3", "ddp", "zero2", "ddp"]
+    assert [s.ckpt for s in hp2.layer_strategies] == [True, False, False, False]
+    assert [s.sp for s in hp2.layer_strategies] == [False, False, True, False]
+    assert [s.cp for s in hp2.layer_strategies] == [1, 1, 1, 2]
+    assert hp2.pp_division == hp.pp_division
+
+
+def test_json_roundtrip_preserves_zero2_vs_ddp():
+    hp = HybridParallelConfig(
+        pp=1,
+        layer_strategies=[LayerStrategy(dp_type="zero2"), LayerStrategy(dp_type="ddp")],
+    )
+    hp2 = HybridParallelConfig.from_json_dict(hp.to_json_dict())
+    assert [s.dp_type for s in hp2.layer_strategies] == ["zero2", "ddp"]
+
+
+def test_validate_world():
+    hp = HybridParallelConfig.uniform(4, pp=2, tp=4)
+    with pytest.raises(ValueError):
+        hp.validate(4)  # tp=4 > 4/2 devices per stage
+    hp.validate(8)
+
+
+def test_balanced_division():
+    assert sum(balanced_division(10, 4)) == 10
+    assert balanced_division(8, 4) == [2, 2, 2, 2]
+    assert len(balanced_division(7, 2)) == 2
+
+
+def test_form_strategy():
+    assert form_strategy(LayerStrategy(tp=2, dp_type="zero3", ckpt=True), pp=2, dp=2) == "2-2-2f-c"
+    assert form_strategy(LayerStrategy(tp=4, tp_consec=False), pp=1, dp=2) == "1-4-2*"
+
+
+def test_mesh_axis_assignment():
+    import jax
+
+    from galvatron_tpu.parallel.mesh import build_mesh
+
+    mesh, axes = build_mesh(pp=2)
+    assert mesh.devices.shape == (2, 2, 2)
+    assert axes.data_axes == ("x0", "x1")
+    # consecutive TP = minor axes (adjacent devices); strided = major axes
+    assert axes.tp_axes(2, consec=True) == ("x1",)
+    assert axes.tp_axes(2, consec=False) == ("x0",)
+    assert axes.dp_axes(2, consec=True) == ("x0",)
+    assert axes.tp_axes(4, consec=True) == ("x0", "x1")
+    assert axes.dp_axes(4) == ()
+    # cp takes minor axes of the non-tp block
+    assert axes.cp_axes(1, True, 2) == ("x1",)
+    assert axes.cp_axes(2, True, 2) == ("x0",)
+    # device order: minor axis = adjacent ids
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    assert ids[0, 0, 0] + 1 == ids[0, 0, 1]
+
+
+def test_param_spec_rules():
+    from jax.sharding import PartitionSpec as P
+
+    from galvatron_tpu.parallel.mesh import build_mesh
+    from galvatron_tpu.parallel.sharding import param_spec
+
+    mesh, axes = build_mesh(pp=1)  # 8 devices, 3 binary axes
+    s = LayerStrategy(tp=2, dp_type="zero3")
+    # col-parallel weight (in, out): fsdp on in (dp axes), tp on out
+    sp = param_spec((64, 64), ("fsdp", "tp"), axes, s)
+    assert sp == P(("x0", "x1"), ("x2",))
+    # ddp: no fsdp sharding
+    sp = param_spec((64, 64), ("fsdp", "tp"), axes, LayerStrategy(tp=2))
+    assert sp == P(None, ("x2",))
+    # zero2: opt state sharded, params not
+    s2 = LayerStrategy(tp=1, dp_type="zero2")
+    assert param_spec((64, 64), ("fsdp", "tp"), axes, s2) == P(None, None)
+    assert param_spec((64, 64), ("fsdp", "tp"), axes, s2, for_opt_state=True) == P(
+        ("x0", "x1", "x2"), None
+    )
+    # non-divisible dims stay unsharded
+    assert param_spec((3, 64), ("fsdp", None), axes, s) == P(None, None)
