@@ -1,0 +1,347 @@
+"""Pipeline-parallel engine: GPipe and 1F1B schedules over shard_map/ppermute.
+
+TPU-native replacement for the reference's 1340-line NCCL pipeline engine
+(galvatron/core/pipeline/pipeline.py). The mapping:
+
+  reference                              → here
+  PipelineParallel stage slicing (:75)   → stage-stacked params: every layer
+                                           array gets a leading pp dim, spec
+                                           P('pp', ...); inside the manual-pp
+                                           shard_map each stage sees its slice
+  chunk_batch microbatching (utils:9-36) → reshape to (chunks, mb, ...) — the
+                                           ragged last chunk is disallowed
+                                           (XLA static shapes; mirrors the
+                                           search engine's strict-chunk filter,
+                                           reference search_engine.py:196-198)
+  _communicate / batch_isend_irecv p2p   → lax.ppermute along the 'pp' axis
+    (:814-989, sync race guard :966-968)   (deterministic, no race class)
+  gpipe_forward/backward (:497-629)      → clocked scan; jax.grad through the
+                                           scan IS the reverse pipeline
+  pipedream_flush 1F1B (:237-480)        → hand-written fwd+bwd clocked scan
+                                           with O(pp) input stash + recompute
+                                           (FSDP-hook surgery is unnecessary:
+                                           grads are pure values)
+
+Layout constraints under SPMD (documented deviations from the reference):
+- layer count must divide evenly across stages (pp_division uniform);
+- layers at the same position within their stage share one strategy (stacked
+  arrays have a single sharding). Per-position heterogeneity is retained;
+  arbitrary per-layer heterogeneity is available at pp=1.
+- embedding / final norm / LM head compute outside the pipelined section,
+  sharded over the full mesh (pp included) on the batch dim; their params are
+  replicated over pp (vocab-TP/ZeRO sharded per vocab strategy).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from galvatron_tpu.core.optim import AdamConfig, adamw_update, init_opt_state
+from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+from galvatron_tpu.models import modeling
+from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.parallel.mesh import MeshAxes, batch_spec
+from galvatron_tpu.parallel.sharding import constrain, param_spec, sharding_tree
+
+# ---------------------------------------------------------------------------
+# Stage-stacked parameters
+# ---------------------------------------------------------------------------
+
+
+def validate_pipeline_strategies(cfg: ModelConfig, hp: HybridParallelConfig) -> int:
+    """Check SPMD stacking constraints; returns layers-per-stage."""
+    L, pp = cfg.num_layers, hp.pp
+    if L % pp != 0:
+        raise ValueError(
+            f"pp={pp} requires the layer count {L} to divide evenly across stages "
+            "(SPMD stage stacking; use pp=1 for ragged divisions)"
+        )
+    lps = L // pp
+    for j in range(lps):
+        base = hp.layer_strategies[j]
+        for s in range(1, pp):
+            other = hp.layer_strategies[s * lps + j]
+            if other != base:
+                raise ValueError(
+                    f"layers at stage-position {j} must share one strategy across "
+                    f"stages (stage 0 has {base}, stage {s} has {other}); "
+                    "per-position heterogeneity only under pp>1"
+                )
+    return lps
+
+
+def init_pipeline_params(key, cfg: ModelConfig, hp: HybridParallelConfig):
+    """Param tree for pp>1: embed/final_norm/head as usual (replicated over pp);
+    transformer layers as ``stages[j]`` — position-j layer params stacked over
+    stages, leading dim pp."""
+    lps = validate_pipeline_strategies(cfg, hp)
+    ks = jax.random.split(key, 4)
+    base = {
+        "embed": {
+            "tok": jax.random.normal(ks[0], (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+            * 0.02
+        },
+        "final_norm": {"scale": jnp.ones((cfg.hidden_size,), cfg.param_dtype)},
+    }
+    if cfg.pos_embed == "learned":
+        base["embed"]["pos"] = (
+            jax.random.normal(ks[1], (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype) * 0.02
+        )
+    if cfg.norm_type == "layernorm":
+        base["final_norm"]["bias"] = jnp.zeros((cfg.hidden_size,), cfg.param_dtype)
+    if not cfg.tie_word_embeddings:
+        base["head"] = {
+            "w": modeling._dense_init(ks[2], cfg.hidden_size, cfg.vocab_size, cfg.param_dtype)
+        }
+    layer_keys = jax.random.split(ks[3], cfg.num_layers)
+    # stages[j][leaf] has shape (pp, *leaf_shape); stage s slice is layer s*lps+j
+    stages = []
+    for j in range(lps):
+        keys_j = jnp.stack([layer_keys[s * lps + j] for s in range(hp.pp)])
+        stages.append(jax.vmap(lambda k: modeling.init_layer_params(k, cfg))(keys_j))
+    base["stages"] = stages
+    return base
+
+
+def pipeline_param_specs(
+    params_shape, cfg: ModelConfig, hp: HybridParallelConfig, axes: MeshAxes,
+    *, for_opt_state: bool = False,
+):
+    """Specs: stages[j] leaves get P('pp', *strategy_j_spec); embed/head/norm
+    get the vocab strategy without a pp entry (replicated over pp)."""
+    lps = cfg.num_layers // hp.pp
+    annots = modeling.layer_annotations(cfg)
+    embed_strategy = LayerStrategy(
+        tp=hp.vocab_tp, tp_consec=True, dp_type=hp.embed_dp_type, sp=hp.vocab_sp
+    )
+    is_leaf = lambda x: hasattr(x, "shape")
+    specs: Dict[str, Any] = {}
+    model_annots = {
+        "embed": {"tok": ("tp", "fsdp")},
+        "final_norm": {"scale": ("fsdp",)},
+    }
+    if cfg.pos_embed == "learned":
+        model_annots["embed"]["pos"] = ("fsdp", None)
+    if cfg.norm_type == "layernorm":
+        model_annots["final_norm"]["bias"] = ("fsdp",)
+    if not cfg.tie_word_embeddings:
+        model_annots["head"] = {"w": ("fsdp", "tp")}
+    for key in params_shape:
+        if key == "stages":
+            specs["stages"] = []
+            for j in range(lps):
+                s_j = hp.layer_strategies[j]
+                specs["stages"].append(
+                    jax.tree.map(
+                        lambda leaf, a: P(
+                            "pp",
+                            *param_spec(
+                                leaf.shape[1:], a, axes, s_j, for_opt_state=for_opt_state
+                            ),
+                        ),
+                        params_shape["stages"][j],
+                        annots,
+                        is_leaf=is_leaf,
+                    )
+                )
+        else:
+            specs[key] = jax.tree.map(
+                lambda leaf, a: param_spec(
+                    leaf.shape, a, axes, embed_strategy, for_opt_state=for_opt_state
+                ),
+                params_shape[key],
+                model_annots[key],
+                is_leaf=is_leaf,
+            )
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# Stage computation
+# ---------------------------------------------------------------------------
+
+
+def make_stage_fn(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axes: MeshAxes):
+    """One pipeline stage: layers-per-stage decoder layers with per-position
+    sharding constraints + remat (the per-layer wrap steps [3,5,6] of the
+    reference construction, galvatron/core/hybrid_parallel_model.py:81-153)."""
+    lps = cfg.num_layers // hp.pp
+
+    def act_spec(s: LayerStrategy) -> P:
+        bs = batch_spec(axes, s)
+        return P(bs[0], bs[1], None)
+
+    def stage_fn(stage_params: List[Any], x):
+        cos_sin = modeling.rope_tables(cfg, x.shape[1]) if cfg.pos_embed == "rope" else None
+        alibi = (
+            jnp.asarray(modeling.alibi_slopes(cfg.num_heads))
+            if cfg.pos_embed == "alibi"
+            else None
+        )
+        for j in range(lps):
+            s = hp.layer_strategies[j]
+            x = constrain(x, mesh, act_spec(s))
+
+            def run(x_, lp_):
+                if s.cp > 1:
+                    from galvatron_tpu.parallel.ring import ring_decoder_layer
+
+                    return ring_decoder_layer(
+                        x_, lp_, cfg, mesh, axes.cp_axes(s.tp, s.tp_consec, s.cp), cos_sin
+                    )
+                return modeling.decoder_layer(x_, lp_, cfg, cos_sin, alibi)
+
+            if s.ckpt:
+                run = jax.checkpoint(run)
+            x = run(x, stage_params[j])
+        return x
+
+    return stage_fn
+
+
+# ---------------------------------------------------------------------------
+# GPipe schedule (clocked scan; autodiff = reverse pipeline)
+# ---------------------------------------------------------------------------
+
+
+def gpipe_pipeline(stage_fn, pp: int, chunks: int, mesh: Mesh):
+    """Returns f(stage_params_local, x_mbs) -> ys, to run under a manual-'pp'
+    shard_map. Clock tick t: stage s computes micro-batch (t - s); forward
+    sends ride ppermute s→s+1 (reference: gpipe_forward,
+    galvatron/core/pipeline/pipeline.py:497-629)."""
+
+    fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+
+    def run(stage_params, x_mbs):
+        # x_mbs: (chunks, mb, S, H) replicated over pp.
+        # P('pp')-sharded params keep a size-1 leading dim in the local view;
+        # strip it so stage compute sees clean per-layer shapes.
+        stage_params = jax.tree.map(lambda a: jnp.squeeze(a, 0), stage_params)
+        stage = jax.lax.axis_index("pp")
+        mb_shape = x_mbs.shape[1:]
+        state = jnp.zeros(mb_shape, x_mbs.dtype)
+        ys = jnp.zeros((chunks,) + mb_shape, x_mbs.dtype)
+
+        def tick(carry, t):
+            state, ys = carry
+            prev = jax.lax.ppermute(state, "pp", fwd_perm)
+            mb_idx = jnp.clip(t, 0, chunks - 1)
+            first_in = jax.lax.dynamic_index_in_dim(x_mbs, mb_idx, keepdims=False)
+            x_in = jnp.where(stage == 0, first_in, prev)
+            out = stage_fn(stage_params, x_in)
+            slot = jnp.clip(t - (pp - 1), 0, chunks - 1)
+            ys = jax.lax.dynamic_update_index_in_dim(ys, out, slot, 0)
+            return (out, ys), None
+
+        (state, ys), _ = jax.lax.scan(tick, (state, ys), jnp.arange(chunks + pp - 1))
+        # new leading stage axis so out_specs=P('pp') yields (pp, chunks, ...)
+        # globally; only the pp=-1 slice holds real outputs
+        return ys[None]
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# Runtime assembly
+# ---------------------------------------------------------------------------
+
+
+def build_pipeline_runtime(
+    cfg: ModelConfig,
+    hp: HybridParallelConfig,
+    mesh: Mesh,
+    axes: MeshAxes,
+    adam: AdamConfig,
+    global_batch_size: int,
+    seq_len: int,
+):
+    from galvatron_tpu.parallel.hybrid import HybridParallelRuntime
+
+    pp, chunks = hp.pp, max(1, hp.chunks)
+    lps = validate_pipeline_strategies(cfg, hp)
+    if global_batch_size % chunks != 0:
+        raise ValueError(f"global batch {global_batch_size} not divisible by chunks {chunks}")
+    mb = global_batch_size // chunks
+
+    stage_fn = make_stage_fn(cfg, hp, mesh, axes)
+    if hp.pipeline_type == "pipedream_flush":
+        from galvatron_tpu.parallel.pipeline_1f1b import make_1f1b_train_step
+
+        return make_1f1b_train_step(
+            cfg, hp, mesh, axes, adam, global_batch_size, seq_len, stage_fn
+        )
+
+    pipe = gpipe_pipeline(stage_fn, pp, chunks, mesh)
+    # full-batch spec for embedding/head compute: batch over pp + all data axes
+    full_spec = P(("pp",) + axes.data_axes, None, None)
+
+    pipe_sm = jax.shard_map(
+        pipe,
+        mesh=mesh,
+        in_specs=(P("pp"), P()),  # stage params: pp-stacked; x_mbs replicated
+        out_specs=P("pp"),
+        axis_names={"pp"},
+        # vma tracking rejects with_sharding_constraint over auto axes inside
+        # the manual region; disable it (grads still correct — probed)
+        check_vma=False,
+    )
+
+    def loss_fn(params, batch):
+        tokens, labels = batch[:, :-1], batch[:, 1:]
+        x = modeling.embed(tokens, params, cfg)
+        x = constrain(x, mesh, full_spec)
+        x_mbs = x.reshape(chunks, mb, *x.shape[1:])
+        ys = pipe_sm(params["stages"], x_mbs)  # (pp, chunks, mb, S, H)
+        y = ys[-1].reshape(global_batch_size, *x.shape[1:])
+        y = constrain(y, mesh, full_spec)
+        y = modeling.norm(y, params["final_norm"], cfg)
+        logits = modeling.lm_head(y, params, cfg)
+        s, n = modeling.cross_entropy_sum(logits, labels)
+        return s / jnp.maximum(n, 1)
+
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
+        new_params, new_opt = adamw_update(state["params"], grads, state["opt"], adam)
+        return {"params": new_params, "opt": new_opt, "step": state["step"] + 1}, loss
+
+    def init_state(key):
+        params = init_pipeline_params(key, cfg, hp)
+        return {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
+
+    state_shape = jax.eval_shape(init_state, jax.random.key(0))
+    specs = {
+        "params": pipeline_param_specs(state_shape["params"], cfg, hp, axes),
+        "opt": {
+            "mu": pipeline_param_specs(state_shape["params"], cfg, hp, axes, for_opt_state=True),
+            "nu": pipeline_param_specs(state_shape["params"], cfg, hp, axes, for_opt_state=True),
+            "count": P(),
+        },
+        "step": P(),
+    }
+    shardings = sharding_tree(mesh, specs)
+    batch_sharding = NamedSharding(mesh, P(("pp",) + axes.data_axes, None))
+
+    jit_train = jax.jit(
+        train_step,
+        in_shardings=(shardings, batch_sharding),
+        out_shardings=(shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+    jit_eval = jax.jit(
+        lambda state, batch: loss_fn(state["params"], batch),
+        in_shardings=(shardings, batch_sharding),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+    jit_init = jax.jit(init_state, out_shardings=shardings)
+
+    return HybridParallelRuntime(
+        cfg=cfg, hp=hp, mesh=mesh, axes=axes, adam=adam,
+        train_step=jit_train, eval_loss=jit_eval, init_state=jit_init,
+        state_shardings=shardings,
+    )

@@ -96,5 +96,12 @@ def constrain(x, mesh: Mesh, spec: P):
     """``with_sharding_constraint`` under an explicit mesh — the activation-
     resharding boundary (replaces reference redistribute.py split/gather
     autograd functions; XLA emits the fused collective the reference's
-    `_Fused_split_allgather` hand-writes)."""
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    `_Fused_split_allgather` hand-writes).
+
+    Inside a (partial-)manual shard_map region the constraint must be built
+    on the tracing context's AbstractMesh (whose manual axes are typed
+    Manual); the concrete mesh's sharding would be rejected in the
+    transpose/grad path."""
+    am = jax.sharding.get_abstract_mesh()
+    target = am if (am is not None and not am.empty) else mesh
+    return jax.lax.with_sharding_constraint(x, NamedSharding(target, spec))
