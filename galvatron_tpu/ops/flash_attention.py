@@ -1,0 +1,342 @@
+"""Pallas TPU flash attention (forward + backward kernels, custom VJP).
+
+Replaces the reference's FlashAttention-2 CUDA dependency
+(flash_attn_unpadded_func import, reference: galvatron/core/tensor_parallel/
+transformer.py:33-39,437-496) with a from-scratch FlashAttention-2-style
+online-softmax kernel for the MXU:
+
+- forward: grid (batch, heads, q_blocks, k_blocks), k innermost; running
+  (m, l, acc) in VMEM scratch; causal blocks above the diagonal skipped with
+  ``pl.when``; emits the per-row log-sum-exp for the backward.
+- backward: two kernels — dK/dV (grid over k blocks, q innermost) and dQ
+  (grid over q blocks, k innermost) — recomputing probabilities from the
+  saved LSE, never materializing the (S, S) score matrix.
+
+Falls back to the einsum path automatically on CPU (interpret mode is used in
+tests) and for shapes that don't tile (seq % block != 0).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, sm_scale, causal, block_q, block_k, num_k_blocks):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: k block j contributes to q block i iff some (row, col) with
+    # row >= col overlaps, i.e. (i+1)*block_q - 1 >= j*block_k (block sizes
+    # may differ)
+    if causal:
+        last_j = jnp.minimum(((i + 1) * block_q - 1) // block_k, num_k_blocks - 1)
+        contributes = ((i + 1) * block_q - 1) >= j * block_k
+    else:
+        last_j = num_k_blocks - 1
+        contributes = jnp.bool_(True)
+
+    @pl.when(contributes)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # (block_q, block_k)
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_old = m_scr[:, :1]  # (block_q, 1), lanes replicated
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_old - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = alpha * acc_scr[:] + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == last_j)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:, :1] + jnp.log(jnp.maximum(l, 1e-30))).astype(
+            jnp.float32
+        )
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    b, h, s, d = q.shape
+    nq, nk = s // block_q, s // block_k
+    grid = (b, h, nq, nk)
+    kernel = functools.partial(
+        _fwd_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        num_k_blocks=nk,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            # trailing unit dim keeps the block 2D-tileable on real TPUs
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal, block_q, block_k, num_q_blocks):
+    j = pl.program_id(2)  # k block
+    i = pl.program_id(3)  # q block (innermost)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    contributes = (
+        ((i + 1) * block_q - 1) >= j * block_k if causal else jnp.bool_(True)
+    )
+
+    @pl.when(contributes)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0].astype(jnp.float32)  # (block_q, 1)
+        delta = delta_ref[0, 0].astype(jnp.float32)  # (block_q, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)  # softmax probs
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(i == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *, sm_scale, causal, block_q, block_k, num_k_blocks):
+    i = pl.program_id(2)  # q block
+    j = pl.program_id(3)  # k block (innermost)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    if causal:
+        last_j = jnp.minimum(((i + 1) * block_q - 1) // block_k, num_k_blocks - 1)
+        contributes = ((i + 1) * block_q - 1) >= j * block_k
+    else:
+        last_j = num_k_blocks - 1
+        contributes = jnp.bool_(True)
+
+    @pl.when(contributes)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0].astype(jnp.float32)  # (block_q, 1)
+        delta = delta_ref[0, 0].astype(jnp.float32)  # (block_q, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(j == last_j)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(res, do_bhsd, sm_scale, causal, block_q, block_k, interpret):
+    q, k, v, out, lse = res
+    b, h, s, d = q.shape
+    nq, nk = s // block_q, s // block_k
+    delta = jnp.sum(
+        do_bhsd.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )  # (b, h, s, 1)
+
+    qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, j, i: (b_, h_, i, 0))
+    kspec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, j, i: (b_, h_, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel,
+            sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_q_blocks=nq,
+        ),
+        grid=(b, h, nk, nq),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(q, k, v, do_bhsd, lse, delta)
+
+    qspec2 = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kspec2 = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    rowspec2 = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel,
+            sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_k_blocks=nk,
+        ),
+        grid=(b, h, nq, nk),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(q, k, v, do_bhsd, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API with custom VJP ((B, S, n, d) layout, matching modeling.attention)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, _use_interpret())
+    return out
+
+
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, _use_interpret())
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, res, do):
+    dq, dk, dv = _flash_bwd(res, do, sm_scale, causal, block_q, block_k, _use_interpret())
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+):
+    """q, k, v: (batch, seq, heads, head_dim); returns same layout.
+
+    GQA callers repeat kv heads first (modeling._repeat_kv). Tiles of
+    (block_q, block_k); shapes that don't tile fall back to the einsum path.
+    Defaults tuned on v5e (seq 2048, d 128): 512/512 beats XLA attention on
+    both passes; 128/128 loses on the backward.
+    """
+    b, s, n, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        from galvatron_tpu.models import modeling
+
+        cfg = modeling.ModelConfig(num_heads=n, hidden_size=n * d, attn_impl="xla")
+        return modeling.attention_xla(q, k, v, cfg)
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    out = _flash(qt, kt, vt, sm_scale, causal, block_q, block_k)
+    return jnp.transpose(out, (0, 2, 1, 3))

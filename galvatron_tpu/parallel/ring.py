@@ -1,0 +1,113 @@
+"""Ring attention: context parallelism over an ICI ring.
+
+A first-class long-context capability the reference lacks entirely (SURVEY
+§2.3: no CP/ring/Ulysses anywhere in Galvatron; its long-context story is
+Megatron-SP + FlashAttention + ckpt only). Sequence is sharded over the CP
+mesh axes; K/V blocks rotate around the ring via ``lax.ppermute`` while each
+device accumulates its queries' attention with online softmax — O(S/cp)
+activation memory per device, exact causal attention.
+
+Schedule: step 0 attends to the local (diagonal) K/V block, so the running
+max starts finite; later steps mask by global position (blocks entirely in
+the future contribute exp(-inf - m) = 0, never NaN).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from galvatron_tpu.models import modeling
+from galvatron_tpu.models.modeling import ModelConfig
+
+NEG_INF = -1e30
+
+
+def _ring_attn_local(q, k, v, axis_name: str, cp: int, sm_scale: float):
+    """Runs inside shard_map with ``axis_name`` manual. q/k/v local:
+    (B, S/cp, n, d), sequence sharded in ring order."""
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    perm = [(i, (i + 1) % cp) for i in range(cp)]  # kv block i → device i+1
+
+    q32 = q.astype(jnp.float32)
+    rows = idx * s_local + jnp.arange(s_local)  # global q positions
+
+    def step(carry, step_idx):
+        k_cur, v_cur, m, l, acc = carry
+        owner = (idx - step_idx) % cp  # whose kv block we currently hold
+        cols = owner * s_local + jnp.arange(s_local)
+        scores = (
+            jnp.einsum("bqnh,bknh->bnqk", q32, k_cur.astype(jnp.float32)) * sm_scale
+        )
+        mask = rows[:, None] >= cols[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        p = jnp.exp(scores - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + p.sum(axis=-1)
+        acc_new = alpha[..., None] * acc + jnp.einsum(
+            "bnqk,bknh->bnqh", p, v_cur.astype(jnp.float32)
+        )
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    b, _, n, d = q.shape
+    m0 = jnp.full((b, n, s_local), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, n, s_local), jnp.float32)
+    acc0 = jnp.zeros((b, n, s_local, d), jnp.float32)
+    (k, v, m, l, acc), _ = jax.lax.scan(step, (k, v, m0, l0, acc0), jnp.arange(cp))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # (B, S/cp, n, d)
+
+
+def ring_attention(
+    q, k, v, mesh: Mesh, cp_axes: Sequence[str], sm_scale: float | None = None
+):
+    """q/k/v: (B, S, n, d) global arrays; sequence ring-sharded over cp_axes."""
+    cp = int(np.prod([mesh.shape[a] for a in cp_axes]))
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    axis = tuple(cp_axes)
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_attn_local, axis_name=axis, cp=cp, sm_scale=sm_scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names=set(cp_axes),
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def ring_decoder_layer(x, p, cfg: ModelConfig, mesh, cp_axes, cos_sin):
+    """Decoder layer with the attention core ring-parallelized (drop-in for
+    modeling.decoder_layer when a layer strategy sets cp > 1)."""
+
+    def attn(xn):
+        b, s, h = xn.shape
+        hd = cfg.head_dim
+        q = (xn @ p["attn"]["wq"].astype(xn.dtype)).reshape(b, s, cfg.num_heads, hd)
+        k = (xn @ p["attn"]["wk"].astype(xn.dtype)).reshape(b, s, cfg.kv_heads, hd)
+        v = (xn @ p["attn"]["wv"].astype(xn.dtype)).reshape(b, s, cfg.kv_heads, hd)
+        if cfg.pos_embed == "rope":
+            cos, sin = cos_sin
+            q = modeling.apply_rope(q, cos, sin)
+            k = modeling.apply_rope(k, cos, sin)
+        k = modeling._repeat_kv(k, cfg.num_heads // k.shape[2])
+        v = modeling._repeat_kv(v, cfg.num_heads // v.shape[2])
+        o = ring_attention(q, k, v, mesh, cp_axes)
+        return o.reshape(b, s, cfg.num_heads * hd) @ p["attn"]["wo"].astype(xn.dtype)
+
+    x = x + attn(modeling.norm(x, p["attn_norm"], cfg))
+    x = x + modeling.mlp_block(modeling.norm(x, p["mlp_norm"], cfg), p["mlp"], cfg)
+    return x
