@@ -1,0 +1,85 @@
+"""Loader for the native C++ DP core (csrc/dp_core.cpp).
+
+The reference builds its DP kernel with pybind11 via setup.py (reference:
+csrc/dp_core.cpp:92-94, setup.py:39-44, Makefile:1-20). pybind11 is not in
+this environment, so the kernel is a plain C-ABI shared object compiled with
+g++ on first use and bound with ctypes; dynamic_programming.py falls back to
+NumPy when no compiler is available (mirroring the reference's NumPy fallback,
+galvatron/core/dynamic_programming.py:98-128).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_SRC = _REPO_ROOT / "csrc" / "dp_core.cpp"
+_BUILD_DIR = _REPO_ROOT / "build"
+_SO = _BUILD_DIR / "libgalvatron_dp_core.so"
+
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    _BUILD_DIR.mkdir(exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SRC), "-o", str(_SO)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_dp_core() -> Optional[ctypes.CDLL]:
+    """Returns the loaded library or None (→ NumPy fallback)."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    try:
+        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+            if not _build():
+                _load_failed = True
+                return None
+        lib = ctypes.CDLL(str(_SO))
+        lib.galvatron_dp_core.restype = ctypes.c_double
+        lib.galvatron_dp_core.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        _lib = lib
+        return _lib
+    except Exception:
+        _load_failed = True
+        return None
+
+
+def dp_core_native(mem: np.ndarray, intra: np.ndarray, inter: np.ndarray, budget: int):
+    """Run the native DP. mem: (L,S) int32 units; intra: (L,S); inter: (S,S).
+    Returns (min_cost, res[L], mem_used) or None if the library is missing."""
+    lib = get_dp_core()
+    if lib is None:
+        return None
+    L, S = mem.shape
+    res = np.full((L,), -1, np.int32)
+    mem_used = ctypes.c_int32(0)
+    cost = lib.galvatron_dp_core(
+        np.int32(L), np.int32(budget), np.int32(S),
+        np.ascontiguousarray(mem, np.int32),
+        np.ascontiguousarray(intra, np.float64),
+        np.ascontiguousarray(inter, np.float64),
+        res, ctypes.byref(mem_used),
+    )
+    return float(cost), res, int(mem_used.value)

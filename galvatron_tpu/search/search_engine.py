@@ -1,0 +1,277 @@
+"""The automatic-parallelism search engine.
+
+Counterpart of the reference's GalvatronSearchEngine (reference:
+galvatron/core/search_engine.py:17-715): enumerate the hybrid-strategy space
+over powers of two — {pp} × {tp, layout} × {zero2/zero3 vs ddp} × {sp} ×
+{ckpt} (+ optional cp rings for long context) — evaluate micro-batch counts,
+run the per-layer dynamic program under the per-chip HBM budget for every
+(pp, bsz, chunks), refine with the pipeline cost model, and emit the winning
+strategy as a runtime-loadable HybridParallelConfig JSON
+(search flow: search_engine.py:168-324; config save :326-367).
+
+Output throughput metric matches the reference's
+``Max throughput = bsz / min_cost`` (search_engine.py:318-321).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy, form_strategy
+from galvatron_tpu.search.cost_model import (
+    MemoryCost,
+    ProfiledHardware,
+    ProfiledLayerType,
+    ProfiledModelCosts,
+    layer_memory_cost,
+    layer_time_cost,
+    other_memory_cost,
+    pipeline_time_cost,
+)
+from galvatron_tpu.search.dynamic_programming import run_dp, transition_cost_ms
+
+
+@dataclass
+class SearchSpace:
+    world_size: int
+    max_tp: Optional[int] = None
+    allow_sp: bool = True
+    allow_ckpt: bool = True
+    allow_zero2: bool = True
+    allow_zero3: bool = True
+    allow_strided: bool = True
+    allow_cp: bool = False
+    pp_choices: Optional[List[int]] = None
+    pipeline_types: Tuple[str, ...] = ("gpipe", "pipedream_flush")
+
+
+def _pow2s(n: int) -> List[int]:
+    out, v = [], 1
+    while v <= n:
+        out.append(v)
+        v *= 2
+    return out
+
+
+def generate_layer_strategies(space: SearchSpace, pp: int) -> List[LayerStrategy]:
+    """Per-layer strategy candidates for a given pp (reference:
+    generate_strategies, search_engine.py:424-537)."""
+    per_stage = space.world_size // pp
+    tps = [t for t in _pow2s(per_stage) if space.max_tp is None or t <= space.max_tp]
+    out: List[LayerStrategy] = []
+    for tp in tps:
+        dp = per_stage // tp
+        consec_opts = [True, False] if (space.allow_strided and 1 < tp < per_stage) else [True]
+        sp_opts = [False, True] if (space.allow_sp and tp > 1) else [False]
+        dp_types = ["ddp"]
+        if dp > 1 and space.allow_zero2:
+            dp_types.append("zero2")
+        if dp > 1 and space.allow_zero3:
+            dp_types.append("zero3")
+        cp_opts = [1]
+        if space.allow_cp and dp > 1:
+            cp_opts += [c for c in _pow2s(dp) if c > 1]
+        for consec, sp, dpt, cp in itertools.product(consec_opts, sp_opts, dp_types, cp_opts):
+            if cp > 1 and sp:
+                continue
+            for ckpt in [False, True] if space.allow_ckpt else [False]:
+                out.append(
+                    LayerStrategy(tp=tp, tp_consec=consec, dp_type=dpt, ckpt=ckpt, sp=sp, cp=cp)
+                )
+    return out
+
+
+@dataclass
+class SearchResult:
+    config: HybridParallelConfig
+    cost_ms: float
+    throughput_samples_per_s: float
+    global_bsz: int
+    memory_mb: float
+    details: Dict = field(default_factory=dict)
+
+
+class SearchEngine:
+    """Ties profiled model + hardware data to the DP (reference:
+    GalvatronSearchEngine.initialize_search_engine / parallelism_optimization,
+    search_engine.py:85-90,168-228)."""
+
+    def __init__(
+        self,
+        model_costs: ProfiledModelCosts,
+        hardware: ProfiledHardware,
+        num_layers: int,
+        space: SearchSpace,
+        memory_budget_mb: float,
+        mixed_precision: str = "bf16",
+        mem_unit_mb: float = 8.0,
+    ):
+        self.costs = model_costs
+        self.hw = hardware
+        self.L = num_layers
+        self.space = space
+        self.budget_mb = memory_budget_mb
+        self.mp = mixed_precision
+        self.unit = mem_unit_mb
+
+    def _layer_type(self, i: int) -> ProfiledLayerType:
+        lts = self.costs.layer_types
+        return lts.get(i, lts[0]) if len(lts) > 1 else lts[0]
+
+    # -- single (pp, bsz, chunks, pipeline_type) evaluation ------------------
+
+    def evaluate(
+        self, pp: int, global_bsz: int, chunks: int, pipeline_type: str
+    ) -> Optional[SearchResult]:
+        space = self.space
+        world = space.world_size
+        if world % pp or self.L % pp:
+            return None
+        if global_bsz % chunks:
+            return None
+        lps = self.L // pp
+        cands = generate_layer_strategies(space, pp)
+        # the micro-batch (global_bsz / chunks) must split over each
+        # strategy's dp axes — strict chunk filter
+        def feasible(s: LayerStrategy) -> bool:
+            dp = world // (pp * s.tp * s.cp)
+            return (global_bsz % (dp * chunks * max(1, s.cp))) == 0
+
+        cands = [s for s in cands if feasible(s)]
+        if not cands:
+            return None
+        S = len(cands)
+
+        budget = self.budget_mb - other_memory_cost(
+            self.costs, world, pp, vocab_tp=1, embed_dp_type="zero3" if pp == 1 else "ddp",
+            global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,
+        )
+        if budget <= 0:
+            return None
+        V = int(budget / self.unit)
+
+        # positions: pp=1 → every layer; pp>1 → one per stage position (the
+        # stage-stacking constraint makes positions the DP unit); memory is
+        # identical across stages, stage 0 carries the 1F1B worst case
+        n_pos = self.L if pp == 1 else lps
+        mem = np.zeros((n_pos, S), np.int32)
+        intra = np.zeros((n_pos, S), np.float64)
+        for j in range(n_pos):
+            lt = self._layer_type(j)
+            for k, s in enumerate(cands):
+                mc = layer_memory_cost(
+                    lt, s, world, pp, global_bsz, chunks, stage_idx=0,
+                    pipeline_type=pipeline_type, mixed_precision=self.mp,
+                )
+                mem[j, k] = max(1, int(np.ceil(mc.total_mb / self.unit)))
+                intra[j, k] = layer_time_cost(
+                    lt, s, self.hw, world, pp, global_bsz, mixed_precision=self.mp
+                )
+        lt0 = self._layer_type(0)
+        inter = np.zeros((S, S), np.float64)
+        for a in range(S):
+            for b in range(S):
+                inter[a, b] = transition_cost_ms(
+                    cands[a], cands[b], lt0, self.hw, world, pp, global_bsz, self.mp
+                )
+
+        cost, res, mem_used = run_dp(mem, intra, inter, V)
+        if not np.isfinite(cost) or (res < 0).any():
+            return None
+
+        chosen = [cands[k] for k in res]
+        if pp > 1:
+            layer_strategies = chosen * pp  # same per-position pattern each stage
+            per_stage_ms = sum(intra[j, res[j]] for j in range(lps)) / chunks
+            stage_ms = [per_stage_ms] * pp
+            boundary_msg = (
+                lt0.boundary_activation_mb_per_sample
+                * (global_bsz / chunks)
+                * (0.5 if self.mp == "bf16" else 1.0)
+            )
+            total_ms = pipeline_time_cost(stage_ms, boundary_msg, pp, chunks, self.hw)
+            total_ms += sum(
+                inter[res[j], res[j + 1]] for j in range(lps - 1)
+            )
+        else:
+            layer_strategies = chosen
+            total_ms = cost
+
+        total_ms += self.costs.other_fwd_ms_per_sample * global_bsz / world * 3.0
+        hp = HybridParallelConfig(
+            pp=pp,
+            layer_strategies=layer_strategies,
+            chunks=chunks,
+            pipeline_type=pipeline_type,
+            vocab_tp=1,
+            embed_dp_type="zero3" if pp == 1 else "ddp",
+            mixed_precision=self.mp,
+            default_dp_type="ddp",
+        )
+        return SearchResult(
+            config=hp,
+            cost_ms=float(total_ms),
+            throughput_samples_per_s=global_bsz / (total_ms / 1000.0),
+            global_bsz=global_bsz,
+            memory_mb=float(mem_used * self.unit),
+            details={"pp": pp, "chunks": chunks, "pipeline_type": pipeline_type},
+        )
+
+    # -- full optimization loop ---------------------------------------------
+
+    def search(
+        self,
+        global_bsz_list: Sequence[int],
+        max_chunks: int = 64,
+        verbose: bool = False,
+    ) -> Optional[SearchResult]:
+        """Sweep (bsz, pp, chunks, schedule); maximize throughput (reference:
+        parallelism_optimization, search_engine.py:168-324)."""
+        best: Optional[SearchResult] = None
+        pps = self.space.pp_choices or [
+            p for p in _pow2s(self.space.world_size) if self.L % p == 0
+        ]
+        for bsz in global_bsz_list:
+            for pp in pps:
+                chunk_opts = [c for c in _pow2s(min(max_chunks, bsz)) if bsz % c == 0]
+                for chunks in chunk_opts:
+                    if pp == 1 and chunks > 1 and len(chunk_opts) > 1:
+                        pass  # accumulation also searched at pp=1
+                    for ptype in self.space.pipeline_types if pp > 1 else ("gpipe",):
+                        r = self.evaluate(pp, bsz, chunks, ptype)
+                        if r is None:
+                            continue
+                        if verbose:
+                            print(
+                                f"bsz={bsz} pp={pp} chunks={chunks} {ptype}: "
+                                f"{r.cost_ms:.1f} ms, {r.throughput_samples_per_s:.2f} samples/s, "
+                                f"mem {r.memory_mb:.0f} MB"
+                            )
+                        if best is None or (
+                            r.throughput_samples_per_s > best.throughput_samples_per_s
+                        ):
+                            best = r
+        if best is not None and verbose:
+            s0 = best.config.layer_strategies[0]
+            dp = self.space.world_size // (best.config.pp * s0.tp * s0.cp)
+            print(
+                f"Max throughput = {best.throughput_samples_per_s:.2f} samples/s "
+                f"(bsz {best.global_bsz}, {form_strategy(s0, best.config.pp, dp)})"
+            )
+        return best
+
+    def save_result(self, result: SearchResult, path: str) -> None:
+        d = result.config.to_json_dict()
+        d["search_cost_ms"] = result.cost_ms
+        d["search_throughput_samples_per_s"] = result.throughput_samples_per_s
+        d["global_bsz"] = result.global_bsz
+        d["memory_mb"] = result.memory_mb
+        with open(path, "w") as f:
+            json.dump(d, f, indent=2)
