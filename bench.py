@@ -1,0 +1,83 @@
+"""Benchmark: LLaMA-7B-shape per-layer forward time per sample, bf16.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+The reference ships no absolute end-to-end numbers (BASELINE.md); its
+concrete per-layer artifact is 4.64 ms forward per layer per sample for the
+LLaMA-7B shape (h=4096, 32 heads, seq 2048) in bf16 on one A100 (reference:
+models/llama_hf/configs/computation_profiling_bf16_hidden4096_head32_
+seqlen2048.json:4). We measure the same quantity on one TPU chip with the
+Pallas flash-attention path, by the same layer-count difference method the
+reference profiler uses. vs_baseline = reference_ms / measured_ms (>1 ⇒
+faster per layer than the reference's A100 measurement).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REF_MS_PER_LAYER_PER_SAMPLE = 4.64
+
+
+def measure(cfg, bsz, seq, iters=6):
+    from galvatron_tpu.models import modeling
+
+    params = modeling.init_model_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((bsz, seq), jnp.int32)
+
+    @jax.jit
+    def fwd(params, tokens):
+        x = modeling.embed(tokens, params, cfg)
+        cos_sin = modeling.rope_tables(cfg, seq)
+        for lp in params["layers"]:
+            x = modeling.decoder_layer(x, lp, cfg, cos_sin, None)
+        return jnp.sum(x.astype(jnp.float32))
+
+    out = fwd(params, tokens)
+    _ = float(out)  # compile + sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fwd(params, tokens)
+    _ = float(out)
+    return (time.perf_counter() - t0) / iters * 1000.0
+
+
+def main():
+    from galvatron_tpu.models.modeling import ModelConfig
+
+    bsz, seq = 8, 2048
+    base = ModelConfig(
+        vocab_size=32000,
+        hidden_size=4096,
+        num_layers=2,
+        num_heads=32,
+        ffn_dim=11008,
+        max_seq_len=seq,
+        dtype=jnp.bfloat16,
+        param_dtype=jnp.bfloat16,
+        attn_impl="flash" if jax.default_backend() != "cpu" else "xla",
+    )
+    l1, l2 = 2, 6
+    t1 = measure(base.replace(num_layers=l1), bsz, seq)
+    t2 = measure(base.replace(num_layers=l2), bsz, seq)
+    ms_per_layer_per_sample = (t2 - t1) / (l2 - l1) / bsz
+    print(
+        json.dumps(
+            {
+                "metric": "llama7b_shape_fwd_ms_per_layer_per_sample_bf16",
+                "value": round(ms_per_layer_per_sample, 4),
+                "unit": "ms",
+                "vs_baseline": round(REF_MS_PER_LAYER_PER_SAMPLE / ms_per_layer_per_sample, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

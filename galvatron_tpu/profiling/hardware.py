@@ -1,0 +1,161 @@
+"""Hardware profiler: ICI/DCN collective bandwidth + overlap coefficient.
+
+The nccl-tests replacement (reference: galvatron/core/profiler.py:404-532
+shells out to all_reduce_perf/sendrecv_perf and parses 'Avg bus bandwidth';
+profile_overlap.py:14-160 measures the compute/comm overlap slowdown with
+CUDA streams). Here each measurement is a jitted collective over a subset of
+mesh axes, timed with forced host synchronization:
+
+- allreduce bus bandwidth per (group size, consec-vs-strided axis layout) —
+  consec = minor mesh axes (ICI-adjacent), strided = major axes, the layout
+  dimension the search engine prices (hardware_configs/allreduce_bandwidth_*);
+- p2p bandwidth per pipeline degree via ppermute along the pp axis;
+- overlap coefficient: slowdown of a matmul+allreduce program vs
+  max(matmul, allreduce) alone.
+
+Writes the ProfiledHardware JSON schema consumed by the search engine.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from galvatron_tpu.parallel.mesh import MeshAxes, build_mesh
+from galvatron_tpu.search.cost_model import ProfiledHardware
+
+
+def _time_fn(fn, *args, iters: int = 5, warmup: int = 2) -> float:
+    """Median wall time (s) with a host fetch to force completion (device
+    timers differ across backends; host fetch is the portable sync)."""
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _ = np.asarray(jax.tree.leaves(out)[0].ravel()[0])  # host fetch
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def profile_allreduce(
+    mesh: Mesh,
+    axes: MeshAxes,
+    msg_mb: float = 64.0,
+    dtype=jnp.bfloat16,
+) -> Dict[str, float]:
+    """Bus bandwidth (GB/s) for every (group size, consec) the mesh supports."""
+    out: Dict[str, float] = {}
+    m = len(axes.data_axes)
+    nbytes = np.dtype(dtype).itemsize
+    n_elem = int(msg_mb * 1e6 / nbytes)
+    x = jnp.ones((n_elem,), dtype)
+    for k in range(1, m + 1):
+        size = 2**k
+        for consec in (True, False):
+            if k == m and not consec:
+                continue  # full-extent group has one layout
+            group = axes.tp_axes(size, consec)
+
+            @jax.jit
+            def ar(x, group=group):
+                y = jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, P(axes.data_axes))
+                )
+                return jax.shard_map(
+                    lambda v: jax.lax.psum(v, group),
+                    mesh=mesh,
+                    in_specs=P(axes.data_axes),
+                    out_specs=P(axes.data_axes),
+                    axis_names=set(axes.data_axes) | {axes.pp},
+                    check_vma=False,
+                )(y)
+
+            t = _time_fn(ar, x)
+            bus_gb = 2.0 * (size - 1) / size * (n_elem * nbytes / size) / t / 1e9
+            out[f"{size}_{int(consec)}"] = round(bus_gb * size, 3)
+    return out
+
+
+def profile_p2p(
+    world: int, msg_mb: float = 64.0, dtype=jnp.bfloat16
+) -> Dict[int, float]:
+    """ppermute bandwidth (GB/s) per pipeline degree (reference p2p profile:
+    core/profiler.py:429-441)."""
+    out: Dict[int, float] = {}
+    nbytes = np.dtype(dtype).itemsize
+    pp = 2
+    while pp <= world:
+        mesh, axes = build_mesh(pp=pp)
+        n_per = int(msg_mb * 1e6 / nbytes)  # message size per stage boundary
+        x = jnp.ones((pp, n_per), dtype)
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        @jax.jit
+        def send(x, mesh=mesh, perm=perm):
+            return jax.shard_map(
+                lambda v: jax.lax.ppermute(v, "pp", perm),
+                mesh=mesh,
+                in_specs=P("pp"),
+                out_specs=P("pp"),
+                axis_names={"pp"},
+                check_vma=False,
+            )(x)
+
+        t = _time_fn(send, x)
+        out[pp] = round((n_per * nbytes) / t / 1e9, 3)
+        pp *= 2
+    return out
+
+
+def profile_overlap_coe(mesh: Mesh, axes: MeshAxes, size_mb: float = 64.0) -> float:
+    """Compute/communication overlap slowdown (reference:
+    profile_hardware/profile_overlap.py — gemm + allreduce on parallel CUDA
+    streams; here: one XLA program containing both, which XLA overlaps)."""
+    n = 2048
+    a = jnp.ones((n, n), jnp.bfloat16)
+    nbytes = int(size_mb * 1e6 / 2)
+    x = jnp.ones((nbytes,), jnp.bfloat16)
+    group = axes.data_axes
+
+    def mm(a):
+        for _ in range(8):
+            a = a @ a * 0.01
+        return a
+
+    sm = lambda f: jax.shard_map(
+        f, mesh=mesh, in_specs=P(axes.data_axes), out_specs=P(axes.data_axes),
+        axis_names=set(axes.data_axes) | {axes.pp}, check_vma=False,
+    )
+    ar = lambda v: jax.lax.psum(v, group)
+    t_mm = _time_fn(jax.jit(mm), a)
+    t_ar = _time_fn(jax.jit(sm(ar)), x)
+    t_both = _time_fn(jax.jit(lambda a, x: (mm(a), sm(ar)(x))), a, x)
+    coe = t_both / max(t_mm, t_ar)
+    return round(max(1.0, float(coe)), 4)
+
+
+def profile_hardware(
+    msg_mb: float = 64.0, out_path: Optional[str] = None
+) -> ProfiledHardware:
+    """Full sweep (reference entry: profile_hardware/profile_hardware.py)."""
+    mesh, axes = build_mesh(pp=1)
+    world = mesh.devices.size
+    hw = ProfiledHardware(
+        allreduce_bw=profile_allreduce(mesh, axes, msg_mb),
+        p2p_bw=profile_p2p(world, msg_mb) if world > 1 else {},
+        overlap_coe=profile_overlap_coe(mesh, axes, msg_mb) if world > 1 else 1.1,
+    )
+    if out_path:
+        from galvatron_tpu.utils.config_utils import save_profiled_hardware
+
+        save_profiled_hardware(hw, out_path)
+    return hw

@@ -1,0 +1,144 @@
+"""Model profiler: per-layer compute time and memory.
+
+Counterpart of the reference's launcher-based profiler (reference:
+galvatron/core/profiler.py:194-401 — launches train_dist.py across
+{layernum_min,max} x tp x ckpt via os.system, then differences the results).
+Here no process launches are needed: the layernum-difference method runs two
+jitted training programs in-process, and memory comes from XLA's compile-time
+memory analysis instead of allocator snapshots:
+
+  per-layer fwd ms  = (iter(L2) - iter(L1)) / (L2 - L1) / bsz / 3
+  per-layer act MB  = (temp_bytes(L2) - temp_bytes(L1)) / (L2 - L1) / bsz
+
+(the /3 removes the bwd≈2x fwd share from a full training step; the reference
+separates fwd via profile hooks, core/profiler.py:133-171).
+
+Parameter sizes are computed analytically from the model config.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from galvatron_tpu.core.optim import AdamConfig
+from galvatron_tpu.core.strategy import HybridParallelConfig
+from galvatron_tpu.models import modeling
+from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.search.cost_model import ProfiledLayerType, ProfiledModelCosts
+
+
+def layer_param_count(cfg: ModelConfig) -> int:
+    h, f = cfg.hidden_size, cfg.ffn
+    attn = h * cfg.num_heads * cfg.head_dim + 2 * h * cfg.kv_heads * cfg.head_dim + cfg.num_heads * cfg.head_dim * h
+    mlp = (3 if cfg.act_fn == "swiglu" else 2) * h * f
+    norms = 2 * h * (2 if cfg.norm_type == "layernorm" else 1)
+    return attn + mlp + norms
+
+
+def other_param_count(cfg: ModelConfig) -> int:
+    n = cfg.vocab_size * cfg.hidden_size
+    if cfg.pos_embed == "learned":
+        n += cfg.max_seq_len * cfg.hidden_size
+    if not cfg.tie_word_embeddings:
+        n += cfg.hidden_size * cfg.vocab_size
+    n += cfg.hidden_size * (2 if cfg.norm_type == "layernorm" else 1)
+    return n
+
+
+def _iter_time_ms(cfg: ModelConfig, bsz: int, seq: int, iters: int = 4) -> float:
+    """Wall time per training iteration of the plain single-device model
+    (the reference's train.py measurement path)."""
+    from galvatron_tpu.core.optim import adamw_update, init_opt_state
+
+    params = modeling.init_model_params(jax.random.key(0), cfg)
+    opt = init_opt_state(params)
+    adam = AdamConfig(lr=1e-4)
+
+    @jax.jit
+    def step(params, opt, batch):
+        loss, grads = jax.value_and_grad(lambda p: modeling.lm_loss(p, batch, cfg))(params)
+        return adamw_update(params, grads, opt, adam), loss
+
+    batch = jnp.zeros((bsz, seq + 1), jnp.int32)
+    (params, opt), loss = step(params, opt, batch)  # compile
+    _ = float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        (params, opt), loss = step(params, opt, batch)
+    _ = float(loss)  # host sync
+    return (time.perf_counter() - t0) / iters * 1000.0
+
+
+def _temp_bytes(cfg: ModelConfig, bsz: int, seq: int) -> Optional[int]:
+    """XLA-reported temporary (activation) bytes for a jitted loss+grad."""
+
+    def f(params, batch):
+        return jax.value_and_grad(lambda p: modeling.lm_loss(p, batch, cfg))(params)
+
+    params = jax.eval_shape(lambda k: modeling.init_model_params(k, cfg), jax.random.key(0))
+    batch = jax.ShapeDtypeStruct((bsz, seq + 1), jnp.int32)
+    try:
+        compiled = jax.jit(f).lower(params, batch).compile()
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return None
+        return int(ma.temp_size_in_bytes)
+    except Exception:
+        return None
+
+
+def profile_model(
+    cfg: ModelConfig,
+    bsz: int = 8,
+    seq: Optional[int] = None,
+    layernums: Tuple[int, int] = (2, 4),
+    measure_time: bool = True,
+    out_prefix: Optional[str] = None,
+) -> ProfiledModelCosts:
+    """Difference-method profile (reference: process_profiled_data,
+    core/profiler.py:243-401). Writes reference-schema JSONs if out_prefix."""
+    seq = seq or cfg.max_seq_len
+    l1, l2 = layernums
+    cfg1, cfg2 = cfg.replace(num_layers=l1), cfg.replace(num_layers=l2)
+
+    if measure_time:
+        t1, t2 = _iter_time_ms(cfg1, bsz, seq), _iter_time_ms(cfg2, bsz, seq)
+        fwd_ms = max(1e-4, (t2 - t1) / (l2 - l1) / bsz / 3.0)
+        other_ms = max(0.0, (t1 - fwd_ms * 3.0 * bsz * l1) / bsz / 3.0)
+    else:
+        fwd_ms, other_ms = 1.0, 0.1
+
+    b1, b2 = _temp_bytes(cfg1, bsz, seq), _temp_bytes(cfg2, bsz, seq)
+    if b1 is not None and b2 is not None and b2 > b1:
+        act_mb = (b2 - b1) / (l2 - l1) / bsz / 1e6
+    else:  # analytic fallback: residuals + attn + mlp intermediates, bf16
+        act_bytes = seq * cfg.hidden_size * (10 + 4 * cfg.ffn / cfg.hidden_size)
+        act_mb = act_bytes * 2 / 1e6
+
+    boundary_mb = seq * cfg.hidden_size * 2 / 1e6  # one bf16 (S, H) tensor
+    p_mb = layer_param_count(cfg) * 4 / 1e6
+    costs = ProfiledModelCosts(
+        layer_types={
+            0: ProfiledLayerType(
+                fwd_ms_per_sample=float(fwd_ms),
+                parameter_mb=float(p_mb),
+                activation_mb_per_sample={t: float(act_mb / t) for t in (1, 2, 4, 8)},
+                boundary_activation_mb_per_sample=float(boundary_mb),
+            )
+        },
+        other_param_mb=float(other_param_count(cfg) * 4 / 1e6),
+        other_act_mb_per_sample=float(seq * cfg.vocab_size * 4 / 1e6),  # logits fp32
+        other_fwd_ms_per_sample=float(other_ms),
+    )
+    if out_prefix:
+        from galvatron_tpu.utils.config_utils import save_profiled_model
+
+        save_profiled_model(
+            costs, f"{out_prefix}_computation.json", f"{out_prefix}_memory.json"
+        )
+    return costs

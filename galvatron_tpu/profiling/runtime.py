@@ -1,0 +1,85 @@
+"""Runtime profiler: per-iteration time/memory during real training.
+
+Counterpart of the reference's in-trainer GalvatronProfiler hooks
+(reference: galvatron/core/profiler.py:88-191 — CUDA allocator snapshots at
+Before-Forward/After-Forward/After-Backward and CUDA-event timing). On TPU:
+wall timing around the donated train step with host sync, and
+``device.memory_stats()`` for HBM peaks where the backend exposes it.
+
+Also hosts the cost-model fidelity check — predicted vs measured iteration
+time — which is the reproducible benchmark the reference itself optimizes
+(SURVEY §6; search print: search_engine.py:318-321).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+@dataclass
+class RuntimeProfiler:
+    warmup_iters: int = 2
+    iter_times_ms: List[float] = field(default_factory=list)
+    _t0: Optional[float] = None
+    _iter: int = 0
+
+    def begin_iter(self):
+        self._t0 = time.perf_counter()
+
+    def end_iter(self, sync_value=None):
+        """Pass a device scalar (e.g. the loss) to force completion."""
+        if sync_value is not None:
+            _ = float(sync_value)
+        dt = (time.perf_counter() - self._t0) * 1000.0
+        self._iter += 1
+        if self._iter > self.warmup_iters:
+            self.iter_times_ms.append(dt)
+
+    @property
+    def avg_iter_ms(self) -> float:
+        return float(np.mean(self.iter_times_ms)) if self.iter_times_ms else float("nan")
+
+    def throughput(self, global_bsz: int, seq_len: int) -> Dict[str, float]:
+        ms = self.avg_iter_ms
+        return {
+            "iter_ms": ms,
+            "samples_per_s": global_bsz / (ms / 1000.0),
+            "tokens_per_s": global_bsz * seq_len / (ms / 1000.0),
+        }
+
+    def memory_stats(self) -> Dict[str, float]:
+        """Per-device HBM stats in MB where the backend reports them
+        (utils/memory_utils.py:3-14 equivalent)."""
+        out: Dict[str, float] = {}
+        for d in jax.devices():
+            try:
+                st = d.memory_stats()
+            except Exception:
+                st = None
+            if st:
+                out[f"dev{d.id}_bytes_in_use_mb"] = st.get("bytes_in_use", 0) / 1e6
+                out[f"dev{d.id}_peak_bytes_mb"] = st.get("peak_bytes_in_use", 0) / 1e6
+        return out
+
+    def report(self, global_bsz: int, seq_len: int, predicted_ms: Optional[float] = None):
+        tp = self.throughput(global_bsz, seq_len)
+        lines = [
+            f"avg iter: {tp['iter_ms']:.2f} ms | "
+            f"{tp['samples_per_s']:.2f} samples/s | {tp['tokens_per_s']:.0f} tokens/s"
+        ]
+        if predicted_ms is not None and np.isfinite(tp["iter_ms"]):
+            fidelity = predicted_ms / tp["iter_ms"]
+            lines.append(
+                f"cost-model fidelity: predicted {predicted_ms:.2f} ms / measured "
+                f"{tp['iter_ms']:.2f} ms = {fidelity:.3f}"
+            )
+        mem = self.memory_stats()
+        if mem:
+            peak = max((v for k, v in mem.items() if "peak" in k), default=0.0)
+            lines.append(f"peak HBM: {peak:.0f} MB")
+        return "\n".join(lines)

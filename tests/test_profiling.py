@@ -1,0 +1,84 @@
+"""Profiler tests (build plan step 8): the hardware sweep and model
+difference-profiler must produce plausible, search-engine-consumable data on
+the CPU simulation (absolute numbers are only meaningful on real hardware)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.profiling.hardware import profile_hardware
+from galvatron_tpu.profiling.model import layer_param_count, other_param_count, profile_model
+from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+
+CFG = ModelConfig(
+    vocab_size=128, hidden_size=64, num_layers=4, num_heads=4, ffn_dim=128,
+    max_seq_len=32, dtype=jnp.float32,
+)
+
+
+def test_param_count_matches_init():
+    params = jax.eval_shape(
+        lambda k: __import__("galvatron_tpu.models.modeling", fromlist=["x"]).init_layer_params(k, CFG),
+        jax.random.key(0),
+    )
+    n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    assert n == layer_param_count(CFG)
+
+
+def test_other_param_count_matches_init():
+    from galvatron_tpu.models import modeling
+
+    full = jax.eval_shape(lambda k: modeling.init_model_params(k, CFG), jax.random.key(0))
+    n_full = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(full))
+    assert n_full == other_param_count(CFG) + CFG.num_layers * layer_param_count(CFG)
+
+
+def test_hardware_profile_schema(tmp_path):
+    hw = profile_hardware(msg_mb=1.0, out_path=str(tmp_path / "hw.json"))
+    # 8-device sim → sizes 2, 4 (consec+strided) and 8
+    assert set(hw.allreduce_bw) == {"2_1", "2_0", "4_1", "4_0", "8_1"}
+    assert all(v > 0 for v in hw.allreduce_bw.values())
+    assert set(hw.p2p_bw) == {2, 4, 8}
+    assert hw.overlap_coe >= 1.0
+    from galvatron_tpu.utils.config_utils import load_profiled_hardware
+
+    hw2 = load_profiled_hardware(str(tmp_path / "hw.json"))
+    assert hw2.allreduce_bw == hw.allreduce_bw and hw2.p2p_bw == hw.p2p_bw
+
+
+def test_model_profile_and_search_consume(tmp_path):
+    costs = profile_model(
+        CFG, bsz=4, seq=32, layernums=(2, 4), out_prefix=str(tmp_path / "llama_tiny")
+    )
+    lt = costs.layer_types[0]
+    assert lt.fwd_ms_per_sample > 0
+    assert lt.parameter_mb == pytest.approx(layer_param_count(CFG) * 4 / 1e6)
+    assert lt.activation_mb_per_sample[1] > 0
+    # roundtrip through the JSON schema
+    from galvatron_tpu.utils.config_utils import load_profiled_model
+
+    costs2 = load_profiled_model(
+        str(tmp_path / "llama_tiny_computation.json"), str(tmp_path / "llama_tiny_memory.json")
+    )
+    assert costs2.layer_types[0].parameter_mb == pytest.approx(lt.parameter_mb)
+    # profiled data drives a real search
+    hw = profile_hardware(msg_mb=1.0)
+    eng = SearchEngine(
+        costs2, hw, num_layers=4, space=SearchSpace(world_size=8), memory_budget_mb=500.0
+    )
+    res = eng.search([8])
+    assert res is not None and np.isfinite(res.cost_ms)
+
+
+def test_runtime_profiler_fidelity_report():
+    from galvatron_tpu.profiling.runtime import RuntimeProfiler
+
+    prof = RuntimeProfiler(warmup_iters=1)
+    for _ in range(4):
+        prof.begin_iter()
+        prof.end_iter(jnp.float32(1.0))
+    assert np.isfinite(prof.avg_iter_ms)
+    rep = prof.report(global_bsz=8, seq_len=32, predicted_ms=prof.avg_iter_ms)
+    assert "cost-model fidelity" in rep
