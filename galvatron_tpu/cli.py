@@ -1,0 +1,130 @@
+"""Unified CLI: python -m galvatron_tpu.cli <mode> [--model_size ...] ...
+
+Modes mirror the reference's per-model entry scripts (reference L7,
+models/<name>/{train_dist,search_dist,profiler}.py + profile_hardware):
+
+  train             hybrid-parallel training (train_dist equivalent)
+  search            parallelism optimization → galvatron_config JSON
+  profile           model computation/memory profiling → JSON
+  profile-hardware  ICI bandwidth + overlap sweep → JSON
+
+The per-model modules (galvatron_tpu.models.<family>) re-export these with
+family defaults, mirroring the reference's directory-per-model layout.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    mode, rest = argv[0], argv[1:]
+
+    from galvatron_tpu.core.arguments import initialize_galvatron, model_config_from_args
+
+    if mode == "train":
+        from galvatron_tpu.core.trainer import train
+
+        ns = initialize_galvatron("train", rest, model_default)
+        train(ns)
+        return 0
+
+    if mode == "search":
+        ns = initialize_galvatron("search", rest, model_default)
+        cfg = model_config_from_args(ns)
+        from galvatron_tpu.profiling.model import profile_model
+        from galvatron_tpu.search.cost_model import ProfiledHardware
+        from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+        from galvatron_tpu.utils.config_utils import (
+            load_profiled_hardware,
+            load_profiled_model,
+        )
+
+        if ns.time_profile_path and ns.memory_profile_path:
+            costs = load_profiled_model(ns.time_profile_path, ns.memory_profile_path)
+        else:
+            print("no profiled model data given; profiling in-process (measured on this host)")
+            costs = profile_model(cfg, bsz=ns.min_bsz)
+        hw = (
+            load_profiled_hardware(ns.hardware_profile_path)
+            if ns.hardware_profile_path
+            else ProfiledHardware()
+        )
+        sspace = SearchSpace(
+            world_size=ns.num_devices,
+            max_tp=ns.max_tp_deg,
+            allow_sp=not ns.disable_sp,
+            allow_ckpt=not ns.disable_ckpt,
+            allow_zero2=not ns.disable_sdp,
+            allow_zero3=not ns.disable_sdp,
+            allow_strided=not ns.disable_tp_consec,
+            allow_cp=bool(ns.enable_cp),
+        )
+        if ns.search_space == "dp":
+            sspace.max_tp, sspace.pp_choices = 1, [1]
+        elif ns.search_space == "tp":
+            sspace.pp_choices = [1]
+        elif ns.search_space == "pp":
+            sspace.max_tp = 1
+        elif ns.search_space == "dp+tp":
+            sspace.pp_choices = [1]
+        elif ns.search_space == "dp+pp":
+            sspace.max_tp = 1
+        elif ns.search_space == "sdp":
+            sspace.max_tp, sspace.pp_choices = 1, [1]
+        eng = SearchEngine(
+            costs, hw, num_layers=cfg.num_layers, space=sspace,
+            memory_budget_mb=ns.memory_constraint_gb * 1024.0,
+            mixed_precision="bf16",
+        )
+        if ns.settle_bsz > 0:
+            bszs = [ns.settle_bsz]
+        else:
+            bszs, b = [], ns.min_bsz
+            while b <= ns.max_bsz:
+                bszs.append(b)
+                b *= ns.bsz_scale
+        res = eng.search(bszs, max_chunks=ns.max_chunks, verbose=True)
+        if res is None:
+            print("no feasible strategy under the memory budget")
+            return 1
+        out = ns.output_config_path or f"galvatron_config_{ns.model_size}_{ns.num_devices}dev.json"
+        eng.save_result(res, out)
+        print(f"saved searched strategy → {out}")
+        return 0
+
+    if mode == "profile":
+        ns = initialize_galvatron("profile", rest, model_default)
+        cfg = model_config_from_args(ns)
+        from galvatron_tpu.profiling.model import profile_model
+
+        prefix = ns.output_prefix or f"profile_{ns.model_size}"
+        profile_model(
+            cfg, bsz=ns.profile_batch_size,
+            layernums=(ns.layernum_min, ns.layernum_max), out_prefix=prefix,
+        )
+        print(f"saved → {prefix}_computation.json, {prefix}_memory.json")
+        return 0
+
+    if mode == "profile-hardware":
+        ns = initialize_galvatron("profile_hardware", rest, model_default)
+        from galvatron_tpu.profiling.hardware import profile_hardware
+
+        hw = profile_hardware(msg_mb=ns.profile_size_mb, out_path=ns.hardware_output_path)
+        print(f"allreduce: {hw.allreduce_bw}")
+        print(f"p2p: {hw.p2p_bw}")
+        print(f"overlap_coe: {hw.overlap_coe}")
+        print(f"saved → {ns.hardware_output_path}")
+        return 0
+
+    print(f"unknown mode {mode!r}; expected train|search|profile|profile-hardware")
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

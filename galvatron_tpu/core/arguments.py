@@ -1,0 +1,195 @@
+"""Argument system for the four operating modes.
+
+Counterpart of the reference's two-tier flag system (reference:
+galvatron/core/arguments.py:5-313 — Megatron argparse + galvatron
+training/profile/search/hardware-profile groups, initialize_galvatron modes).
+No vendored Megatron here: one argparse tree with mode-specific groups, plus
+the JSON artifacts (model meta-config, profiled data, searched strategy) as
+the interchange format.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional, Sequence
+
+from galvatron_tpu.models.modeling import PRESETS
+
+
+def _add_model_args(p: argparse.ArgumentParser):
+    g = p.add_argument_group("model")
+    g.add_argument("--model_size", type=str, default="llama-0.3b", choices=sorted(PRESETS))
+    g.add_argument("--set_model_config_manually", type=int, default=0)
+    g.add_argument("--vocab_size", type=int, default=None)
+    g.add_argument("--hidden_size", type=int, default=None)
+    g.add_argument("--num_layers", type=int, default=None)
+    g.add_argument("--num_heads", type=int, default=None)
+    g.add_argument("--num_kv_heads", type=int, default=None)
+    g.add_argument("--ffn_dim", type=int, default=None)
+    g.add_argument("--seq_length", type=int, default=None)
+
+
+def _add_training_args(p: argparse.ArgumentParser):
+    """(reference: galvatron_training_args, core/arguments.py:44-137)"""
+    g = p.add_argument_group("training")
+    g.add_argument("--global_train_batch_size", type=int, default=8)
+    g.add_argument("--train_iters", type=int, default=10)
+    g.add_argument("--lr", type=float, default=1e-4)
+    g.add_argument("--weight_decay", type=float, default=0.01)
+    g.add_argument("--grad_clip", type=float, default=1.0)
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--mixed_precision", type=str, default="bf16", choices=["fp32", "bf16"])
+    g.add_argument("--check_loss", type=int, default=0)
+    g.add_argument("--profile", type=int, default=0, help="print per-iter time/memory")
+    # hybrid-parallel GLOBAL flags (used when no galvatron_config_path)
+    g.add_argument("--pp_deg", type=int, default=1)
+    g.add_argument("--global_tp_deg", type=int, default=1)
+    g.add_argument("--global_tp_consec", type=int, default=1)
+    g.add_argument("--sdp", type=int, default=0, help="1 = zero3 on all layers")
+    g.add_argument("--default_dp_type", type=str, default="ddp", choices=["ddp", "zero2", "zero3"])
+    g.add_argument("--global_checkpoint", type=int, default=0)
+    g.add_argument("--sequence_parallel", type=int, default=0)
+    g.add_argument("--context_parallel_deg", type=int, default=1)
+    g.add_argument("--chunks", type=int, default=-1, help="-1 = heuristic")
+    g.add_argument("--pipeline_type", type=str, default="gpipe", choices=["gpipe", "pipedream_flush"])
+    g.add_argument("--vocab_tp", type=int, default=1)
+    g.add_argument("--embed_sdp", type=int, default=0)
+    g.add_argument("--galvatron_config_path", type=str, default=None)
+    g.add_argument("--attn_impl", type=str, default="auto", choices=["auto", "flash", "xla"])
+    # checkpoint/resume (capability the reference only gestures at; SURVEY §5)
+    g.add_argument("--save", type=str, default=None, help="checkpoint directory")
+    g.add_argument("--load", type=str, default=None, help="resume directory")
+    g.add_argument("--save_interval", type=int, default=0)
+
+
+def _add_search_args(p: argparse.ArgumentParser):
+    """(reference: galvatron_search_args, core/arguments.py:226-313)"""
+    g = p.add_argument_group("search")
+    g.add_argument("--num_devices", type=int, default=8)
+    g.add_argument("--memory_constraint_gb", type=float, default=16.0)
+    g.add_argument("--min_bsz", type=int, default=8)
+    g.add_argument("--max_bsz", type=int, default=64)
+    g.add_argument("--bsz_scale", type=int, default=2)
+    g.add_argument("--settle_bsz", type=int, default=-1, help="search exactly this bsz")
+    g.add_argument("--max_chunks", type=int, default=64)
+    g.add_argument("--search_space", type=str, default="full",
+                   choices=["full", "dp+tp", "dp+pp", "3d", "dp", "tp", "pp", "sdp"])
+    g.add_argument("--disable_sdp", type=int, default=0)
+    g.add_argument("--disable_ckpt", type=int, default=0)
+    g.add_argument("--disable_sp", type=int, default=0)
+    g.add_argument("--disable_tp_consec", type=int, default=0)
+    g.add_argument("--enable_cp", type=int, default=0)
+    g.add_argument("--max_tp_deg", type=int, default=8)
+    g.add_argument("--time_profile_path", type=str, default=None)
+    g.add_argument("--memory_profile_path", type=str, default=None)
+    g.add_argument("--hardware_profile_path", type=str, default=None)
+    g.add_argument("--output_config_path", type=str, default=None)
+
+
+def _add_profile_args(p: argparse.ArgumentParser):
+    """(reference: galvatron_profile_args, core/arguments.py:139-184)"""
+    g = p.add_argument_group("profile")
+    g.add_argument("--profile_type", type=str, default="computation",
+                   choices=["computation", "memory"])
+    g.add_argument("--profile_batch_size", type=int, default=8)
+    g.add_argument("--layernum_min", type=int, default=2)
+    g.add_argument("--layernum_max", type=int, default=4)
+    g.add_argument("--output_prefix", type=str, default=None)
+
+
+def _add_hardware_args(p: argparse.ArgumentParser):
+    """(reference: galvatron_profile_hardware_args, core/arguments.py:186-223)"""
+    g = p.add_argument_group("profile-hardware")
+    g.add_argument("--profile_size_mb", type=float, default=64.0)
+    g.add_argument("--hardware_output_path", type=str, default="hardware_config.json")
+
+
+def build_parser(mode: str, model_default: Optional[str] = None) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(f"galvatron_tpu {mode}")
+    _add_model_args(p)
+    if model_default:
+        p.set_defaults(model_size=model_default)
+    if mode in ("train", "train_dist"):
+        _add_training_args(p)
+    elif mode == "search":
+        _add_search_args(p)
+    elif mode == "profile":
+        _add_profile_args(p)
+        _add_training_args(p)
+    elif mode == "profile_hardware":
+        _add_hardware_args(p)
+    else:
+        raise ValueError(f"unknown mode {mode}")
+    return p
+
+
+def initialize_galvatron(mode: str, args: Optional[Sequence[str]] = None,
+                         model_default: Optional[str] = None) -> argparse.Namespace:
+    """(reference: initialize_galvatron, core/arguments.py:5-27)"""
+    return build_parser(mode, model_default).parse_args(args)
+
+
+def model_config_from_args(ns: argparse.Namespace):
+    """Meta-config resolution (reference: config_from_meta/set_model_config,
+    models/*/meta_configs/config_utils.py:13-46)."""
+    import dataclasses
+
+    cfg = PRESETS[ns.model_size]
+    overrides = {}
+    for field, attr in [
+        ("vocab_size", "vocab_size"), ("hidden_size", "hidden_size"),
+        ("num_layers", "num_layers"), ("num_heads", "num_heads"),
+        ("num_kv_heads", "num_kv_heads"), ("ffn_dim", "ffn_dim"),
+        ("max_seq_len", "seq_length"),
+    ]:
+        v = getattr(ns, attr, None)
+        if v is not None:
+            overrides[field] = v
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg
+
+
+def hybrid_config_from_args(ns: argparse.Namespace, num_layers: int, world: int):
+    """GLOBAL-flags → uniform strategy, or JSON file → per-layer strategies
+    (reference: the two config modes of get_hybrid_parallel_configs_api,
+    core/hybrid_parallel_config.py:13-87)."""
+    from galvatron_tpu.core.strategy import HybridParallelConfig
+
+    if ns.galvatron_config_path:
+        hp = HybridParallelConfig.load(ns.galvatron_config_path)
+        if hp.num_layers != num_layers:
+            raise ValueError(
+                f"config has {hp.num_layers} layers, model has {num_layers}"
+            )
+    else:
+        dp_type = "zero3" if ns.sdp else ns.default_dp_type
+        chunks = ns.chunks if ns.chunks > 0 else default_chunks(
+            ns.global_train_batch_size, ns.pp_deg, world
+        )
+        hp = HybridParallelConfig.uniform(
+            num_layers,
+            pp=ns.pp_deg,
+            tp=ns.global_tp_deg,
+            tp_consec=bool(ns.global_tp_consec),
+            dp_type=dp_type,
+            ckpt=bool(ns.global_checkpoint),
+            sp=bool(ns.sequence_parallel),
+            cp=ns.context_parallel_deg,
+            chunks=chunks,
+            pipeline_type=ns.pipeline_type,
+            vocab_tp=ns.vocab_tp,
+            embed_dp_type="zero3" if ns.embed_sdp else "ddp",
+            mixed_precision=ns.mixed_precision,
+        )
+    return hp
+
+
+def default_chunks(global_bsz: int, pp: int, world: int) -> int:
+    """Micro-batch count heuristic (reference: get_chunks,
+    core/hybrid_parallel_config.py:220-230): enough chunks to keep the
+    pipeline filled, bounded by the local batch."""
+    if pp == 1:
+        return 1
+    local = max(1, global_bsz // (world // pp))
+    return min(local, 2 * pp)

@@ -1,0 +1,74 @@
+"""Sharded checkpoint save/restore with resume.
+
+The reference's trainer never saves (SURVEY §5: only an unused --load_params
+flag; the vendored Megatron checkpointing.py/dist_checkpointing are not
+integrated). Here sharded save/restore is first-class via Orbax: each leaf is
+written from its NamedSharding layout and restored into the (possibly
+different) target sharding, so a run searched onto a new strategy can resume
+from an old layout.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def save_checkpoint(ckpt_dir: str, state: Any, step: int) -> str:
+    """Writes state (params/opt/step pytree) under ckpt_dir/step_N."""
+    ocp = _ocp()
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, state, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = []
+    for name in os.listdir(ckpt_dir):
+        if name.startswith("step_"):
+            try:
+                steps.append(int(name.split("_")[1]))
+            except ValueError:
+                pass
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(ckpt_dir: str, abstract_state: Any, step: Optional[int] = None) -> Any:
+    """Restores into the shardings carried by ``abstract_state`` (a pytree of
+    jax.ShapeDtypeStruct with .sharding — e.g. from eval_shape + the runtime's
+    state_shardings). Cross-strategy resume falls out: Orbax reshards on
+    load."""
+    ocp = _ocp()
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(path, abstract_state)
+
+
+def abstract_state_of(runtime, init_key=None) -> Any:
+    """Abstract (shape+sharding) pytree for the runtime's train state."""
+    import jax.numpy as jnp
+
+    key = init_key if init_key is not None else jax.random.key(0)
+    shapes = jax.eval_shape(runtime.init_state, key)
+    return jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes,
+        runtime.state_shardings,
+    )

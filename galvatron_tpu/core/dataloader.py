@@ -1,0 +1,48 @@
+"""Synthetic LM dataloader with data-parallel sharding.
+
+The reference trains on synthetic random-token datasets per model family
+(reference: models/llama_hf/dataloader.py:5-30 — random vocab tokens;
+utils/training_utils.py:14-23 — DistributedSampler split over the dp group).
+Here the dataloader yields global (B, S+1) int32 batches; sharding over the
+mesh's data axes is applied by the runtime's batch sharding, so the loader
+itself stays host-side and device-layout-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+class RandomTokenDataset:
+    def __init__(self, vocab_size: int, seq_len: int, size: int = 1024, seed: int = 1234):
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+        self.size = size
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.size
+
+    def batch_iterator(
+        self, global_batch_size: int, epochs: Optional[int] = None
+    ) -> Iterator[np.ndarray]:
+        """Yields (B, S+1) int32 token batches (inputs ‖ next-token labels)."""
+        epoch = 0
+        while epochs is None or epoch < epochs:
+            rng = np.random.RandomState(self.seed + epoch)
+            order = rng.permutation(self.size)
+            for i in range(0, self.size - global_batch_size + 1, global_batch_size):
+                idx = order[i : i + global_batch_size]
+                batch_rng = np.random.RandomState(self.seed * 1000003 + int(idx[0]))
+                yield batch_rng.randint(
+                    0, self.vocab_size, (global_batch_size, self.seq_len + 1), np.int32
+                )
+            epoch += 1
+
+
+def build_dataloader(cfg, global_batch_size: int, seq_len: Optional[int] = None,
+                     size: int = 1024, seed: int = 1234):
+    ds = RandomTokenDataset(cfg.vocab_size, seq_len or cfg.max_seq_len, size, seed)
+    return ds.batch_iterator(global_batch_size)

@@ -1,0 +1,79 @@
+"""Training loop driver shared by all model-family entries.
+
+The train_dist.py body of the reference (reference:
+models/llama_hf/train_dist.py:16-90): resolve model config → hybrid strategy
+→ construct hybrid model → dataloader → Adam → iterate forward_backward with
+profiler hooks. Plus what the reference lacks: checkpoint save/resume.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from galvatron_tpu.core.arguments import hybrid_config_from_args, model_config_from_args
+from galvatron_tpu.core.checkpoint import (
+    abstract_state_of,
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from galvatron_tpu.core.dataloader import build_dataloader
+from galvatron_tpu.core.optim import AdamConfig
+from galvatron_tpu.parallel.hybrid import build_runtime
+from galvatron_tpu.profiling.runtime import RuntimeProfiler
+
+
+def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
+    cfg = model_config_from_args(ns)
+    if ns.attn_impl != "auto":
+        cfg = cfg.replace(attn_impl=ns.attn_impl)
+    elif jax.default_backend() != "cpu":
+        cfg = cfg.replace(attn_impl="flash")
+    world = len(jax.devices())
+    hp = hybrid_config_from_args(ns, cfg.num_layers, world)
+    adam = AdamConfig(lr=ns.lr, weight_decay=ns.weight_decay, grad_clip=ns.grad_clip)
+    seq = cfg.max_seq_len
+    rt = build_runtime(
+        cfg, hp, adam=adam, global_batch_size=ns.global_train_batch_size, seq_len=seq
+    )
+
+    start_step = 0
+    if ns.load and latest_step(ns.load) is not None:
+        state = restore_checkpoint(ns.load, abstract_state_of(rt))
+        start_step = int(np.asarray(state["step"]))
+        if verbose:
+            print(f"resumed from {ns.load} at step {start_step}")
+    else:
+        state = rt.init_state(jax.random.key(ns.seed))
+
+    loader = build_dataloader(cfg, ns.global_train_batch_size, seq, seed=ns.seed)
+    prof = RuntimeProfiler(warmup_iters=1)
+    losses = []
+    for it in range(start_step, ns.train_iters):
+        batch = jnp.asarray(next(loader))
+        prof.begin_iter()
+        state, loss = rt.train_step(state, batch)
+        prof.end_iter(loss if (ns.profile or ns.check_loss) else None)
+        if ns.check_loss or ns.profile:
+            losses.append(float(loss))
+            if verbose:
+                print(f"iter {it}: loss {float(loss):.4f}")
+        if ns.save and ns.save_interval and (it + 1) % ns.save_interval == 0:
+            save_checkpoint(ns.save, state, it + 1)
+            if verbose:
+                print(f"saved step {it + 1} → {ns.save}")
+    if ns.save:
+        save_checkpoint(ns.save, state, ns.train_iters)
+    report = prof.report(ns.global_train_batch_size, seq) if prof.iter_times_ms else ""
+    if verbose and report:
+        print(report)
+    return {
+        "losses": losses,
+        "iter_ms": prof.avg_iter_ms if prof.iter_times_ms else None,
+        "state": state,
+    }

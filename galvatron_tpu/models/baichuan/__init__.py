@@ -1,0 +1,12 @@
+"""Baichuan family entry (reference: galvatron/models/baichuan/ — flash-attn
+GPT with HF configs; the 13B variant uses ALiBi positions, see
+PRESETS['baichuan-13b'])."""
+
+DEFAULT_MODEL = "baichuan-7b"
+SIZES = ("baichuan-7b", "baichuan-13b")
+
+
+def main(argv=None):
+    from galvatron_tpu.cli import main as cli_main
+
+    return cli_main(argv, model_default=DEFAULT_MODEL)

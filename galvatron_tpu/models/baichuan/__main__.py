@@ -1,0 +1,3 @@
+from galvatron_tpu.models.baichuan import main
+
+raise SystemExit(main())

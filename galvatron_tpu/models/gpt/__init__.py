@@ -1,0 +1,11 @@
+"""GPT-2 family entry (reference: galvatron/models/gpt_hf/ and gpt_fa/).
+Sizes: gpt-0.3b/1.5b/2.7b/6.7b (reference arguments.py:6)."""
+
+DEFAULT_MODEL = "gpt-1.5b"
+SIZES = ("gpt-0.3b", "gpt-1.5b", "gpt-2.7b", "gpt-6.7b")
+
+
+def main(argv=None):
+    from galvatron_tpu.cli import main as cli_main
+
+    return cli_main(argv, model_default=DEFAULT_MODEL)

@@ -1,0 +1,3 @@
+from galvatron_tpu.models.gpt import main
+
+raise SystemExit(main())

@@ -1,0 +1,13 @@
+"""LLaMA family entry (reference: galvatron/models/llama_hf/ and llama_fa/ —
+the flash-attention variant is the same family here with attn_impl='flash',
+which is the default on TPU). Sizes: llama-0.3b/7b/13b/30b
+(reference arguments.py:6)."""
+
+DEFAULT_MODEL = "llama-7b"
+SIZES = ("llama-0.3b", "llama-7b", "llama-13b", "llama-30b")
+
+
+def main(argv=None):
+    from galvatron_tpu.cli import main as cli_main
+
+    return cli_main(argv, model_default=DEFAULT_MODEL)

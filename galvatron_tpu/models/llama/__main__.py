@@ -1,0 +1,3 @@
+from galvatron_tpu.models.llama import main
+
+raise SystemExit(main())

@@ -1,0 +1,135 @@
+"""CLI / trainer / checkpoint tests: the reference's L7 entry surface
+(train_dist / search_dist / profiler scripts) driven end-to-end on the CPU
+sim, plus save/resume — the capability the reference lacks."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from galvatron_tpu.cli import main as cli_main
+
+TINY = [
+    "--model_size", "llama-0.3b",
+    "--hidden_size", "64", "--num_layers", "4", "--num_heads", "4",
+    "--ffn_dim", "128", "--vocab_size", "128", "--seq_length", "32",
+]
+
+
+def test_train_mode_global_flags(capsys):
+    rc = cli_main(
+        ["train", *TINY, "--global_train_batch_size", "8", "--train_iters", "3",
+         "--global_tp_deg", "2", "--sdp", "1", "--mixed_precision", "fp32",
+         "--check_loss", "1"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "iter 2: loss" in out
+
+
+def test_train_mode_pipeline(capsys):
+    rc = cli_main(
+        ["train", *TINY, "--global_train_batch_size", "8", "--train_iters", "2",
+         "--pp_deg", "2", "--chunks", "2", "--pipeline_type", "pipedream_flush",
+         "--mixed_precision", "fp32", "--check_loss", "1"]
+    )
+    assert rc == 0
+    assert "iter 1: loss" in capsys.readouterr().out
+
+
+def test_search_then_train_closure(tmp_path, capsys):
+    """search emits a config; train consumes it (reference loop:
+    search_dist.py → configs/galvatron_config_*.json → train_dist.py)."""
+    cfg_path = str(tmp_path / "cfg.json")
+    rc = cli_main(
+        ["search", *TINY, "--num_devices", "8", "--memory_constraint_gb", "1",
+         "--settle_bsz", "8", "--output_config_path", cfg_path]
+    )
+    assert rc == 0
+    assert os.path.exists(cfg_path)
+    d = json.load(open(cfg_path))
+    assert "search_throughput_samples_per_s" in d
+    rc = cli_main(
+        ["train", *TINY, "--global_train_batch_size", "8", "--train_iters", "2",
+         "--galvatron_config_path", cfg_path, "--mixed_precision", "fp32",
+         "--check_loss", "1"]
+    )
+    assert rc == 0
+
+
+def test_profile_mode(tmp_path):
+    prefix = str(tmp_path / "prof")
+    rc = cli_main(["profile", *TINY, "--profile_batch_size", "4",
+                   "--output_prefix", prefix])
+    assert rc == 0
+    assert os.path.exists(f"{prefix}_computation.json")
+    assert os.path.exists(f"{prefix}_memory.json")
+
+
+def test_profile_hardware_mode(tmp_path):
+    out = str(tmp_path / "hw.json")
+    rc = cli_main(["profile-hardware", "--profile_size_mb", "1",
+                   "--hardware_output_path", out])
+    assert rc == 0
+    d = json.load(open(out))
+    assert "allreduce" in d and "p2p" in d
+
+
+def test_checkpoint_save_resume(tmp_path, capsys):
+    ckpt = str(tmp_path / "ckpt")
+    rc = cli_main(
+        ["train", *TINY, "--global_train_batch_size", "8", "--train_iters", "2",
+         "--mixed_precision", "fp32", "--save", ckpt, "--check_loss", "1"]
+    )
+    assert rc == 0
+    first = capsys.readouterr().out
+    # resume continues from step 2 of 4 — only iters 2,3 run
+    rc = cli_main(
+        ["train", *TINY, "--global_train_batch_size", "8", "--train_iters", "4",
+         "--mixed_precision", "fp32", "--load", ckpt, "--check_loss", "1"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "resumed" in out and "iter 2: loss" in out and "iter 0" not in out
+
+
+def test_checkpoint_cross_strategy_resume(tmp_path):
+    """Save under tp=2/zero3, restore into tp=1/ddp — Orbax reshards."""
+    from galvatron_tpu.core.arguments import initialize_galvatron
+    from galvatron_tpu.core.trainer import train
+
+    ckpt = str(tmp_path / "ck2")
+    ns = initialize_galvatron(
+        "train",
+        [*TINY, "--global_train_batch_size", "8", "--train_iters", "2",
+         "--global_tp_deg", "2", "--sdp", "1", "--mixed_precision", "fp32",
+         "--save", ckpt],
+    )
+    r1 = train(ns, verbose=False)
+    ns2 = initialize_galvatron(
+        "train",
+        [*TINY, "--global_train_batch_size", "8", "--train_iters", "3",
+         "--mixed_precision", "fp32", "--load", ckpt, "--check_loss", "1"],
+    )
+    r2 = train(ns2, verbose=False)
+    assert len(r2["losses"]) == 1  # resumed at step 2, ran iter 2 only
+    # params restored: compare one leaf across layouts
+    a = np.asarray(r1["state"]["params"]["final_norm"]["scale"])
+    assert np.isfinite(a).all()
+
+
+def test_model_family_entries(capsys):
+    from galvatron_tpu.models import baichuan, gpt, llama
+
+    for fam, size in [(llama, "llama-0.3b"), (gpt, "gpt-0.3b"), (baichuan, "baichuan-7b")]:
+        rc = fam.main(
+            ["train", "--model_size", size,
+             "--hidden_size", "64", "--num_layers", "2", "--num_heads", "4",
+             "--ffn_dim", "128", "--vocab_size", "128", "--seq_length", "32",
+             "--global_train_batch_size", "8", "--train_iters", "1",
+             "--mixed_precision", "fp32", "--check_loss", "1"]
+        )
+        assert rc == 0
+        assert "iter 0: loss" in capsys.readouterr().out
