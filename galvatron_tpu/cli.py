@@ -45,6 +45,12 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             load_profiled_model,
         )
 
+        if bool(ns.time_profile_path) != bool(ns.memory_profile_path):
+            print(
+                "error: --time_profile_path and --memory_profile_path must be "
+                "given together (got only one; refusing to silently re-profile)"
+            )
+            return 2
         if ns.time_profile_path and ns.memory_profile_path:
             costs = load_profiled_model(ns.time_profile_path, ns.memory_profile_path)
         else:
@@ -77,6 +83,10 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             sspace.max_tp = 1
         elif ns.search_space == "sdp":
             sspace.max_tp, sspace.pp_choices = 1, [1]
+        elif ns.search_space == "3d":
+            # pure pp x tp x dp grid: no ZeRO/ckpt/layout/SP variants
+            sspace.allow_zero2 = sspace.allow_zero3 = False
+            sspace.allow_ckpt = sspace.allow_sp = sspace.allow_strided = False
         eng = SearchEngine(
             costs, hw, num_layers=cfg.num_layers, space=sspace,
             memory_budget_mb=ns.memory_constraint_gb * 1024.0,
@@ -85,6 +95,9 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         if ns.settle_bsz > 0:
             bszs = [ns.settle_bsz]
         else:
+            if ns.bsz_scale < 2:
+                print(f"error: --bsz_scale must be >= 2, got {ns.bsz_scale}")
+                return 2
             bszs, b = [], ns.min_bsz
             while b <= ns.max_bsz:
                 bszs.append(b)
@@ -104,11 +117,17 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         from galvatron_tpu.profiling.model import profile_model
 
         prefix = ns.output_prefix or f"profile_{ns.model_size}"
-        profile_model(
+        costs = profile_model(
             cfg, bsz=ns.profile_batch_size,
-            layernums=(ns.layernum_min, ns.layernum_max), out_prefix=prefix,
+            layernums=(ns.layernum_min, ns.layernum_max),
+            measure_time=ns.profile_type in ("computation", "both"),
         )
-        print(f"saved → {prefix}_computation.json, {prefix}_memory.json")
+        from galvatron_tpu.utils.config_utils import save_profiled_model
+
+        comp = f"{prefix}_computation.json" if ns.profile_type in ("computation", "both") else None
+        mem = f"{prefix}_memory.json" if ns.profile_type in ("memory", "both") else None
+        save_profiled_model(costs, comp, mem)
+        print(f"saved → {', '.join(p for p in (comp, mem) if p)}")
         return 0
 
     if mode == "profile-hardware":

@@ -19,7 +19,11 @@ from galvatron_tpu.models.modeling import PRESETS
 def _add_model_args(p: argparse.ArgumentParser):
     g = p.add_argument_group("model")
     g.add_argument("--model_size", type=str, default="llama-0.3b", choices=sorted(PRESETS))
-    g.add_argument("--set_model_config_manually", type=int, default=0)
+    g.add_argument(
+        "--set_model_config_manually", type=int, default=0,
+        help="1 = require the full manual model config (vocab/hidden/layers/heads); "
+        "0 = preset sizes, with any explicitly-passed flags overriding",
+    )
     g.add_argument("--vocab_size", type=int, default=None)
     g.add_argument("--hidden_size", type=int, default=None)
     g.add_argument("--num_layers", type=int, default=None)
@@ -89,8 +93,8 @@ def _add_search_args(p: argparse.ArgumentParser):
 def _add_profile_args(p: argparse.ArgumentParser):
     """(reference: galvatron_profile_args, core/arguments.py:139-184)"""
     g = p.add_argument_group("profile")
-    g.add_argument("--profile_type", type=str, default="computation",
-                   choices=["computation", "memory"])
+    g.add_argument("--profile_type", type=str, default="both",
+                   choices=["computation", "memory", "both"])
     g.add_argument("--profile_batch_size", type=int, default=8)
     g.add_argument("--layernum_min", type=int, default=2)
     g.add_argument("--layernum_max", type=int, default=4)
@@ -145,6 +149,13 @@ def model_config_from_args(ns: argparse.Namespace):
         v = getattr(ns, attr, None)
         if v is not None:
             overrides[field] = v
+    if getattr(ns, "set_model_config_manually", 0):
+        required = ("vocab_size", "hidden_size", "num_layers", "num_heads")
+        missing = [f for f in required if f not in overrides]
+        if missing:
+            raise ValueError(
+                f"--set_model_config_manually 1 requires {missing} to be passed"
+            )
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     return cfg
@@ -191,5 +202,7 @@ def default_chunks(global_bsz: int, pp: int, world: int) -> int:
     pipeline filled, bounded by the local batch."""
     if pp == 1:
         return 1
+    if pp > world or world % pp != 0:
+        raise ValueError(f"pp={pp} must divide the device count {world}")
     local = max(1, global_bsz // (world // pp))
     return min(local, 2 * pp)

@@ -49,6 +49,9 @@ class LayerStrategy:
         tensor_parallel/mappings_group.py:192-293).
       cp: context-parallel (ring attention) degree over the minor data axes;
         1 disables. A TPU-native capability the reference lacks (SURVEY §5).
+      ep: expert-parallel degree for MoE layers — experts sharded over the
+        minor data-parallel axes (reference EP groups: site_package/megatron/
+        core/parallel_state.py:450-478; SwitchMLP transformer.py:161-295).
     """
 
     tp: int = 1
@@ -57,12 +60,17 @@ class LayerStrategy:
     ckpt: bool = False
     sp: bool = False
     cp: int = 1
+    ep: int = 1
 
     def __post_init__(self):
         if not _is_pow2(self.tp):
             raise ValueError(f"tp degree must be a power of two, got {self.tp}")
         if not _is_pow2(self.cp):
             raise ValueError(f"cp degree must be a power of two, got {self.cp}")
+        if not _is_pow2(self.ep):
+            raise ValueError(f"ep degree must be a power of two, got {self.ep}")
+        if self.cp > 1 and self.ep > 1:
+            raise ValueError("cp and ep both >1 is unsupported (they share mesh axes)")
         if self.dp_type not in DP_TYPES:
             raise ValueError(f"dp_type must be one of {DP_TYPES}, got {self.dp_type}")
 
@@ -115,6 +123,11 @@ class HybridParallelConfig:
                 raise ValueError(
                     f"layer {i}: tp*cp={s.tp * s.cp} exceeds per-stage devices {per_stage}"
                 )
+            if s.ep > per_stage // (s.tp * s.cp):
+                raise ValueError(
+                    f"layer {i}: ep={s.ep} exceeds data-parallel extent "
+                    f"{per_stage // (s.tp * s.cp)}"
+                )
         if self.vocab_tp > per_stage:
             raise ValueError(f"vocab_tp={self.vocab_tp} exceeds per-stage devices")
         if self.pp_division is not None:
@@ -141,6 +154,7 @@ class HybridParallelConfig:
             "checkpoint": ",".join(str(int(s.ckpt)) for s in ls),
             "sp_flags": ",".join(str(int(s.sp)) for s in ls),
             "cp_sizes_enc": ",".join(str(s.cp) for s in ls),
+            "ep_sizes_enc": ",".join(str(s.ep) for s in ls),
             "pp_division": ",".join(str(n) for n in (self.pp_division or [])),
             "chunks": self.chunks,
             "pipeline_type": self.pipeline_type,
@@ -171,6 +185,7 @@ class HybridParallelConfig:
         ckpt = ints("checkpoint") or [0] * n
         sp = ints("sp_flags") or [0] * n
         cp = ints("cp_sizes_enc") or [1] * n
+        ep = ints("ep_sizes_enc") or [1] * n
         strategies = [
             LayerStrategy(
                 tp=tps[i],
@@ -179,6 +194,7 @@ class HybridParallelConfig:
                 ckpt=bool(ckpt[i]),
                 sp=bool(sp[i]),
                 cp=cp[i],
+                ep=ep[i],
             )
             for i in range(n)
         ]
@@ -214,10 +230,13 @@ class HybridParallelConfig:
         ckpt: bool = False,
         sp: bool = False,
         cp: int = 1,
+        ep: int = 1,
         tp_consec: bool = True,
         **kw,
     ) -> "HybridParallelConfig":
-        s = LayerStrategy(tp=tp, tp_consec=tp_consec, dp_type=dp_type, ckpt=ckpt, sp=sp, cp=cp)
+        s = LayerStrategy(
+            tp=tp, tp_consec=tp_consec, dp_type=dp_type, ckpt=ckpt, sp=sp, cp=cp, ep=ep
+        )
         return cls(pp=pp, layer_strategies=[s] * num_layers, vocab_tp=kw.pop("vocab_tp", tp), **kw)
 
 

@@ -52,6 +52,8 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
         state = rt.init_state(jax.random.key(ns.seed))
 
     loader = build_dataloader(cfg, ns.global_train_batch_size, seq, seed=ns.seed)
+    for _ in range(start_step):  # fast-forward so resume sees the batches an
+        next(loader)  # uninterrupted run would (reference has no resume at all)
     prof = RuntimeProfiler(warmup_iters=1)
     losses = []
     for it in range(start_step, ns.train_iters):
@@ -68,7 +70,9 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
             if verbose:
                 print(f"saved step {it + 1} → {ns.save}")
     if ns.save:
-        save_checkpoint(ns.save, state, ns.train_iters)
+        final_step = int(np.asarray(state["step"]))
+        if latest_step(ns.save) != final_step:
+            save_checkpoint(ns.save, state, final_step)
     report = prof.report(ns.global_train_batch_size, seq) if prof.iter_times_ms else ""
     if verbose and report:
         print(report)

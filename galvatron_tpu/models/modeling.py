@@ -52,6 +52,11 @@ class ModelConfig:
     attn_impl: str = "xla"  # 'xla' | 'flash' | 'ring'
     dtype: Any = jnp.bfloat16  # compute dtype
     param_dtype: Any = jnp.float32
+    # Mixture-of-Experts (SwitchMLP equivalent, reference:
+    # galvatron/core/tensor_parallel/transformer.py:161-295). 0 → dense MLP.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_sinkhorn_iters: int = 8
 
     @property
     def kv_heads(self) -> int:
@@ -100,7 +105,11 @@ def init_layer_params(key, cfg: ModelConfig) -> Params:
         },
         "mlp_norm": {"scale": jnp.ones((h,), cfg.param_dtype)},
     }
-    if cfg.act_fn == "swiglu":
+    if cfg.moe_experts > 0:
+        from galvatron_tpu.models import moe
+
+        p["mlp"] = moe.init_moe_params(ks[4], cfg)
+    elif cfg.act_fn == "swiglu":
         p["mlp"] = {
             "w1": _dense_init(ks[4], h, cfg.ffn, cfg.param_dtype),
             "w3": _dense_init(ks[5], h, cfg.ffn, cfg.param_dtype),
@@ -131,7 +140,11 @@ def layer_annotations(cfg: ModelConfig) -> Params:
         },
         "mlp_norm": {"scale": ("fsdp",)},
     }
-    if cfg.act_fn == "swiglu":
+    if cfg.moe_experts > 0:
+        from galvatron_tpu.models import moe
+
+        a["mlp"] = moe.moe_annotations(cfg)
+    elif cfg.act_fn == "swiglu":
         a["mlp"] = {"w1": ("fsdp", "tp"), "w3": ("fsdp", "tp"), "w2": ("tp", "fsdp")}
     else:
         a["mlp"] = {"w1": ("fsdp", "tp"), "w2": ("tp", "fsdp")}
@@ -289,7 +302,12 @@ def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None):
 
 def mlp_block(x, p, cfg: ModelConfig):
     """SwiGLU or GeLU MLP (reference: ParallelMLP, galvatron/core/
-    tensor_parallel/transformer.py:78-159)."""
+    tensor_parallel/transformer.py:78-159); switch-MoE when moe_experts > 0
+    (SwitchMLP, transformer.py:161-295)."""
+    if cfg.moe_experts > 0:
+        from galvatron_tpu.models import moe
+
+        return moe.moe_block(x, p, cfg)
     if cfg.act_fn == "swiglu":
         return (
             jax.nn.silu(x @ p["w1"].astype(x.dtype)) * (x @ p["w3"].astype(x.dtype))

@@ -79,6 +79,12 @@ class MeshAxes:
             raise ValueError(f"cp={cp} exceeds remaining mesh extent")
         return tuple(rest[-k:])
 
+    def ep_axes(self, tp: int, consec: bool = True, ep: int = 1) -> Tuple[str, ...]:
+        """Expert-parallel axes for MoE layers: same minor-axes-of-the-non-TP-
+        block selection as cp (EP subdivides data parallelism, reference:
+        parallel_state.py:450-478); a strategy never uses both (strategy.py)."""
+        return self.cp_axes(tp, consec, ep)
+
 
 def build_mesh(
     pp: int = 1,

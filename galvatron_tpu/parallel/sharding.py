@@ -52,13 +52,20 @@ def param_spec(
     if len(shape) != len(annot):
         raise ValueError(f"shape {shape} vs annotation {annot} rank mismatch")
     tp_ax = axes.tp_axes(s.tp, s.tp_consec)
+    ep_ax = axes.ep_axes(s.tp, s.tp_consec, s.ep) if "ep" in annot else ()
     zero = s.dp_type == "zero3" or (for_opt_state and s.dp_type == "zero2")
     dp_ax = axes.dp_axes(s.tp, s.tp_consec, s.cp) if zero else ()
+    # expert params are distinct per EP group: ZeRO shards them only over the
+    # data axes *within* an EP group (reference: expert weights live on their
+    # EP rank, parallel_state.py:611-621)
+    dp_ax = tuple(a for a in dp_ax if a not in set(ep_ax))
     entries: list = []
     fsdp_used = False
     for dim, tag in zip(shape, annot):
         if tag == "tp" and tp_ax and dim % (2 ** len(tp_ax)) == 0:
             entries.append(tp_ax)
+        elif tag == "ep" and ep_ax and dim % (2 ** len(ep_ax)) == 0:
+            entries.append(ep_ax)
         elif tag == "fsdp" and dp_ax and not fsdp_used and dim % (2 ** len(dp_ax)) == 0:
             entries.append(dp_ax)
             fsdp_used = True

@@ -41,22 +41,27 @@ def write_json_config(obj: Dict[str, Any], path: str) -> None:
         json.dump(obj, f, indent=2)
 
 
-def save_profiled_model(costs: ProfiledModelCosts, time_path: str, mem_path: str) -> None:
-    times = {f"layertype_{i}": lt.fwd_ms_per_sample for i, lt in costs.layer_types.items()}
-    write_json_config(times, time_path)
-    mem: Dict[str, Any] = {}
-    for i, lt in costs.layer_types.items():
-        mem[f"layertype_{i}"] = {
-            "parameter_mb": lt.parameter_mb,
-            "activation_mb_per_sample": {str(k): v for k, v in lt.activation_mb_per_sample.items()},
-            "boundary_activation_mb_per_sample": lt.boundary_activation_mb_per_sample,
+def save_profiled_model(costs: ProfiledModelCosts, time_path=None, mem_path=None) -> None:
+    """Write either or both profiled-model JSONs (None skips that file)."""
+    if time_path:
+        times = {f"layertype_{i}": lt.fwd_ms_per_sample for i, lt in costs.layer_types.items()}
+        write_json_config(times, time_path)
+    if mem_path:
+        mem: Dict[str, Any] = {}
+        for i, lt in costs.layer_types.items():
+            mem[f"layertype_{i}"] = {
+                "parameter_mb": lt.parameter_mb,
+                "activation_mb_per_sample": {
+                    str(k): v for k, v in lt.activation_mb_per_sample.items()
+                },
+                "boundary_activation_mb_per_sample": lt.boundary_activation_mb_per_sample,
+            }
+        mem["other"] = {
+            "param_mb": costs.other_param_mb,
+            "act_mb_per_sample": costs.other_act_mb_per_sample,
+            "fwd_ms_per_sample": costs.other_fwd_ms_per_sample,
         }
-    mem["other"] = {
-        "param_mb": costs.other_param_mb,
-        "act_mb_per_sample": costs.other_act_mb_per_sample,
-        "fwd_ms_per_sample": costs.other_fwd_ms_per_sample,
-    }
-    write_json_config(mem, mem_path)
+        write_json_config(mem, mem_path)
 
 
 def load_profiled_model(time_path: str, mem_path: str) -> ProfiledModelCosts:
