@@ -7,6 +7,8 @@ models/<name>/{train_dist,search_dist,profiler}.py + profile_hardware):
   search            parallelism optimization → galvatron_config JSON
   profile           model computation/memory profiling → JSON
   profile-hardware  ICI bandwidth + overlap sweep → JSON
+  generate          KV-cache text generation from a checkpoint (or random init)
+  serve             REST generation server (text_generation_server equivalent)
 
 The per-model modules (galvatron_tpu.models.<family>) re-export these with
 family defaults, mirroring the reference's directory-per-model layout.
@@ -14,6 +16,8 @@ family defaults, mirroring the reference's directory-per-model layout.
 
 from __future__ import annotations
 
+import json
+import os
 import sys
 from typing import List, Optional
 
@@ -141,8 +145,84 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         print(f"saved → {ns.hardware_output_path}")
         return 0
 
-    print(f"unknown mode {mode!r}; expected train|search|profile|profile-hardware")
+    if mode in ("generate", "serve"):
+        import jax
+
+        from galvatron_tpu.models.tokenizer import build_tokenizer
+
+        ns = initialize_galvatron(mode, rest, model_default)
+        cfg = model_config_from_args(ns)
+        tok = build_tokenizer(ns.tokenizer)
+        if tok.vocab_size > cfg.vocab_size:
+            cfg = cfg.replace(vocab_size=tok.vocab_size)
+        params = _load_or_init_params(ns, cfg)
+        if mode == "generate":
+            from galvatron_tpu.models import generation
+
+            prompts = ns.prompt or ["Hello"]
+            outs = generation.generate_np(
+                params, cfg, [tok.encode(p) for p in prompts],
+                max_new_tokens=ns.max_new_tokens, temperature=ns.temperature,
+                top_k=ns.top_k, top_p=ns.top_p,
+                eos_id=tok.eos_id if tok.eos_id is not None else -1,
+                pad_id=tok.pad_id if tok.pad_id is not None else 0,
+                key=jax.random.key(ns.seed),
+            )
+            for p, o in zip(prompts, outs):
+                print(json.dumps({"prompt": p, "completion": tok.decode(o[len(tok.encode(p)):])}))
+            return 0
+        from galvatron_tpu.server import GenerationService, run_server
+
+        run_server(
+            GenerationService(params, cfg, tok, ns.max_new_tokens, ns.seed),
+            port=ns.port, host=ns.host,
+        )
+        return 0
+
+    print(f"unknown mode {mode!r}; expected train|search|profile|profile-hardware|generate|serve")
     return 2
+
+
+def _load_or_init_params(ns, cfg):
+    """Params from a trainer checkpoint (--load) or fresh random init."""
+    import jax
+
+    from galvatron_tpu.models import modeling
+
+    if getattr(ns, "load", None):
+        import orbax.checkpoint as ocp
+
+        from galvatron_tpu.core.checkpoint import latest_step
+
+        load_dir = os.path.abspath(ns.load)
+        step = latest_step(load_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {load_dir}")
+        raw = ocp.StandardCheckpointer().restore(os.path.join(load_dir, f"step_{step}"))
+        params = raw["params"] if isinstance(raw, dict) and "params" in raw else raw
+        # validate against the model config before silently generating garbage
+        abstract = jax.eval_shape(lambda k: modeling.init_model_params(k, cfg), jax.random.key(0))
+        got, want = _shape_map(params), _shape_map(abstract)
+        if got != want:
+            diff = {k: (got.get(k), want.get(k)) for k in sorted(set(got) | set(want))
+                    if got.get(k) != want.get(k)}
+            raise ValueError(
+                f"checkpoint under {ns.load} does not match the model config "
+                f"(e.g. --vocab_size/--tokenizer mismatch); got vs want: {diff}"
+            )
+        return params
+    return modeling.init_model_params(jax.random.key(0), cfg)
+
+
+def _shape_map(tree):
+    """path → shape, with list indices and '0'-style dict keys normalized."""
+    import jax
+
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        keys = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+        out["/".join(keys)] = tuple(getattr(leaf, "shape", ()))
+    return out
 
 
 if __name__ == "__main__":

@@ -101,6 +101,22 @@ def _add_profile_args(p: argparse.ArgumentParser):
     g.add_argument("--output_prefix", type=str, default=None)
 
 
+def _add_generate_args(p: argparse.ArgumentParser):
+    """(reference: megatron text-generation flags + text_generation_server.py)"""
+    g = p.add_argument_group("generate")
+    g.add_argument("--load", type=str, default=None, help="checkpoint directory (trainer state)")
+    g.add_argument("--tokenizer", type=str, default="byte",
+                   help="'byte' or a local transformers tokenizer path")
+    g.add_argument("--prompt", type=str, action="append", default=None)
+    g.add_argument("--max_new_tokens", type=int, default=64)
+    g.add_argument("--temperature", type=float, default=0.0)
+    g.add_argument("--top_k", type=int, default=0)
+    g.add_argument("--top_p", type=float, default=0.0)
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--port", type=int, default=5000)
+    g.add_argument("--host", type=str, default="127.0.0.1")
+
+
 def _add_hardware_args(p: argparse.ArgumentParser):
     """(reference: galvatron_profile_hardware_args, core/arguments.py:186-223)"""
     g = p.add_argument_group("profile-hardware")
@@ -122,6 +138,8 @@ def build_parser(mode: str, model_default: Optional[str] = None) -> argparse.Arg
         _add_training_args(p)
     elif mode == "profile_hardware":
         _add_hardware_args(p)
+    elif mode in ("generate", "serve"):
+        _add_generate_args(p)
     else:
         raise ValueError(f"unknown mode {mode}")
     return p

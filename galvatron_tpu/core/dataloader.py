@@ -25,15 +25,30 @@ class RandomTokenDataset:
     def __len__(self) -> int:
         return self.size
 
+    def batches_per_epoch(self, global_batch_size: int) -> int:
+        return max(0, (self.size - global_batch_size) // global_batch_size + 1)
+
     def batch_iterator(
-        self, global_batch_size: int, epochs: Optional[int] = None
+        self, global_batch_size: int, epochs: Optional[int] = None, start_batch: int = 0
     ) -> Iterator[np.ndarray]:
-        """Yields (B, S+1) int32 token batches (inputs ‖ next-token labels)."""
-        epoch = 0
+        """Yields (B, S+1) int32 token batches (inputs ‖ next-token labels).
+
+        ``start_batch`` resumes mid-stream without materializing the skipped
+        batches: batch contents depend only on (seed, epoch, position), so the
+        offset is pure index arithmetic."""
+        per_epoch = self.batches_per_epoch(global_batch_size)
+        if per_epoch == 0:
+            raise ValueError(
+                f"global_batch_size {global_batch_size} exceeds dataset size "
+                f"{self.size}; no full batch can be formed"
+            )
+        epoch, skip = divmod(start_batch, per_epoch)
         while epochs is None or epoch < epochs:
             rng = np.random.RandomState(self.seed + epoch)
             order = rng.permutation(self.size)
-            for i in range(0, self.size - global_batch_size + 1, global_batch_size):
+            start_i = skip * global_batch_size
+            skip = 0
+            for i in range(start_i, self.size - global_batch_size + 1, global_batch_size):
                 idx = order[i : i + global_batch_size]
                 batch_rng = np.random.RandomState(self.seed * 1000003 + int(idx[0]))
                 yield batch_rng.randint(
@@ -43,6 +58,6 @@ class RandomTokenDataset:
 
 
 def build_dataloader(cfg, global_batch_size: int, seq_len: Optional[int] = None,
-                     size: int = 1024, seed: int = 1234):
+                     size: int = 1024, seed: int = 1234, start_batch: int = 0):
     ds = RandomTokenDataset(cfg.vocab_size, seq_len or cfg.max_seq_len, size, seed)
-    return ds.batch_iterator(global_batch_size)
+    return ds.batch_iterator(global_batch_size, start_batch=start_batch)

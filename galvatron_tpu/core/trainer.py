@@ -51,9 +51,11 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
     else:
         state = rt.init_state(jax.random.key(ns.seed))
 
-    loader = build_dataloader(cfg, ns.global_train_batch_size, seq, seed=ns.seed)
-    for _ in range(start_step):  # fast-forward so resume sees the batches an
-        next(loader)  # uninterrupted run would (reference has no resume at all)
+    # start_batch fast-forwards by index arithmetic so resume sees the batches
+    # an uninterrupted run would (reference has no resume at all)
+    loader = build_dataloader(
+        cfg, ns.global_train_batch_size, seq, seed=ns.seed, start_batch=start_step
+    )
     prof = RuntimeProfiler(warmup_iters=1)
     losses = []
     for it in range(start_step, ns.train_iters):

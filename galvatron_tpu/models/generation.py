@@ -1,0 +1,275 @@
+"""Autoregressive text generation with a static KV cache.
+
+TPU-native counterpart of the reference's text-generation subsystem
+(reference: galvatron/site_package/megatron/text_generation/{api.py,
+generation.py,sampling.py} and text_generation_server.py): prefill + one
+token-per-step decode over a preallocated KV cache, with greedy /
+temperature / top-k / top-p sampling.
+
+Design differences from the reference (which loops in Python over
+dynamically growing torch tensors): the cache is a static-shape pytree and
+the decode loop is a single ``lax.scan`` inside one ``jit`` — XLA sees a
+fixed-shape program, so the whole generation runs on-device without host
+round-trips per token.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from galvatron_tpu.models import modeling
+from galvatron_tpu.models.modeling import ModelConfig, Params
+
+
+class KVCache(NamedTuple):
+    """Per-layer key/value tensors, (L, B, max_len, kv_heads, head_dim)."""
+
+    k: jax.Array
+    v: jax.Array
+
+
+def init_kv_cache(cfg: ModelConfig, batch_size: int, max_len: int) -> KVCache:
+    shape = (cfg.num_layers, batch_size, max_len, cfg.kv_heads, cfg.head_dim)
+    return KVCache(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+def _cached_attention(q, k_cache, v_cache, q_offset, cfg: ModelConfig, alibi=None):
+    """q: (B, s, nh, hd); caches: (B, Smax, kvh, hd). Delegates to
+    modeling.attention_xla (same mask/softmax core); only the ALiBi bias needs
+    the absolute-position rewrite here."""
+    s, smax = q.shape[1], k_cache.shape[1]
+    bias = None
+    if alibi is not None:
+        q_pos = q_offset + jnp.arange(s)
+        k_pos = jnp.arange(smax)
+        rel = k_pos[None, :] - q_pos[:, None]  # (s, Smax)
+        bias = (alibi[:, None, None] * rel[None]).astype(jnp.float32)[None]
+    return modeling.attention_xla(q, k_cache, v_cache, cfg, bias=bias, q_offset=q_offset)
+
+
+def _layer_with_cache(x, p, cfg: ModelConfig, k_cache, v_cache, offset, cos_sin, alibi):
+    """decoder_layer variant that reads/writes the KV cache at ``offset``.
+    Returns (x_out, k_cache, v_cache)."""
+    b, s, h = x.shape
+    hd = cfg.head_dim
+    xa = modeling.norm(x, p["attn_norm"], cfg)
+    pa = p["attn"]
+    q = (xa @ pa["wq"].astype(xa.dtype)).reshape(b, s, cfg.num_heads, hd)
+    k = (xa @ pa["wk"].astype(xa.dtype)).reshape(b, s, cfg.kv_heads, hd)
+    v = (xa @ pa["wv"].astype(xa.dtype)).reshape(b, s, cfg.kv_heads, hd)
+    if cfg.pos_embed == "rope":
+        cos, sin = cos_sin
+        q = modeling.apply_rope(q, cos, sin)
+        k = modeling.apply_rope(k, cos, sin)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, offset, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, offset, 0, 0))
+    o = _cached_attention(q, k_cache, v_cache, offset, cfg, alibi=alibi)
+    x = x + o.reshape(b, s, cfg.num_heads * hd) @ pa["wo"].astype(x.dtype)
+    x = x + modeling.mlp_block(
+        modeling.norm(x, p["mlp_norm"], cfg), p["mlp"], cfg, train=False
+    )
+    return x, k_cache, v_cache
+
+
+def forward_with_cache(params: Params, tokens, cfg: ModelConfig, cache: KVCache, offset):
+    """Run ``tokens`` (B, s) through the model at absolute position ``offset``,
+    updating the cache. Returns (logits, new_cache). ``offset`` may be traced."""
+    s = tokens.shape[1]
+    if cfg.pos_embed == "rope":
+        # full-length tables indexed dynamically so offset can be traced
+        cos_all, sin_all = modeling.rope_tables(cfg, cache.k.shape[2])
+        cos = jax.lax.dynamic_slice_in_dim(cos_all, offset, s, axis=0)
+        sin = jax.lax.dynamic_slice_in_dim(sin_all, offset, s, axis=0)
+        cos_sin = (cos, sin)
+    else:
+        cos_sin = None
+    alibi = (
+        jnp.asarray(modeling.alibi_slopes(cfg.num_heads)) if cfg.pos_embed == "alibi" else None
+    )
+    x = params["embed"]["tok"].astype(cfg.dtype)[tokens]
+    if cfg.pos_embed == "learned":
+        pos = offset + jnp.arange(s)
+        x = x + params["embed"]["pos"].astype(cfg.dtype)[pos][None]
+    new_k, new_v = [], []
+    for i, lp in enumerate(params["layers"]):
+        x, ki, vi = _layer_with_cache(
+            x, lp, cfg, cache.k[i], cache.v[i], offset, cos_sin, alibi
+        )
+        new_k.append(ki)
+        new_v.append(vi)
+    x = modeling.norm(x, params["final_norm"], cfg)
+    logits = modeling.lm_head(x, params, cfg)
+    return logits, KVCache(jnp.stack(new_k), jnp.stack(new_v))
+
+
+# ---------------------------------------------------------------------------
+# Sampling (reference: megatron/text_generation/sampling.py modify_logits_for_
+# top_k_filtering / top_p_filtering + sample)
+# ---------------------------------------------------------------------------
+
+
+def sample_logits(key, logits, temperature=1.0, top_k: int = 0, top_p=0.0,
+                  use_top_p: Optional[bool] = None):
+    """logits: (B, V) → token ids (B,). temperature 0 (or <0) → greedy.
+
+    ``temperature`` and ``top_p`` may be traced values — under jit, varying
+    them does NOT recompile. ``top_k`` must be static (lax.top_k needs a
+    concrete k), as must ``use_top_p``, the gate that includes the nucleus
+    sort in the program (defaults from ``top_p`` when that is concrete)."""
+    if use_top_p is None:
+        use_top_p = (not isinstance(top_p, (int, float))) or top_p > 0
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1)
+    t = jnp.asarray(temperature, jnp.float32)
+    scaled = logits / jnp.where(t > 0, t, 1.0)
+    if top_k > 0:
+        kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if use_top_p:
+        p = jnp.asarray(top_p, jnp.float32)
+        sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p (always >= 1 tok)
+        cutoff_mask = cum - probs < p
+        threshold = jnp.min(jnp.where(cutoff_mask, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        scaled = jnp.where((p > 0) & (scaled < threshold), -jnp.inf, scaled)
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(t <= 0, greedy, sampled)
+
+
+# ---------------------------------------------------------------------------
+# Generation loop
+# ---------------------------------------------------------------------------
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg",
+        "max_new_tokens",
+        "min_prompt_len",
+        "top_k",
+        "use_top_p",
+        "eos_id",
+        "pad_id",
+    ),
+)
+def generate(
+    params: Params,
+    prompt: jax.Array,  # (B, P) int32, right-padded with pad_id
+    prompt_lengths: jax.Array,  # (B,) true lengths
+    cfg: ModelConfig,
+    key: jax.Array,
+    max_new_tokens: int = 32,
+    min_prompt_len: Optional[int] = None,  # static int(prompt_lengths.min())
+    temperature=0.0,  # traced: varying it does not recompile
+    top_k: int = 0,
+    top_p=0.0,  # traced; use_top_p gates the nucleus sort into the program
+    use_top_p: bool = False,
+    eos_id: int = -1,
+    pad_id: int = 0,
+) -> jax.Array:
+    """Prefill + lockstep scan decode (the reference's scheme: right-padded
+    prompts, generation starts at min(context_length), prompt tokens override
+    sampled ones until each row's own prompt is exhausted — megatron/
+    text_generation/generation.py generate_tokens_probs_and_return_on_first_
+    stage). Returns (B, P + max_new_tokens); positions past a row's eos are
+    ``pad_id``."""
+    b, p_len = prompt.shape
+    if min_prompt_len is None:
+        min_prompt_len = p_len
+    max_len = p_len + max_new_tokens
+    cache = init_kv_cache(cfg, b, max_len)
+
+    # prefill positions [0, min_prompt_len); all rows have real tokens there
+    logits, cache = forward_with_cache(
+        params, prompt[:, :min_prompt_len], cfg, cache, 0
+    )
+    last = logits[:, -1]  # (B, V) — logits at position min_prompt_len-1
+
+    out = jnp.concatenate(
+        [prompt, jnp.full((b, max_new_tokens), pad_id, jnp.int32)], axis=1
+    )
+
+    def step(carry, i):
+        cache, last, key, done, out = carry
+        key, sub = jax.random.split(key)
+        sampled = sample_logits(
+            sub, last, temperature, top_k, top_p, use_top_p=use_top_p
+        ).astype(jnp.int32)
+        in_prompt = i < prompt_lengths  # (B,) teacher-force rows still in prompt
+        tok = jnp.where(in_prompt, out[:, i], jnp.where(done, pad_id, sampled))
+        done = done | (~in_prompt & (tok == eos_id))
+        out = jax.lax.dynamic_update_slice(out, tok[:, None], (0, i))
+
+        def do_fwd(cache):  # predict position i+1
+            logits, cache = forward_with_cache(params, tok[:, None], cfg, cache, i)
+            return logits[:, 0], cache
+
+        def skip_fwd(cache):  # last step: nothing left to predict
+            return last, cache
+
+        last2, cache = jax.lax.cond(i < max_len - 1, do_fwd, skip_fwd, cache)
+        return (cache, last2, key, done, out), None
+
+    done = jnp.zeros((b,), bool)
+    steps = jnp.arange(min_prompt_len, max_len)
+    carry = (cache, last, key, done, out)
+    (cache, _, _, _, out), _ = jax.lax.scan(step, carry, steps)
+    return out
+
+
+def generate_np(params, cfg: ModelConfig, prompts, length_bucket: int = 64, **kw):
+    """Host-side convenience: list of variable-length token lists → padded
+    arrays → ``generate`` → list of token lists (stopping at eos).
+
+    Prompt length is padded UP and min_prompt_len rounded DOWN to multiples of
+    ``length_bucket`` so repeat calls with naturally varying prompt lengths
+    hit the jit cache instead of recompiling per length."""
+    lengths = np.asarray([len(p) for p in prompts], np.int32)
+    if int(lengths.min()) < 1:
+        raise ValueError("empty prompt")
+    max_new = kw.get("max_new_tokens", 32)
+    p_raw = int(lengths.max())
+    if p_raw + max_new > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({p_raw}) + max_new_tokens ({max_new}) exceeds "
+            f"max_seq_len {cfg.max_seq_len}"
+        )
+    # pad up to the bucket when the seq-len window allows it
+    p_len = min(-(-p_raw // length_bucket) * length_bucket,
+                max(p_raw, cfg.max_seq_len - max_new))
+    pad_id = kw.get("pad_id", 0)
+    batch = np.full((len(prompts), p_len), pad_id, np.int32)
+    for i, p in enumerate(prompts):
+        batch[i, : len(p)] = p
+    key = kw.pop("key", jax.random.key(0))
+    tp = kw.get("top_p", 0.0)
+    kw.setdefault("use_top_p", not isinstance(tp, (int, float)) or tp > 0)
+    min_len = max(1, int(lengths.min()) // length_bucket * length_bucket)
+    out = generate(
+        params,
+        jnp.asarray(batch),
+        jnp.asarray(lengths),
+        cfg,
+        key,
+        min_prompt_len=min_len,
+        **kw,
+    )
+    out = np.asarray(out)
+    eos_id = kw.get("eos_id", -1)
+    res = []
+    for i, row in enumerate(out):
+        toks = row[: lengths[i]].tolist()
+        for t in row[lengths[i] : lengths[i] + kw.get("max_new_tokens", 32)]:
+            if t == eos_id:
+                break
+            toks.append(int(t))
+        res.append(toks)
+    return res

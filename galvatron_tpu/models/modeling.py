@@ -255,16 +255,22 @@ def _repeat_kv(x, n_rep: int):
     )
 
 
-def attention_xla(q, k, v, cfg: ModelConfig, bias=None):
+def attention_xla(q, k, v, cfg: ModelConfig, bias=None, q_offset=0):
     """Reference einsum attention (the 'CoreAttention' path, reference:
-    galvatron/core/tensor_parallel/transformer.py:298-435)."""
+    galvatron/core/tensor_parallel/transformer.py:298-435).
+
+    k/v may be longer than q (KV-cache decode): query i sits at absolute
+    position ``q_offset + i`` and sees keys at positions <= its own.
+    ``q_offset`` may be a traced scalar."""
     b, s, nh, hd = q.shape
     k = _repeat_kv(k, nh // k.shape[2])
     v = _repeat_kv(v, nh // v.shape[2])
     scores = jnp.einsum("bqnh,bknh->bnqk", q, k).astype(jnp.float32) / np.sqrt(hd)
     if bias is not None:
         scores = scores + bias
-    causal = jnp.tril(jnp.ones((s, s), bool))
+    q_pos = q_offset + jnp.arange(s)
+    k_pos = jnp.arange(k.shape[1])
+    causal = k_pos[None, :] <= q_pos[:, None]
     scores = jnp.where(causal[None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bnqk,bknh->bqnh", probs, v)
@@ -300,14 +306,15 @@ def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None):
     return o.reshape(b, s, cfg.num_heads * hd) @ p["wo"].astype(x.dtype)
 
 
-def mlp_block(x, p, cfg: ModelConfig):
+def mlp_block(x, p, cfg: ModelConfig, train: bool = True):
     """SwiGLU or GeLU MLP (reference: ParallelMLP, galvatron/core/
     tensor_parallel/transformer.py:78-159); switch-MoE when moe_experts > 0
-    (SwitchMLP, transformer.py:161-295)."""
+    (SwitchMLP, transformer.py:161-295). ``train`` only affects MoE routing
+    (sinkhorn-balanced vs raw-argmax)."""
     if cfg.moe_experts > 0:
         from galvatron_tpu.models import moe
 
-        return moe.moe_block(x, p, cfg)
+        return moe.moe_block(x, p, cfg, train=train)
     if cfg.act_fn == "swiglu":
         return (
             jax.nn.silu(x @ p["w1"].astype(x.dtype)) * (x @ p["w3"].astype(x.dtype))

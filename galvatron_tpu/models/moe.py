@@ -52,19 +52,26 @@ def moe_capacity(num_tokens: int, num_experts: int, capacity_factor: float) -> i
     return max(8, (c + 7) // 8 * 8)
 
 
-def route_top1(logits: jax.Array, capacity: int, *, sinkhorn_iters: int = 8):
+def route_top1(logits: jax.Array, capacity: int, *, sinkhorn_iters: int = 8,
+               train: bool = True):
     """Top-1 switch routing with capacity limiting.
 
-    Assignment comes from the sinkhorn-balanced scores; the gate value that
-    scales the expert output is the sigmoid of the raw logit at the chosen
-    expert (reference: transformer.py:231-246).
+    During training the assignment comes from the sinkhorn-balanced scores; at
+    inference it is the raw-logit argmax (the reference does the same:
+    sinkhorn under no_grad for training routing, plain argmax at eval,
+    transformer.py:231-246 — and sinkhorn over a tiny batch degenerates to
+    uniform scores, so batch-1 decode would always pick expert 0). The gate
+    value is the sigmoid of the raw logit at the chosen expert either way.
 
     Returns (dispatch, combine): dispatch is a (T, E, C) one-hot used to
     scatter tokens into per-expert slots; combine = dispatch · gate gathers
     expert outputs back, zero for capacity-dropped tokens.
     """
     T, E = logits.shape
-    scores = sinkhorn(logits.astype(jnp.float32), sinkhorn_iters)
+    if train:
+        scores = sinkhorn(logits.astype(jnp.float32), sinkhorn_iters)
+    else:
+        scores = logits.astype(jnp.float32)
     expert_idx = jnp.argmax(scores, axis=-1)  # (T,)
     gate = jax.nn.sigmoid(
         jnp.take_along_axis(logits.astype(jnp.float32), expert_idx[:, None], axis=1)[:, 0]
@@ -111,7 +118,7 @@ def moe_annotations(cfg) -> Params:
     return a
 
 
-def moe_block(x: jax.Array, p: Params, cfg) -> jax.Array:
+def moe_block(x: jax.Array, p: Params, cfg, train: bool = True) -> jax.Array:
     """Switch-MoE MLP on a (B, S, H) activation (SwitchMLP.forward equivalent,
     reference: transformer.py:210-295)."""
     b, s, h = x.shape
@@ -120,7 +127,9 @@ def moe_block(x: jax.Array, p: Params, cfg) -> jax.Array:
     xt = x.reshape(T, h)
     logits = xt.astype(jnp.float32) @ p["router"]["w"].astype(jnp.float32)  # (T, E)
     C = moe_capacity(T, E, cfg.moe_capacity_factor)
-    dispatch, combine = route_top1(logits, C, sinkhorn_iters=cfg.moe_sinkhorn_iters)
+    dispatch, combine = route_top1(
+        logits, C, sinkhorn_iters=cfg.moe_sinkhorn_iters, train=train
+    )
 
     # scatter tokens into per-expert buffers: (E, C, H). XLA turns the expert
     # dim's sharding mismatch (tokens batch-sharded vs experts ep-sharded)

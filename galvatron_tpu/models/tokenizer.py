@@ -1,0 +1,79 @@
+"""Tokenizers for the generation path.
+
+Counterpart of the reference's tokenizer subsystem (reference:
+galvatron/site_package/megatron/tokenizer/tokenizer.py — build_tokenizer with
+BPE/sentencepiece backends + vocab-size padding for TP divisibility). Here:
+
+- ``ByteTokenizer``: dependency-free UTF-8 byte-level tokenizer (ids 0..255
+  are bytes, then bos/eos/pad) — always available, used by demos and tests.
+- ``HFTokenizer``: wraps a ``transformers`` tokenizer loaded from a LOCAL
+  path (no network egress); gated import.
+
+``pad_vocab_size`` mirrors the reference's make-vocab-size-divisible logic
+(megatron/tokenizer/tokenizer.py _vocab_size_with_padding) so vocab-parallel
+embedding shards stay equal-sized under any ``vocab_tp``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+def pad_vocab_size(n: int, divisor: int = 128) -> int:
+    """Round vocab up so TP shards divide evenly."""
+    return (n + divisor - 1) // divisor * divisor
+
+
+class ByteTokenizer:
+    """UTF-8 bytes; ids 256/257/258 = bos/eos/pad."""
+
+    def __init__(self):
+        self.bos_id = 256
+        self.eos_id = 257
+        self.pad_id = 258
+
+    @property
+    def vocab_size(self) -> int:
+        return pad_vocab_size(259)
+
+    def encode(self, text: str, bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_id] if bos else []) + ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """transformers tokenizer from a local directory (offline)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self.tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.bos_id = self.tok.bos_token_id
+        self.eos_id = self.tok.eos_token_id
+        self.pad_id = self.tok.pad_token_id
+        if self.pad_id is None:
+            self.pad_id = self.eos_id if self.eos_id is not None else 0
+
+    @property
+    def vocab_size(self) -> int:
+        return pad_vocab_size(len(self.tok))
+
+    def encode(self, text: str, bos: bool = True) -> List[int]:
+        ids = self.tok.encode(text, add_special_tokens=False)
+        if bos and self.bos_id is not None:
+            ids = [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self.tok.decode(list(ids), skip_special_tokens=True)
+
+
+def build_tokenizer(name_or_path: Optional[str] = None):
+    """(reference: build_tokenizer, megatron/tokenizer/tokenizer.py)"""
+    if name_or_path in (None, "", "byte"):
+        return ByteTokenizer()
+    return HFTokenizer(name_or_path)

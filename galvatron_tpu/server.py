@@ -1,0 +1,104 @@
+"""Minimal REST text-generation server.
+
+Counterpart of the reference's Flask server (reference:
+galvatron/site_package/megatron/text_generation_server.py — PUT /api with
+{"prompts": [...], "tokens_to_generate": N, ...}). Stdlib-only
+(http.server) so it carries no extra dependencies; single worker, requests
+are served sequentially in arrival order (generation holds the chip anyway).
+
+API (POST or PUT /api, JSON body):
+  {"prompts": ["..."], "tokens_to_generate": 32, "temperature": 0.0,
+   "top_k": 0, "top_p": 0.0}
+→ {"text": ["...completions..."], "tokens": [[...ids...]]}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import Any, Optional
+
+import jax
+
+
+class GenerationService:
+    def __init__(self, params, cfg, tokenizer, max_new_default: int = 64, seed: int = 0):
+        self.params = params
+        self.cfg = cfg
+        self.tok = tokenizer
+        self.max_new_default = max_new_default
+        self.key = jax.random.key(seed)
+        self.lock = threading.Lock()
+
+    def generate(self, body: dict) -> dict:
+        from galvatron_tpu.models import generation
+
+        if not isinstance(body, dict):
+            raise ValueError("request body must be a JSON object")
+        prompts = body.get("prompts")
+        if not isinstance(prompts, list) or not prompts or not all(
+            isinstance(p, str) for p in prompts
+        ):
+            raise ValueError("'prompts' must be a non-empty list of strings")
+        n_new = int(body.get("tokens_to_generate", self.max_new_default))
+        if n_new < 0 or n_new > self.cfg.max_seq_len:
+            raise ValueError(f"tokens_to_generate out of range [0, {self.cfg.max_seq_len}]")
+        tok_prompts = [self.tok.encode(p) for p in prompts]
+        with self.lock:
+            self.key, sub = jax.random.split(self.key)
+            outs = generation.generate_np(
+                self.params,
+                self.cfg,
+                tok_prompts,
+                max_new_tokens=n_new,
+                temperature=float(body.get("temperature", 0.0)),
+                top_k=int(body.get("top_k", 0)),
+                top_p=float(body.get("top_p", 0.0)),
+                eos_id=self.tok.eos_id if self.tok.eos_id is not None else -1,
+                pad_id=self.tok.pad_id if self.tok.pad_id is not None else 0,
+                key=sub,
+            )
+        texts = [self.tok.decode(o[len(tp):]) for o, tp in zip(outs, tok_prompts)]
+        return {"text": texts, "tokens": outs}
+
+
+def _make_handler(service: GenerationService):
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code: int, payload: dict):
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _handle(self):
+            if self.path.rstrip("/") != "/api":
+                return self._reply(404, {"error": "use /api"})
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                return self._reply(200, service.generate(body))
+            except ValueError as e:
+                return self._reply(400, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — surface to client
+                return self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        do_POST = _handle
+        do_PUT = _handle
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    return Handler
+
+
+def run_server(service: GenerationService, port: int = 5000, host: str = "127.0.0.1",
+               ready_event: Optional[threading.Event] = None) -> None:
+    httpd = HTTPServer((host, port), _make_handler(service))
+    service.httpd = httpd
+    if ready_event is not None:
+        ready_event.set()
+    print(f"generation server listening on http://{host}:{httpd.server_address[1]}/api")
+    httpd.serve_forever()

@@ -4,12 +4,17 @@ config_utils.py:34-116 — the bandwidth/time/memory config readers/writers).
 Schemas:
 
 computation profiling (reference computation_profiling_*.json equivalent):
-  {"layertype_0": <fwd ms per layer per sample>, ...}
+  {"layertype_0": <fwd ms per layer per sample>, ...,
+   "other": <embed/cls fwd ms per sample>}
 
 memory profiling (reference memory_profiling_*.json equivalent):
   {"layertype_0": {"parameter_mb": ..., "activation_mb_per_sample": {"1": ...},
                    "boundary_activation_mb_per_sample": ...},
-   "other": {"param_mb": ..., "act_mb_per_sample": ..., "fwd_ms_per_sample": ...}}
+   "other": {"param_mb": ..., "act_mb_per_sample": ...}}
+
+(all time quantities live in the computation JSON so a memory-only profile
+run never persists placeholder timings; older files carrying
+other.fwd_ms_per_sample in the memory JSON still load)
 
 hardware (reference allreduce_bandwidth_*/p2p_bandwidth_*/overlap_coefficient
 .json equivalents, measured over ICI instead of nccl-tests):
@@ -44,7 +49,10 @@ def write_json_config(obj: Dict[str, Any], path: str) -> None:
 def save_profiled_model(costs: ProfiledModelCosts, time_path=None, mem_path=None) -> None:
     """Write either or both profiled-model JSONs (None skips that file)."""
     if time_path:
-        times = {f"layertype_{i}": lt.fwd_ms_per_sample for i, lt in costs.layer_types.items()}
+        times: Dict[str, Any] = {
+            f"layertype_{i}": lt.fwd_ms_per_sample for i, lt in costs.layer_types.items()
+        }
+        times["other"] = costs.other_fwd_ms_per_sample
         write_json_config(times, time_path)
     if mem_path:
         mem: Dict[str, Any] = {}
@@ -59,7 +67,6 @@ def save_profiled_model(costs: ProfiledModelCosts, time_path=None, mem_path=None
         mem["other"] = {
             "param_mb": costs.other_param_mb,
             "act_mb_per_sample": costs.other_act_mb_per_sample,
-            "fwd_ms_per_sample": costs.other_fwd_ms_per_sample,
         }
         write_json_config(mem, mem_path)
 
@@ -82,11 +89,12 @@ def load_profiled_model(time_path: str, mem_path: str) -> ProfiledModelCosts:
             boundary_activation_mb_per_sample=float(m["boundary_activation_mb_per_sample"]),
         )
     other = mem.get("other", {})
+    other_ms = times.get("other", other.get("fwd_ms_per_sample", 0.0))
     return ProfiledModelCosts(
         layer_types=layer_types,
         other_param_mb=float(other.get("param_mb", 0.0)),
         other_act_mb_per_sample=float(other.get("act_mb_per_sample", 0.0)),
-        other_fwd_ms_per_sample=float(other.get("fwd_ms_per_sample", 0.0)),
+        other_fwd_ms_per_sample=float(other_ms),
     )
 
 

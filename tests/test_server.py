@@ -1,0 +1,75 @@
+"""Generation CLI mode + REST server round-trip + tokenizers."""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+
+from galvatron_tpu.models import modeling
+from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.models.tokenizer import ByteTokenizer, build_tokenizer, pad_vocab_size
+
+TINY = ModelConfig(
+    vocab_size=pad_vocab_size(259),
+    hidden_size=32,
+    num_layers=1,
+    num_heads=2,
+    ffn_dim=64,
+    max_seq_len=64,
+    dtype=jnp.float32,
+)
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    ids = tok.encode("héllo ✓")
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids) == "héllo ✓"
+    assert build_tokenizer("byte").vocab_size % 128 == 0
+
+
+def test_cli_generate(capsys):
+    from galvatron_tpu.cli import main
+
+    rc = main([
+        "generate", "--model_size", "llama-0.3b", "--num_layers", "1",
+        "--hidden_size", "32", "--num_heads", "2", "--ffn_dim", "64",
+        "--vocab_size", str(TINY.vocab_size), "--seq_length", "64",
+        "--prompt", "ab", "--max_new_tokens", "3",
+    ])
+    assert rc == 0
+    out = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines() if l.startswith("{")]
+    assert out and out[0]["prompt"] == "ab"
+
+
+def test_server_roundtrip():
+    from galvatron_tpu.server import GenerationService, run_server
+
+    params = modeling.init_model_params(jax.random.key(0), TINY)
+    svc = GenerationService(params, TINY, ByteTokenizer(), max_new_default=4)
+    ready = threading.Event()
+    t = threading.Thread(target=run_server, args=(svc, 0), kwargs={"ready_event": ready}, daemon=True)
+    t.start()
+    assert ready.wait(10)
+    port = svc.httpd.server_address[1]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api",
+        data=json.dumps({"prompts": ["hi", "there"], "tokens_to_generate": 3}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        body = json.loads(r.read())
+    assert len(body["text"]) == 2 and len(body["tokens"]) == 2
+    # bad request → 400
+    req2 = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api", data=b"{}", method="POST"
+    )
+    try:
+        urllib.request.urlopen(req2, timeout=60)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    svc.httpd.shutdown()
