@@ -39,6 +39,17 @@ def _add_training_args(p: argparse.ArgumentParser):
     g.add_argument("--global_train_batch_size", type=int, default=8)
     g.add_argument("--train_iters", type=int, default=10)
     g.add_argument("--lr", type=float, default=1e-4)
+    g.add_argument("--min_lr", type=float, default=0.0)
+    g.add_argument("--lr_warmup_iters", type=int, default=0)
+    g.add_argument("--lr_decay_iters", type=int, default=0, help="0 = no decay")
+    g.add_argument("--lr_decay_style", type=str, default="cosine",
+                   choices=["constant", "linear", "cosine"])
+    g.add_argument(
+        "--rampup_batch_size", type=int, nargs=3, default=None,
+        metavar=("START", "INCREMENT", "SAMPLES"),
+        help="global-batch-size ramp-up (reference: megatron microbatches.py); "
+        "pp=1 only — each size change recompiles the step",
+    )
     g.add_argument("--weight_decay", type=float, default=0.01)
     g.add_argument("--grad_clip", type=float, default=1.0)
     g.add_argument("--seed", type=int, default=1234)
@@ -51,7 +62,11 @@ def _add_training_args(p: argparse.ArgumentParser):
     g.add_argument("--global_tp_consec", type=int, default=1)
     g.add_argument("--sdp", type=int, default=0, help="1 = zero3 on all layers")
     g.add_argument("--default_dp_type", type=str, default="ddp", choices=["ddp", "zero2", "zero3"])
-    g.add_argument("--global_checkpoint", type=int, default=0)
+    g.add_argument(
+        "--global_checkpoint", type=int, default=0, choices=[0, 1, 2],
+        help="0 = off, 1 = full-layer remat, 2 = selective (attention-core-only "
+        "recompute; reference: Megatron --recompute-granularity selective)",
+    )
     g.add_argument("--sequence_parallel", type=int, default=0)
     g.add_argument("--context_parallel_deg", type=int, default=1)
     g.add_argument("--chunks", type=int, default=-1, help="-1 = heuristic")
@@ -61,6 +76,8 @@ def _add_training_args(p: argparse.ArgumentParser):
     g.add_argument("--galvatron_config_path", type=str, default=None)
     g.add_argument("--attn_impl", type=str, default="auto", choices=["auto", "flash", "xla"])
     # checkpoint/resume (capability the reference only gestures at; SURVEY §5)
+    g.add_argument("--metrics_path", type=str, default=None,
+                   help="JSONL structured metrics sink (per-iter loss/time)")
     g.add_argument("--save", type=str, default=None, help="checkpoint directory")
     g.add_argument("--load", type=str, default=None, help="resume directory")
     g.add_argument("--save_interval", type=int, default=0)
@@ -202,7 +219,7 @@ def hybrid_config_from_args(ns: argparse.Namespace, num_layers: int, world: int)
             tp=ns.global_tp_deg,
             tp_consec=bool(ns.global_tp_consec),
             dp_type=dp_type,
-            ckpt=bool(ns.global_checkpoint),
+            ckpt=ns.global_checkpoint,
             sp=bool(ns.sequence_parallel),
             cp=ns.context_parallel_deg,
             chunks=chunks,

@@ -28,6 +28,11 @@ class AdamConfig(NamedTuple):
     eps: float = 1e-8
     weight_decay: float = 0.0
     grad_clip: Optional[float] = 1.0
+    # optional LRSchedule (galvatron_tpu.core.schedules); when set, the
+    # effective lr is lr_schedule(step) — evaluated inside the jitted update
+    # from the optimizer step count, so one compiled train_step serves the
+    # whole schedule (reference: megatron lr-decay flags, SURVEY §2.6)
+    lr_schedule: Optional[Any] = None
 
 
 def init_opt_state(params) -> Dict[str, Any]:
@@ -58,7 +63,11 @@ def adamw_update(params, grads, opt_state, cfg: AdamConfig, lr_scale=1.0):
     b2c = 1.0 - cfg.b2 ** count.astype(jnp.float32)
     mu = jax.tree.map(lambda m, g: cfg.b1 * m + (1 - cfg.b1) * g, opt_state["mu"], grads)
     nu = jax.tree.map(lambda v, g: cfg.b2 * v + (1 - cfg.b2) * g * g, opt_state["nu"], grads)
-    lr = cfg.lr * lr_scale
+    if cfg.lr_schedule is not None:
+        # 0-based step index = count before this update's increment
+        lr = cfg.lr_schedule(count.astype(jnp.float32) - 1.0) * lr_scale
+    else:
+        lr = cfg.lr * lr_scale
 
     def upd(p, m, v):
         step = lr * (m / b1c) / (jnp.sqrt(v / b2c) + cfg.eps)

@@ -1,0 +1,182 @@
+"""Learning-rate schedules, batch-size ramp-up, and dynamic loss scaling.
+
+TPU-native counterparts of three vendored-Megatron subsystems the reference
+carries but never wires into its trainer (SURVEY §2.6 aux subsystems):
+
+- ``LRSchedule`` — warmup + {constant, linear, cosine} decay
+  (reference: site_package/megatron/optimizer_param_scheduler.py /
+  training.py lr-decay flags);
+- ``BatchSizeRampup`` — global-batch-size ramp-up by a fixed increment every
+  N samples (reference: site_package/megatron/microbatches.py:1-144,
+  RampupBatchsizeNumMicroBatches);
+- ``DynamicLossScaler`` — fp16 loss scaling with growth/backoff
+  (reference: site_package/megatron/optimizer/grad_scaler.py). On TPU the
+  native precision is bf16 (no scaler needed); the scaler exists for fp16
+  parity and is pure-jax so it composes with jit.
+
+Everything here is traceable: schedule values are jnp scalars when given
+traced steps, plain floats when given ints.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class LRSchedule:
+    """lr(step): linear warmup from ``warmup_init_lr`` to ``lr`` over
+    ``warmup_iters``, then decay to ``min_lr`` at ``decay_iters`` following
+    ``decay_style``, constant afterwards."""
+
+    lr: float = 1e-4
+    min_lr: float = 0.0
+    warmup_iters: int = 0
+    decay_iters: int = 0  # 0 → no decay (constant after warmup)
+    decay_style: str = "cosine"  # 'constant' | 'linear' | 'cosine'
+    warmup_init_lr: float = 0.0
+
+    def __post_init__(self):
+        if self.decay_style not in ("constant", "linear", "cosine"):
+            raise ValueError(f"unknown decay_style {self.decay_style!r}")
+        if self.min_lr > self.lr:
+            raise ValueError("min_lr must not exceed lr")
+
+    def __call__(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        warm = jnp.asarray(max(self.warmup_iters, 0), jnp.float32)
+        # warmup branch value (guard warm==0 with a dummy denominator)
+        wfrac = s / jnp.maximum(warm, 1.0)
+        warm_lr = self.warmup_init_lr + (self.lr - self.warmup_init_lr) * wfrac
+        if self.decay_style == "constant" or self.decay_iters <= 0:
+            decayed = jnp.asarray(self.lr, jnp.float32)
+        else:
+            span = jnp.asarray(max(self.decay_iters - self.warmup_iters, 1), jnp.float32)
+            dfrac = jnp.clip((s - warm) / span, 0.0, 1.0)
+            if self.decay_style == "linear":
+                coeff = 1.0 - dfrac
+            else:  # cosine
+                coeff = 0.5 * (1.0 + jnp.cos(jnp.pi * dfrac))
+            decayed = self.min_lr + (self.lr - self.min_lr) * coeff
+        out = jnp.where(s < warm, warm_lr, decayed)
+        if isinstance(step, int):
+            return float(out)
+        return out
+
+    def scale(self, step):
+        """lr(step)/lr — multiplier form for ``adamw_update(..., lr_scale=)``."""
+        return self(step) / self.lr if self.lr else 0.0
+
+
+@dataclass(frozen=True)
+class BatchSizeRampup:
+    """Global batch size as a function of consumed samples
+    (reference: megatron/microbatches.py RampupBatchsizeNumMicroBatches:
+    ``--rampup-batch-size <start> <increment> <ramp-up samples>``).
+
+    The size grows from ``start`` to ``target`` in steps of ``increment``;
+    each intermediate size is held for an equal share of ``rampup_samples``.
+    """
+
+    start: int
+    increment: int
+    rampup_samples: int
+    target: int
+
+    def __post_init__(self):
+        if self.increment <= 0 or self.start <= 0:
+            raise ValueError("start and increment must be positive")
+        if self.start > self.target:
+            raise ValueError(f"start {self.start} must not exceed target {self.target}")
+        if (self.target - self.start) % self.increment != 0:
+            raise ValueError(
+                f"target-start ({self.target}-{self.start}) must be a multiple of "
+                f"increment {self.increment} (reference constraint, microbatches.py)"
+            )
+
+    def __call__(self, consumed_samples: int) -> int:
+        steps = (self.target - self.start) // self.increment
+        if steps == 0 or consumed_samples >= self.rampup_samples:
+            return self.target
+        per = self.rampup_samples / steps
+        i = int(consumed_samples / per)
+        return min(self.start + i * self.increment, self.target)
+
+    def sizes(self):
+        return list(range(self.start, self.target + 1, self.increment))
+
+
+# ---------------------------------------------------------------------------
+# fp16 dynamic loss scaling (pure-jax, jit-composable)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class LossScalerConfig:
+    """(reference defaults: megatron/optimizer/grad_scaler.py DynamicGradScaler
+    — initial 2^32, growth 2.0 every 1000 clean steps, backoff 0.5, min 1.0)"""
+
+    initial_scale: float = 2.0**16
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 1000
+    min_scale: float = 1.0
+
+
+def init_scaler_state(cfg: LossScalerConfig) -> Dict[str, Any]:
+    return {
+        "scale": jnp.asarray(cfg.initial_scale, jnp.float32),
+        "good_steps": jnp.zeros((), jnp.int32),
+    }
+
+
+def all_finite(tree) -> jax.Array:
+    leaves = [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(tree)]
+    return jnp.stack(leaves).all() if leaves else jnp.asarray(True)
+
+
+def scaler_update(state: Dict[str, Any], finite, cfg: LossScalerConfig):
+    """Next scaler state given whether this step's grads were all finite.
+    Growth after ``growth_interval`` consecutive clean steps; backoff (and
+    skipped update — caller's responsibility via the ``finite`` flag) on
+    overflow."""
+    grown = jnp.where(
+        state["good_steps"] + 1 >= cfg.growth_interval,
+        state["scale"] * cfg.growth_factor,
+        state["scale"],
+    )
+    new_scale = jnp.where(
+        finite,
+        grown,
+        jnp.maximum(state["scale"] * cfg.backoff_factor, cfg.min_scale),
+    )
+    new_good = jnp.where(
+        finite & (state["good_steps"] + 1 < cfg.growth_interval),
+        state["good_steps"] + 1,
+        0,
+    )
+    return {"scale": new_scale, "good_steps": new_good}
+
+
+def scaled_grads_fn(loss_fn, scaler_state):
+    """Wrap ``loss_fn(params, batch) -> loss`` so gradients are computed on
+    ``loss * scale`` and then unscaled — the fp16 pattern. Returns
+    ``(loss, grads, finite)``; on overflow the caller must skip the update and
+    feed ``finite`` to ``scaler_update``."""
+
+    def run(params, batch):
+        scale = scaler_state["scale"]
+
+        def scaled(p):
+            return loss_fn(p, batch) * scale
+
+        sloss, sgrads = jax.value_and_grad(scaled)(params)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32) / scale, sgrads)
+        finite = all_finite(grads) & jnp.isfinite(sloss)
+        return sloss / scale, grads, finite
+
+    return run

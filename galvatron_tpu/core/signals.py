@@ -1,0 +1,53 @@
+"""Graceful-exit signal handling (failure detection).
+
+Counterpart of the vendored Megatron ``dist_signal_handler.py`` (reference:
+site_package/megatron/dist_signal_handler.py:1-81 — SIGTERM caught on every
+rank, all-gathered so all ranks agree, then checkpoint + exit; carried but
+unused by the reference's own trainer, SURVEY §5 "failure detection: none").
+
+Here the trainer polls ``handler.signaled`` at iteration boundaries and
+checkpoints before exiting. Under multi-controller JAX each host process
+installs its own handler; the decision is host-local (a SIGTERM'd host stops
+fetching work, which stalls collectives — preemption on TPU pods delivers the
+signal to every host simultaneously, so in practice all hosts agree).
+"""
+
+from __future__ import annotations
+
+import signal
+from types import FrameType
+from typing import List, Optional
+
+
+class GracefulExitHandler:
+    """Context manager latching SIGTERM/SIGINT; restores prior handlers on
+    exit. Second SIGINT falls through to the default handler (hard Ctrl-C)."""
+
+    def __init__(self, signals: Optional[List[int]] = None):
+        self.signals = signals or [signal.SIGTERM, signal.SIGINT]
+        self.signaled: Optional[int] = None
+        self._prev = {}
+
+    def _handle(self, signum: int, frame: Optional[FrameType]):
+        if self.signaled is not None and signum == signal.SIGINT:
+            # second Ctrl-C: restore and re-raise for an immediate stop
+            signal.signal(signum, self._prev.get(signum, signal.SIG_DFL))
+            raise KeyboardInterrupt
+        self.signaled = signum
+
+    def __enter__(self) -> "GracefulExitHandler":
+        for s in self.signals:
+            try:
+                self._prev[s] = signal.signal(s, self._handle)
+            except (ValueError, OSError):
+                # non-main thread or unsupported signal: degrade to no-op
+                pass
+        return self
+
+    def __exit__(self, *exc):
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, OSError):
+                pass
+        return False

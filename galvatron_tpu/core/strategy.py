@@ -25,6 +25,19 @@ DP_TYPES = ("ddp", "zero2", "zero3")
 # (0 = default dp type, 1 = fsdp/zero3; we extend with explicit names).
 _DP_TYPE_TO_INT = {"ddp": 0, "zero2": 0, "zero3": 1}
 
+# Activation-recompute modes. The reference has full-layer checkpoint_wrapper
+# wrapping (galvatron/core/parallel.py:109-132) plus Megatron's "selective"
+# core-attention-only recompute (galvatron/core/tensor_parallel/
+# transformer.py:597,615-636). JSON encoding extends the reference's 0/1
+# `checkpoint` flags with 2 = selective.
+_CKPT_NORMALIZE = {
+    # bool keys omitted: False==0 / True==1 hash-equal, so 0/1 cover them
+    0: False, "none": False, "": False, None: False,
+    1: "full", "full": "full",
+    2: "selective", "selective": "selective",
+}
+_CKPT_TO_INT = {False: 0, "full": 1, "selective": 2}
+
 
 def _is_pow2(n: int) -> bool:
     return n >= 1 and (n & (n - 1)) == 0
@@ -42,8 +55,11 @@ class LayerStrategy:
       dp_type: 'ddp' (replicated params), 'zero2' (sharded optimizer state),
         'zero3' (fully sharded params — FSDP FULL_SHARD equivalent).
         (reference: galvatron/core/parallel.py:30-32)
-      ckpt: activation rematerialization for this layer
-        (reference: checkpoint_wrapper wrapping, galvatron/core/parallel.py:109-132)
+      ckpt: activation rematerialization for this layer — False, 'full'
+        (whole-layer remat; reference: checkpoint_wrapper wrapping,
+        galvatron/core/parallel.py:109-132) or 'selective' (core-attention-only
+        recompute; reference: transformer.py:597,615-636). Truthiness works:
+        ``if s.ckpt`` means "any recompute".
       sp: Megatron-style sequence parallelism — activations sequence-sharded
         over the TP axes between blocks (reference: site_package/megatron/core/
         tensor_parallel/mappings_group.py:192-293).
@@ -57,12 +73,18 @@ class LayerStrategy:
     tp: int = 1
     tp_consec: bool = True
     dp_type: str = "ddp"
-    ckpt: bool = False
+    ckpt: Any = False  # False | 'full' | 'selective' (True/0/1/2 accepted)
     sp: bool = False
     cp: int = 1
     ep: int = 1
 
     def __post_init__(self):
+        try:
+            object.__setattr__(self, "ckpt", _CKPT_NORMALIZE[self.ckpt])
+        except (KeyError, TypeError):
+            raise ValueError(
+                f"ckpt must be one of False/'full'/'selective' (or 0/1/2), got {self.ckpt!r}"
+            )
         if not _is_pow2(self.tp):
             raise ValueError(f"tp degree must be a power of two, got {self.tp}")
         if not _is_pow2(self.cp):
@@ -71,6 +93,11 @@ class LayerStrategy:
             raise ValueError(f"ep degree must be a power of two, got {self.ep}")
         if self.cp > 1 and self.ep > 1:
             raise ValueError("cp and ep both >1 is unsupported (they share mesh axes)")
+        if self.cp > 1 and self.ckpt == "selective":
+            raise ValueError(
+                "ckpt='selective' is not supported with cp>1 (the ring-attention "
+                "layer has no attention-core remat hook); use ckpt='full'"
+            )
         if self.dp_type not in DP_TYPES:
             raise ValueError(f"dp_type must be one of {DP_TYPES}, got {self.dp_type}")
 
@@ -151,7 +178,7 @@ class HybridParallelConfig:
             # authoritative per-layer dp types (dp_types_enc's 0/1 is kept for
             # reference-schema compatibility but cannot distinguish ddp/zero2)
             "dp_type_names": ",".join(s.dp_type for s in ls),
-            "checkpoint": ",".join(str(int(s.ckpt)) for s in ls),
+            "checkpoint": ",".join(str(_CKPT_TO_INT[s.ckpt]) for s in ls),
             "sp_flags": ",".join(str(int(s.sp)) for s in ls),
             "cp_sizes_enc": ",".join(str(s.cp) for s in ls),
             "ep_sizes_enc": ",".join(str(s.ep) for s in ls),
@@ -191,7 +218,7 @@ class HybridParallelConfig:
                 tp=tps[i],
                 tp_consec=bool(consec[i]),
                 dp_type=dp_names[i] if dp_names else ("zero3" if dp_enc[i] == 1 else default_dp),
-                ckpt=bool(ckpt[i]),
+                ckpt=ckpt[i],
                 sp=bool(sp[i]),
                 cp=cp[i],
                 ep=ep[i],
@@ -269,6 +296,8 @@ def form_strategy(s: LayerStrategy, pp: int = 1, dp: int = 1) -> str:
         tag += "s"
     if s.cp > 1:
         tag += f"r{s.cp}"
-    if s.ckpt:
+    if s.ckpt == "full":
         tag += "-c"
+    elif s.ckpt == "selective":
+        tag += "-cs"
     return tag

@@ -36,7 +36,40 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
         cfg = cfg.replace(attn_impl="flash")
     world = len(jax.devices())
     hp = hybrid_config_from_args(ns, cfg.num_layers, world)
-    adam = AdamConfig(lr=ns.lr, weight_decay=ns.weight_decay, grad_clip=ns.grad_clip)
+    lr_schedule = None
+    if getattr(ns, "lr_warmup_iters", 0) or getattr(ns, "lr_decay_iters", 0):
+        from galvatron_tpu.core.schedules import LRSchedule
+
+        lr_schedule = LRSchedule(
+            lr=ns.lr, min_lr=ns.min_lr, warmup_iters=ns.lr_warmup_iters,
+            decay_iters=ns.lr_decay_iters, decay_style=ns.lr_decay_style,
+        )
+    adam = AdamConfig(
+        lr=ns.lr, weight_decay=ns.weight_decay, grad_clip=ns.grad_clip,
+        lr_schedule=lr_schedule,
+    )
+    rampup = None
+    if getattr(ns, "rampup_batch_size", None):
+        from galvatron_tpu.core.schedules import BatchSizeRampup
+
+        if hp.pp > 1:
+            raise ValueError("--rampup_batch_size requires pp=1 (static pipeline shapes)")
+        start, inc, samples = ns.rampup_batch_size
+        rampup = BatchSizeRampup(
+            start=start, increment=inc, rampup_samples=samples,
+            target=ns.global_train_batch_size,
+        )
+        for bs in rampup.sizes():
+            if bs % world != 0:
+                raise ValueError(
+                    f"rampup batch size {bs} must be divisible by the device "
+                    f"count {world} (global batches shard over all data axes)"
+                )
+            if bs % max(1, hp.chunks) != 0:
+                raise ValueError(
+                    f"rampup batch size {bs} must be divisible by chunks "
+                    f"{hp.chunks} (micro-batch gradient accumulation)"
+                )
     seq = cfg.max_seq_len
     rt = build_runtime(
         cfg, hp, adam=adam, global_batch_size=ns.global_train_batch_size, seq_len=seq
@@ -56,26 +89,73 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
     loader = build_dataloader(
         cfg, ns.global_train_batch_size, seq, seed=ns.seed, start_batch=start_step
     )
+    from galvatron_tpu.core.signals import GracefulExitHandler
+    from galvatron_tpu.utils.metrics import MetricsLogger
+
     prof = RuntimeProfiler(warmup_iters=1)
     losses = []
-    for it in range(start_step, ns.train_iters):
-        batch = jnp.asarray(next(loader))
-        prof.begin_iter()
-        state, loss = rt.train_step(state, batch)
-        prof.end_iter(loss if (ns.profile or ns.check_loss) else None)
-        if ns.check_loss or ns.profile:
-            losses.append(float(loss))
-            if verbose:
-                print(f"iter {it}: loss {float(loss):.4f}")
-        if ns.save and ns.save_interval and (it + 1) % ns.save_interval == 0:
-            save_checkpoint(ns.save, state, it + 1)
-            if verbose:
-                print(f"saved step {it + 1} → {ns.save}")
+    # consumed-samples bookkeeping: under rampup, replay the schedule from
+    # step 0 so a resumed run sees exactly the sizes (and per-size stream
+    # positions) an uninterrupted run would
+    consumed = 0
+    batches_at_size: dict = {}
+    if rampup is not None:
+        for _ in range(start_step):
+            b = rampup(consumed)
+            batches_at_size[b] = batches_at_size.get(b, 0) + 1
+            consumed += b
+    else:
+        consumed = start_step * ns.global_train_batch_size
+    consumed_at_start = consumed
+    cur_bs = ns.global_train_batch_size
+    metrics = MetricsLogger(getattr(ns, "metrics_path", None))
+    iters_run = 0
+    with GracefulExitHandler() as exit_handler:
+        for it in range(start_step, ns.train_iters):
+            if exit_handler.signaled is not None:
+                if verbose:
+                    print(f"signal {exit_handler.signaled} received; stopping at iter {it}")
+                break
+            if rampup is not None:
+                bs = rampup(consumed)
+                if bs != cur_bs or it == start_step:
+                    cur_bs = bs
+                    loader = build_dataloader(
+                        cfg, bs, seq, seed=ns.seed + bs,
+                        start_batch=batches_at_size.get(bs, 0),
+                    )
+                batches_at_size[bs] = batches_at_size.get(bs, 0) + 1
+                consumed += bs
+            else:
+                consumed += cur_bs
+            iters_run += 1
+            batch = jnp.asarray(next(loader))
+            prof.begin_iter()
+            state, loss = rt.train_step(state, batch)
+            prof.end_iter(loss if (ns.profile or ns.check_loss) else None)
+            if ns.check_loss or ns.profile:
+                losses.append(float(loss))
+                if verbose:
+                    print(f"iter {it}: loss {float(loss):.4f}")
+            if metrics.path:
+                metrics.log(
+                    "train_iter", step=it, loss=float(loss), batch_size=cur_bs,
+                    iter_ms=(prof.iter_times_ms[-1] if prof.iter_times_ms else None),
+                )
+            if ns.save and ns.save_interval and (it + 1) % ns.save_interval == 0:
+                save_checkpoint(ns.save, state, it + 1)
+                if verbose:
+                    print(f"saved step {it + 1} → {ns.save}")
+    # checkpoint on exit — normal completion or signal (the reference's
+    # dist_signal_handler checkpoint-then-exit pattern, there unused)
     if ns.save:
         final_step = int(np.asarray(state["step"]))
         if latest_step(ns.save) != final_step:
             save_checkpoint(ns.save, state, final_step)
-    report = prof.report(ns.global_train_batch_size, seq) if prof.iter_times_ms else ""
+    metrics.close()
+    # throughput from actual samples processed (rampup runs at smaller sizes)
+    avg_bs = (consumed - consumed_at_start) / iters_run if iters_run else 0
+    report = prof.report(avg_bs, seq) if prof.iter_times_ms else ""
     if verbose and report:
         print(report)
     return {

@@ -287,7 +287,10 @@ def attention(q, k, v, cfg: ModelConfig, bias=None):
     return attention_xla(q, k, v, cfg, bias=bias)
 
 
-def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None):
+def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: bool = False):
+    """``remat_attn`` rematerializes only the attention core (scores/softmax/
+    context) in the backward pass — Megatron's "selective" recompute
+    (reference: galvatron/core/tensor_parallel/transformer.py:597,615-636)."""
     b, s, h = x.shape
     hd = cfg.head_dim
     q = (x @ p["wq"].astype(x.dtype)).reshape(b, s, cfg.num_heads, hd)
@@ -302,7 +305,13 @@ def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None):
         pos = jnp.arange(s)
         rel = pos[None, :] - pos[:, None]  # (q, k) negative below diag
         bias = (alibi[:, None, None] * rel[None]).astype(jnp.float32)[None]  # (1,n,q,k)
-    o = attention(q, k, v, cfg, bias=bias)
+
+    def core(q_, k_, v_, bias_):
+        return attention(q_, k_, v_, cfg, bias=bias_)
+
+    if remat_attn:
+        core = jax.checkpoint(core)
+    o = core(q, k, v, bias)
     return o.reshape(b, s, cfg.num_heads * hd) @ p["wo"].astype(x.dtype)
 
 
@@ -322,8 +331,10 @@ def mlp_block(x, p, cfg: ModelConfig, train: bool = True):
     return jax.nn.gelu(x @ p["w1"].astype(x.dtype), approximate=True) @ p["w2"].astype(x.dtype)
 
 
-def decoder_layer(x, p, cfg: ModelConfig, cos_sin=None, alibi=None):
-    x = x + attn_block(norm(x, p["attn_norm"], cfg), p["attn"], cfg, cos_sin, alibi)
+def decoder_layer(x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: bool = False):
+    x = x + attn_block(
+        norm(x, p["attn_norm"], cfg), p["attn"], cfg, cos_sin, alibi, remat_attn=remat_attn
+    )
     x = x + mlp_block(norm(x, p["mlp_norm"], cfg), p["mlp"], cfg)
     return x
 

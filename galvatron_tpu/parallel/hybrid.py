@@ -130,9 +130,11 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
                 return ring_decoder_layer(
                     x_, lp_, layer_cfg, mesh, axes.cp_axes(s.tp, s.tp_consec, s.cp), cos_sin
                 )
-            return modeling.decoder_layer(x_, lp_, layer_cfg, cos_sin, alibi)
+            return modeling.decoder_layer(
+                x_, lp_, layer_cfg, cos_sin, alibi, remat_attn=(s.ckpt == "selective")
+            )
 
-        if s.ckpt:
+        if s.ckpt == "full":
             run = jax.checkpoint(run)
         return run(x, lp)
 

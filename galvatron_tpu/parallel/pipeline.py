@@ -195,9 +195,11 @@ def make_stage_fn(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axes: 
                     return ring_decoder_layer(
                         x_, lp_, cfg, mesh, axes.cp_axes(s.tp, s.tp_consec, s.cp), cos_sin
                     )
-                return modeling.decoder_layer(x_, lp_, cfg, cos_sin, alibi)
+                return modeling.decoder_layer(
+                    x_, lp_, cfg, cos_sin, alibi, remat_attn=(s.ckpt == "selective")
+                )
 
-            if s.ckpt:
+            if s.ckpt == "full":
                 run = jax.checkpoint(run)
             x = run(x, stage_params[j])
         return x

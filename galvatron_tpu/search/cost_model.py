@@ -154,8 +154,12 @@ def layer_memory_cost(
         states = 4.0 * p_mb + cast
     local_bsz = global_bsz / dp / max(1, s.cp)
     mb_bsz = local_bsz / chunks
+    # 'full' remat stores only the layer-boundary activation; 'selective'
+    # (attention-core-only recompute) stores the same per-layer activations as
+    # no-remat on the flash path — scores are never materialized there — so it
+    # is modeled as act_mb (conservative for the xla-attention path).
     act_per_mb = (
-        lt.boundary_activation_mb_per_sample if s.ckpt else lt.act_mb(s.tp, s.sp, s.cp)
+        lt.boundary_activation_mb_per_sample if s.ckpt == "full" else lt.act_mb(s.tp, s.sp, s.cp)
     ) * mb_bsz
     if pp == 1:
         act = act_per_mb  # accumulation scan keeps one micro-batch live
@@ -212,7 +216,9 @@ def layer_time_cost(
     dp = world // (pp * s.tp * s.cp)
     local_bsz = global_bsz / dp / max(1, s.cp)
     fwd = lt.fwd_ms_per_sample * local_bsz / s.tp
-    compute = fwd * (3.0 if not s.ckpt else 4.0)  # fwd + 2×bwd (+ recompute)
+    # fwd + 2×bwd; full remat adds one fwd replay, selective replays only the
+    # attention core (~1/3 of layer FLOPs at reference shapes)
+    compute = fwd * (4.0 if s.ckpt == "full" else 3.33 if s.ckpt == "selective" else 3.0)
 
     comm_bytes_factor = 0.5 if mixed_precision == "bf16" else 1.0
     # TP: 2 allreduces fwd + 2 bwd of one (b, s, h) activation (Megatron f/g;
@@ -220,8 +226,10 @@ def layer_time_cost(
     act_msg = lt.boundary_activation_mb_per_sample * local_bsz * comm_bytes_factor
     tp_bw = hw.bw(s.tp, s.tp_consec)
     tp_ms = 4.0 * _allreduce_ms(act_msg, s.tp, tp_bw)
-    if s.ckpt:
-        tp_ms *= 1.5  # recompute replays the forward collectives
+    if s.ckpt == "full":
+        tp_ms *= 1.5  # full recompute replays the forward collectives
+    # (selective recompute replays no TP collectives: the attention core sits
+    # between the column- and row-parallel linears)
     # CP: ring passes K/V once around per step — volume ≈ 2·(seq-sharded kv)
     cp_ms = 0.0
     if s.cp > 1:

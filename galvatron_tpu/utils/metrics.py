@@ -1,0 +1,63 @@
+"""Structured metrics sink.
+
+The reference's observability is print-based (SURVEY §5: loss printer
+utils/training_utils.py:25-38, search progress prints, no structured sink;
+the vendored Megatron tensorboard writer is unused). Here: a JSONL metrics
+log — one flat JSON object per event with a monotonic step and wall-clock
+timestamp — cheap, greppable, and loadable into anything.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics writer; no-op when ``path`` is None."""
+
+    def __init__(self, path: Optional[str] = None, flush_every: int = 1):
+        self.path = path
+        self._f = None
+        self._n = 0
+        self.flush_every = max(1, flush_every)
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._f = open(path, "a")
+
+    def log(self, event: str, step: Optional[int] = None, **fields: Any) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {"event": event, "ts": time.time()}
+        if step is not None:
+            rec["step"] = int(step)
+        for k, v in fields.items():
+            # scalars only: cast numpy/jax 0-d arrays, reject structures
+            if hasattr(v, "item"):
+                v = v.item()
+            if not isinstance(v, (int, float, str, bool, type(None))):
+                raise TypeError(f"metric {k!r} must be scalar, got {type(v).__name__}")
+            rec[k] = v
+        if self._f is not None:
+            self._f.write(json.dumps(rec) + "\n")
+            self._n += 1
+            if self._n % self.flush_every == 0:
+                self._f.flush()
+        return rec
+
+    def close(self):
+        if self._f is not None:
+            self._f.flush()
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_metrics(path: str):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]

@@ -1,0 +1,66 @@
+"""Named wall-clock timers (reference: site_package/megatron/timers.py:123
+``Timers`` — start/stop/elapsed named timers with a log-string formatter;
+that implementation barriers over torch.distributed and reads CUDA events,
+neither of which exists here: on TPU the caller is responsible for
+``jax.block_until_ready`` at measurement boundaries, which the runtime
+profiler (galvatron_tpu.profiling.runtime) already does)."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self._elapsed = 0.0
+        self._started: Optional[float] = None
+        self.count = 0
+
+    def start(self):
+        if self._started is not None:
+            raise RuntimeError(f"timer {self.name!r} already started")
+        self._started = time.perf_counter()
+
+    def stop(self):
+        if self._started is None:
+            raise RuntimeError(f"timer {self.name!r} not started")
+        self._elapsed += time.perf_counter() - self._started
+        self._started = None
+        self.count += 1
+
+    def elapsed(self, reset: bool = False) -> float:
+        """Total elapsed seconds (not counting a currently-running interval)."""
+        e = self._elapsed
+        if reset:
+            self._elapsed = 0.0
+            self.count = 0
+        return e
+
+
+class Timers:
+    """``timers('fwd').start() ... .stop(); timers.log(['fwd'])``"""
+
+    def __init__(self):
+        self._timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self._timers:
+            self._timers[name] = _Timer(name)
+        return self._timers[name]
+
+    def names(self) -> List[str]:
+        return list(self._timers)
+
+    def log_string(
+        self, names: Optional[List[str]] = None, normalizer: float = 1.0, reset: bool = True
+    ) -> str:
+        """(reference: Timers.log, megatron/timers.py — 'time (ms)' line)"""
+        assert normalizer > 0.0
+        parts = []
+        for name in names or self.names():
+            if name in self._timers:
+                ms = self._timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}")
+        return "time (ms) | " + " | ".join(parts)

@@ -82,6 +82,9 @@ STRATEGIES = {
         4, tp=1, dp_type="zero2", mixed_precision="fp32", vocab_tp=1
     ),
     "ckpt": HybridParallelConfig.uniform(4, tp=2, ckpt=True, mixed_precision="fp32", vocab_tp=2),
+    "ckpt_selective": HybridParallelConfig.uniform(
+        4, tp=2, ckpt="selective", mixed_precision="fp32", vocab_tp=2
+    ),
     "accum2": HybridParallelConfig.uniform(4, tp=1, mixed_precision="fp32", vocab_tp=1, chunks=2),
     "hetero": HybridParallelConfig(
         pp=1,

@@ -1,0 +1,120 @@
+"""LR schedules, batch-size ramp-up, fp16 loss scaler (SURVEY §2.6 aux
+subsystems: megatron optimizer_param_scheduler / microbatches.py /
+optimizer/grad_scaler.py equivalents)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from galvatron_tpu.core.optim import AdamConfig, adamw_update, init_opt_state
+from galvatron_tpu.core.schedules import (
+    BatchSizeRampup,
+    LossScalerConfig,
+    LRSchedule,
+    all_finite,
+    init_scaler_state,
+    scaled_grads_fn,
+    scaler_update,
+)
+
+
+def test_lr_warmup_and_cosine_decay():
+    s = LRSchedule(lr=1e-3, min_lr=1e-4, warmup_iters=10, decay_iters=110, decay_style="cosine")
+    assert s(0) == pytest.approx(0.0)
+    assert s(5) == pytest.approx(5e-4)
+    assert s(10) == pytest.approx(1e-3)
+    # halfway through decay: midpoint of lr and min_lr
+    assert s(60) == pytest.approx((1e-3 + 1e-4) / 2, rel=1e-5)
+    assert s(110) == pytest.approx(1e-4)
+    assert s(10_000) == pytest.approx(1e-4)  # constant after decay end
+
+
+def test_lr_linear_and_constant():
+    lin = LRSchedule(lr=2.0, min_lr=0.0, warmup_iters=0, decay_iters=100, decay_style="linear")
+    assert lin(50) == pytest.approx(1.0)
+    const = LRSchedule(lr=3.0, decay_style="constant", warmup_iters=4)
+    assert const(2) == pytest.approx(1.5)
+    assert const(1000) == pytest.approx(3.0)
+
+
+def test_lr_traceable_under_jit():
+    s = LRSchedule(lr=1e-3, warmup_iters=5, decay_iters=50, decay_style="linear")
+    f = jax.jit(lambda step: s(step))
+    assert float(f(jnp.asarray(5.0))) == pytest.approx(1e-3)
+
+
+def test_lr_schedule_inside_adamw():
+    """The schedule is evaluated from the optimizer step count inside the
+    (jittable) update: step 0 with warmup must apply ~zero lr."""
+    sched = LRSchedule(lr=1.0, warmup_iters=100, decay_iters=0)
+    cfg = AdamConfig(lr=1.0, grad_clip=None, lr_schedule=sched)
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    opt = init_opt_state(params)
+    grads = {"w": jnp.ones((4,), jnp.float32)}
+    p1, opt = adamw_update(params, grads, opt, cfg)
+    # step index 0 → lr 0 → params unchanged
+    np.testing.assert_allclose(p1["w"], params["w"], atol=1e-7)
+    p2, opt = adamw_update(p1, grads, opt, cfg)
+    # step index 1 → lr = 1/100 → visible movement
+    assert float(jnp.abs(p2["w"] - p1["w"]).max()) > 1e-4
+
+
+def test_rampup_batch_size():
+    r = BatchSizeRampup(start=8, increment=8, rampup_samples=64, target=32)
+    # 3 increments over 64 samples → each size held ~21 samples
+    assert r(0) == 8
+    assert r(22) == 16
+    assert r(43) == 24
+    assert r(64) == 32
+    assert r(10_000) == 32
+    assert r.sizes() == [8, 16, 24, 32]
+    with pytest.raises(ValueError):
+        BatchSizeRampup(start=8, increment=5, rampup_samples=64, target=32)
+
+
+def test_loss_scaler_growth_and_backoff():
+    cfg = LossScalerConfig(initial_scale=16.0, growth_interval=2, min_scale=1.0)
+    st = init_scaler_state(cfg)
+    st = scaler_update(st, jnp.asarray(True), cfg)
+    assert float(st["scale"]) == 16.0 and int(st["good_steps"]) == 1
+    st = scaler_update(st, jnp.asarray(True), cfg)  # 2nd clean step → grow
+    assert float(st["scale"]) == 32.0 and int(st["good_steps"]) == 0
+    st = scaler_update(st, jnp.asarray(False), cfg)  # overflow → backoff
+    assert float(st["scale"]) == 16.0 and int(st["good_steps"]) == 0
+
+
+def test_scaled_grads_detect_overflow():
+    def loss_fn(p, b):
+        return jnp.sum(p["w"] * b)
+
+    state = init_scaler_state(LossScalerConfig(initial_scale=4.0))
+    run = scaled_grads_fn(loss_fn, state)
+    p = {"w": jnp.ones((2,), jnp.float32)}
+    loss, grads, finite = run(p, jnp.ones((2,), jnp.float32))
+    assert bool(finite)
+    np.testing.assert_allclose(grads["w"], [1.0, 1.0], rtol=1e-6)
+    assert float(loss) == pytest.approx(2.0)
+    _, _, finite2 = run(p, jnp.asarray([jnp.inf, 1.0], jnp.float32))
+    assert not bool(finite2)
+    assert not bool(all_finite({"g": jnp.asarray([jnp.nan])}))
+
+
+def test_trainer_rampup_and_schedule_integration():
+    from galvatron_tpu.core.arguments import initialize_galvatron
+    from galvatron_tpu.core.trainer import train
+
+    ns = initialize_galvatron(
+        "train",
+        [
+            "--model_size", "llama-0.3b", "--num_layers", "2", "--hidden_size", "64",
+            "--num_heads", "4", "--vocab_size", "128", "--seq_length", "16",
+            "--global_train_batch_size", "16", "--train_iters", "4",
+            "--rampup_batch_size", "8", "8", "16",
+            "--lr_warmup_iters", "10", "--lr_decay_iters", "20",
+            "--check_loss", "1", "--mixed_precision", "fp32",
+        ],
+    )
+    out = train(ns, verbose=False)
+    assert len(out["losses"]) == 4
+    assert all(np.isfinite(out["losses"]))

@@ -41,10 +41,31 @@ def test_json_roundtrip(tmp_path):
     assert [s.tp_consec for s in hp2.layer_strategies] == [True, False, True, True]
     # dp_type_names preserves the exact per-layer dp types
     assert [s.dp_type for s in hp2.layer_strategies] == ["zero3", "ddp", "zero2", "ddp"]
-    assert [s.ckpt for s in hp2.layer_strategies] == [True, False, False, False]
+    assert [s.ckpt for s in hp2.layer_strategies] == ["full", False, False, False]
     assert [s.sp for s in hp2.layer_strategies] == [False, False, True, False]
     assert [s.cp for s in hp2.layer_strategies] == [1, 1, 1, 2]
     assert hp2.pp_division == hp.pp_division
+
+
+def test_ckpt_modes():
+    # normalization: bool/int/str all accepted, canonical False | 'full' | 'selective'
+    assert LayerStrategy(ckpt=True).ckpt == "full"
+    assert LayerStrategy(ckpt=1).ckpt == "full"
+    assert LayerStrategy(ckpt=2).ckpt == "selective"
+    assert LayerStrategy(ckpt=False).ckpt is False
+    assert not LayerStrategy(ckpt=0).ckpt
+    with pytest.raises(ValueError):
+        LayerStrategy(ckpt="sometimes")
+    # selective survives the JSON roundtrip (encoded as 2)
+    hp = HybridParallelConfig(
+        pp=1,
+        layer_strategies=[LayerStrategy(ckpt="selective"), LayerStrategy(ckpt="full")],
+    )
+    d = hp.to_json_dict()
+    assert d["checkpoint"] == "2,1"
+    hp2 = HybridParallelConfig.from_json_dict(d)
+    assert [s.ckpt for s in hp2.layer_strategies] == ["selective", "full"]
+    assert form_strategy(LayerStrategy(tp=2, ckpt="selective")) == "1-2-1-cs"
 
 
 def test_json_roundtrip_preserves_zero2_vs_ddp():
