@@ -50,6 +50,7 @@ class ModelConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     attn_impl: str = "xla"  # 'xla' | 'flash' | 'ring'
+    fused_norm: bool = True  # Pallas fused rms/layernorm on TPU (jnp on CPU)
     dtype: Any = jnp.bfloat16  # compute dtype
     param_dtype: Any = jnp.float32
     # Mixture-of-Experts (SwitchMLP equivalent, reference:
@@ -201,6 +202,15 @@ def model_annotations(cfg: ModelConfig) -> Params:
 
 
 def norm(x, p, cfg: ModelConfig):
+    """RMSNorm / LayerNorm; Pallas fused kernel on TPU when cfg.fused_norm
+    (reference fused-norm CUDA ops: megatron fused_layer_norm / rms_norm,
+    flash-attn dropout_add_rms_norm — SURVEY §2.1)."""
+    if cfg.fused_norm:
+        from galvatron_tpu.ops import fused_norm
+
+        if cfg.norm_type == "rms":
+            return fused_norm.fused_rmsnorm(x, p["scale"], cfg.norm_eps)
+        return fused_norm.fused_layernorm(x, p["scale"], p["bias"], cfg.norm_eps)
     dt = x.dtype
     x32 = x.astype(jnp.float32)
     if cfg.norm_type == "rms":
