@@ -57,6 +57,11 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             return 2
         if ns.time_profile_path and ns.memory_profile_path:
             costs = load_profiled_model(ns.time_profile_path, ns.memory_profile_path)
+        elif ns.analytic_costs or ns.check_cost_model:
+            from galvatron_tpu.search.theoretical import analytic_model_costs
+
+            print("using analytic (unprofiled) model costs")
+            costs = analytic_model_costs(cfg)
         else:
             print("no profiled model data given; profiling in-process (measured on this host)")
             costs = profile_model(cfg, bsz=ns.min_bsz)
@@ -96,6 +101,14 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             memory_budget_mb=ns.memory_constraint_gb * 1024.0,
             mixed_precision="bf16",
         )
+        if ns.check_cost_model:
+            bsz = ns.settle_bsz if ns.settle_bsz > 0 else ns.min_bsz
+            print(eng.check_cost_model(bsz, chunks=1, pp=1))
+            from galvatron_tpu.search.theoretical import report as theo_report
+            from galvatron_tpu.core.strategy import LayerStrategy as _LS
+
+            print(theo_report(cfg, _LS(), ns.num_devices).lines())
+            return 0
         if ns.settle_bsz > 0:
             bszs = [ns.settle_bsz]
         else:

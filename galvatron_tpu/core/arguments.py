@@ -101,6 +101,12 @@ def _add_search_args(p: argparse.ArgumentParser):
     g.add_argument("--disable_tp_consec", type=int, default=0)
     g.add_argument("--enable_cp", type=int, default=0)
     g.add_argument("--max_tp_deg", type=int, default=8)
+    g.add_argument("--analytic_costs", type=int, default=0,
+                   help="1 = search on analytic (unprofiled) model costs "
+                   "(theoretical_memory_usage equivalent)")
+    g.add_argument("--check_cost_model", type=int, default=0,
+                   help="print the predicted per-strategy memory/time table "
+                   "instead of searching (developer harness)")
     g.add_argument("--time_profile_path", type=str, default=None)
     g.add_argument("--memory_profile_path", type=str, default=None)
     g.add_argument("--hardware_profile_path", type=str, default=None)

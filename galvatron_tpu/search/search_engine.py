@@ -267,6 +267,41 @@ class SearchEngine:
             )
         return best
 
+    def check_cost_model(
+        self, global_bsz: int, chunks: int = 1, pp: int = 1,
+        pipeline_type: str = "gpipe", strategies: Optional[Sequence[LayerStrategy]] = None,
+    ) -> str:
+        """Developer harness: per-strategy predicted memory/time table for
+        manual comparison against profiled reality (reference:
+        GalvatronSearchEngine.check_cost_model, search_engine.py:369-421).
+        Returns the formatted table (also useful in tests)."""
+        world = self.space.world_size
+        cands = list(strategies) if strategies else generate_layer_strategies(self.space, pp)
+        lt = self._layer_type(0)
+        lines = [
+            f"check_cost_model: bsz={global_bsz} chunks={chunks} pp={pp} "
+            f"{pipeline_type} world={world}",
+            f"{'strategy':>16} | {'states MB':>9} | {'act MB':>8} | {'total MB':>8} | {'time ms':>8}",
+        ]
+        for s in cands:
+            dp = world // (pp * s.tp * s.cp)
+            mc = layer_memory_cost(
+                lt, s, world, pp, global_bsz, chunks, stage_idx=0,
+                pipeline_type=pipeline_type, mixed_precision=self.mp,
+            )
+            t = layer_time_cost(lt, s, self.hw, world, pp, global_bsz, mixed_precision=self.mp)
+            lines.append(
+                f"{form_strategy(s, pp, dp):>16} | {mc.states_mb:9.1f} | "
+                f"{mc.activation_mb:8.1f} | {mc.total_mb:8.1f} | {t:8.2f}"
+            )
+        other = other_memory_cost(
+            self.costs, world, pp, vocab_tp=1,
+            embed_dp_type="zero3" if pp == 1 else "ddp",
+            global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,
+        )
+        lines.append(f"other (embed/head) memory: {other:.1f} MB")
+        return "\n".join(lines)
+
     def save_result(self, result: SearchResult, path: str) -> None:
         d = result.config.to_json_dict()
         d["search_cost_ms"] = result.cost_ms

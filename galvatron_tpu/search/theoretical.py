@@ -1,0 +1,188 @@
+"""Analytic (no-profiling) parameter/memory estimates from a model config.
+
+Counterpart of the vendored Megatron ``theoretical_memory_usage.py``
+(reference: site_package/megatron/theoretical_memory_usage.py — unused by the
+reference's own trainer, SURVEY §2.6), re-derived for this runtime:
+
+- exact parameter counts from ModelConfig (GQA, SwiGLU/GeLU, tied embeddings);
+- model-state memory per chip under a LayerStrategy (fp32 master + 2 Adam
+  moments + optional bf16 working cast; ZeRO-2 shards moments, ZeRO-3 all);
+- activation estimates per layer per sample for the three attention paths
+  (flash never materializes the (S, S) score matrix; xla does).
+
+Useful to seed the search before any profiling has run, and as the
+cross-check for the profiler's measured numbers (``check_cost_model``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+from galvatron_tpu.core.strategy import LayerStrategy
+from galvatron_tpu.models.modeling import ModelConfig
+
+_BYTES = {"fp32": 4, "bf16": 2, "fp16": 2}
+
+
+def layer_param_count(cfg: ModelConfig) -> int:
+    """Exact per-decoder-layer parameter count (matches init_layer_params)."""
+    h, hd = cfg.hidden_size, cfg.head_dim
+    q_out, kv_out = cfg.num_heads * hd, cfg.kv_heads * hd
+    attn = h * q_out + 2 * h * kv_out + q_out * h
+    if cfg.moe_experts > 0:
+        # router + per-expert swiglu MLPs
+        mlp = h * cfg.moe_experts + cfg.moe_experts * 3 * h * cfg.ffn
+    elif cfg.act_fn == "swiglu":
+        mlp = 3 * h * cfg.ffn
+    else:
+        mlp = 2 * h * cfg.ffn
+    norms = 2 * h if cfg.norm_type == "rms" else 4 * h
+    return attn + mlp + norms
+
+
+def other_param_count(cfg: ModelConfig) -> int:
+    """Embedding + final norm + LM head."""
+    n = cfg.vocab_size * cfg.hidden_size  # token embedding
+    if cfg.pos_embed == "learned":
+        n += cfg.max_seq_len * cfg.hidden_size
+    n += cfg.hidden_size if cfg.norm_type == "rms" else 2 * cfg.hidden_size
+    if not cfg.tie_word_embeddings:
+        n += cfg.hidden_size * cfg.vocab_size
+    return n
+
+
+def total_param_count(cfg: ModelConfig) -> int:
+    return cfg.num_layers * layer_param_count(cfg) + other_param_count(cfg)
+
+
+def layer_states_mb(
+    cfg: ModelConfig, s: LayerStrategy, world: int, pp: int = 1,
+    mixed_precision: str = "bf16",
+) -> float:
+    """Per-chip model-state MB for one layer under strategy ``s`` — the
+    analytic form of layer_memory_cost's states term."""
+    dp = world // (pp * s.tp * s.cp)
+    p_mb = layer_param_count(cfg) * 4 / 1e6 / s.tp  # fp32 MB after TP
+    cast = 0.5 * p_mb if mixed_precision == "bf16" else 0.0
+    if s.dp_type == "zero3":
+        return 4.0 * p_mb / dp + cast
+    if s.dp_type == "zero2":
+        return 2.0 * p_mb + 2.0 * p_mb / dp + cast
+    return 4.0 * p_mb + cast
+
+
+def layer_activation_mb_per_sample(
+    cfg: ModelConfig, s: LayerStrategy, seq_len: int = 0,
+    mixed_precision: str = "bf16",
+) -> float:
+    """Analytic activation MB per layer per sample, no remat.
+
+    Derivation (per token, compute dtype bytes b): residual h, two norm
+    outputs 2h, qkv (1 + 2·kv/n)·h·(n·hd/h), attention context h, mlp inputs
+    h + {3 ffn (swiglu: w1 out, w3 out, product) | 2 ffn (gelu)}. The xla
+    attention path additionally saves the (n_heads, S, S) probs in fp32;
+    flash saves only the (S, 1) LSE. TP divides the sharded intermediates;
+    SP additionally shards the replicated residual/norm tensors.
+    """
+    S = seq_len or cfg.max_seq_len
+    h, n, kvn, hd = cfg.hidden_size, cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    b = _BYTES[mixed_precision]
+    tp = s.tp
+    # replicated (residual stream + norm inputs): sharded only under SP
+    repl = 3 * h * b / (tp if s.sp else 1)
+    # TP-sharded intermediates
+    qkv = (n + 2 * kvn) * hd * b / tp
+    ctx = n * hd * b / tp
+    if cfg.moe_experts > 0:
+        mlp = 3 * cfg.ffn * b / tp  # per routed token (capacity ~1)
+    elif cfg.act_fn == "swiglu":
+        mlp = 3 * cfg.ffn * b / tp
+    else:
+        mlp = 2 * cfg.ffn * b / tp
+    per_token = repl + qkv + ctx + mlp
+    total = per_token * S
+    if cfg.attn_impl == "xla":
+        total += 4.0 * (n / tp) * S * S  # fp32 probs
+    else:
+        total += 4.0 * (n / tp) * S  # flash LSE
+    return total / 1e6 / max(1, s.cp)
+
+
+def analytic_model_costs(
+    cfg: ModelConfig, seq_len: int = 0, peak_tflops: float = 100.0, mfu: float = 0.4,
+    mixed_precision: str = "bf16",
+):
+    """ProfiledModelCosts from pure analysis — lets the search run before any
+    profiling exists (the reference cannot: it always requires profiled JSON,
+    search_engine.py:92-121). fwd time from the 2·P·T FLOP estimate at an
+    assumed MFU; activation table from layer_activation_mb_per_sample."""
+    from galvatron_tpu.search.cost_model import ProfiledLayerType, ProfiledModelCosts
+
+    S = seq_len or cfg.max_seq_len
+    b = _BYTES[mixed_precision]
+    p_layer = layer_param_count(cfg)
+    flops = 2.0 * p_layer * S  # fwd multiply-accumulate per sample
+    if cfg.attn_impl == "xla" or cfg.attn_impl == "flash":
+        flops += 2.0 * 2.0 * cfg.num_heads * cfg.head_dim * S * S  # qk^T + pv
+    fwd_ms = flops / (peak_tflops * 1e12 * mfu) * 1e3
+    act = {
+        tp: layer_activation_mb_per_sample(
+            cfg, LayerStrategy(tp=tp), S, mixed_precision
+        )
+        for tp in (1, 2, 4, 8)
+        if cfg.hidden_size % tp == 0
+    }
+    other_p = other_param_count(cfg)
+    # logits dominate "other" activation
+    other_act = S * cfg.vocab_size * b / 1e6
+    other_flops = 2.0 * cfg.hidden_size * cfg.vocab_size * S
+    return ProfiledModelCosts(
+        layer_types={
+            0: ProfiledLayerType(
+                fwd_ms_per_sample=fwd_ms,
+                parameter_mb=p_layer * 4 / 1e6,
+                activation_mb_per_sample=act,
+                boundary_activation_mb_per_sample=S * cfg.hidden_size * b / 1e6,
+            )
+        },
+        other_param_mb=other_p * 4 / 1e6,
+        other_act_mb_per_sample=other_act,
+        other_fwd_ms_per_sample=other_flops / (peak_tflops * 1e12 * mfu) * 1e3,
+    )
+
+
+@dataclass
+class TheoreticalReport:
+    total_params: int
+    per_layer_params: int
+    other_params: int
+    layer_states_mb: float
+    layer_act_mb_per_sample: float
+    model_states_total_mb: float
+
+    def lines(self) -> str:
+        return (
+            f"params: total {self.total_params/1e9:.3f}B "
+            f"(layer {self.per_layer_params/1e6:.1f}M x N + other {self.other_params/1e6:.1f}M)\n"
+            f"per-chip layer states: {self.layer_states_mb:.1f} MB | "
+            f"layer activation/sample: {self.layer_act_mb_per_sample:.2f} MB | "
+            f"all-layer states: {self.model_states_total_mb:.0f} MB"
+        )
+
+
+def report(
+    cfg: ModelConfig, s: LayerStrategy, world: int, pp: int = 1,
+    seq_len: int = 0, mixed_precision: str = "bf16",
+) -> TheoreticalReport:
+    lsm = layer_states_mb(cfg, s, world, pp, mixed_precision)
+    return TheoreticalReport(
+        total_params=total_param_count(cfg),
+        per_layer_params=layer_param_count(cfg),
+        other_params=other_param_count(cfg),
+        layer_states_mb=lsm,
+        layer_act_mb_per_sample=layer_activation_mb_per_sample(
+            cfg, s, seq_len, mixed_precision
+        ),
+        model_states_total_mb=lsm * (cfg.num_layers // pp),
+    )
