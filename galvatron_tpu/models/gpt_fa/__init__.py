@@ -1,0 +1,17 @@
+"""GPT flash-attention family entry (reference: galvatron/models/gpt_fa/ —
+the flash-attn GPT backbone variant of gpt_hf, models/gpt_fa/
+GPTModel_tensor_parallel.py:1-14).
+
+Same sizes as the gpt family; ``attn_impl='flash'`` (the Pallas kernel,
+galvatron_tpu.ops.flash_attention) forced by default — see
+galvatron_tpu.models.llama_fa for the design note.
+"""
+
+from galvatron_tpu.models.gpt import SIZES  # noqa: F401 — same sizes
+from galvatron_tpu.models.llama_fa import fa_main
+
+DEFAULT_MODEL = "gpt-1.5b"
+
+
+def main(argv=None):
+    return fa_main(argv, DEFAULT_MODEL)

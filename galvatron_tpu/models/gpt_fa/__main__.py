@@ -1,0 +1,3 @@
+from galvatron_tpu.models.gpt_fa import main
+
+raise SystemExit(main())

@@ -1,0 +1,37 @@
+"""LLaMA flash-attention family entry (reference: galvatron/models/llama_fa/ —
+the flash-attn GPT backbone variant of llama_hf, models/llama_fa/
+LlamaModel_tensor_parallel.py:1-14).
+
+On TPU the flash path is the Pallas flash-attention kernel
+(galvatron_tpu.ops.flash_attention) rather than an alternative backbone: the
+same functional model runs with ``attn_impl='flash'`` forced, which this entry
+defaults (the reference's *_fa families likewise exist to pin the fused
+attention implementation and its BSH activation layout; here the layout is
+XLA's concern).
+"""
+
+from galvatron_tpu.models.llama import SIZES  # noqa: F401 — same sizes
+
+DEFAULT_MODEL = "llama-7b"
+
+# modes whose arg parser carries --attn_impl (train/profile share training args)
+_ATTN_MODES = ("train", "train_dist", "profile")
+
+
+def fa_main(argv, model_default: str):
+    """Shared *_fa entry: forward to the CLI with the family's size default
+    and ``--attn_impl flash`` injected unless the user chose an impl."""
+    import sys
+
+    from galvatron_tpu.cli import main as cli_main
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in _ATTN_MODES and not any(
+        a == "--attn_impl" or a.startswith("--attn_impl=") for a in argv
+    ):
+        argv += ["--attn_impl", "flash"]
+    return cli_main(argv, model_default=model_default)
+
+
+def main(argv=None):
+    return fa_main(argv, DEFAULT_MODEL)

@@ -120,6 +120,34 @@ def test_checkpoint_cross_strategy_resume(tmp_path):
     assert np.isfinite(a).all()
 
 
+def test_fa_family_entries_force_flash(monkeypatch):
+    """gpt_fa / llama_fa (reference: galvatron/models/{gpt,llama}_fa/) pin the
+    flash-attention path; verify the default injection without running a step
+    (the Pallas kernel itself is covered by test_ops)."""
+    from galvatron_tpu.models import gpt_fa, llama_fa
+
+    captured = {}
+
+    def fake_cli(argv, model_default=None):
+        captured["argv"] = list(argv)
+        captured["model_default"] = model_default
+        return 0
+
+    import galvatron_tpu.cli as cli_mod
+
+    monkeypatch.setattr(cli_mod, "main", fake_cli)
+    assert llama_fa.main(["train", "--train_iters", "1"]) == 0
+    assert captured["argv"][-2:] == ["--attn_impl", "flash"]
+    assert captured["model_default"] == "llama-7b"
+    # explicit user choice wins
+    assert llama_fa.main(["train", "--attn_impl", "xla"]) == 0
+    assert captured["argv"].count("--attn_impl") == 1
+    # non-training modes don't get the flag (their parsers lack it)
+    assert gpt_fa.main(["search"]) == 0
+    assert "--attn_impl" not in captured["argv"]
+    assert captured["model_default"] == "gpt-1.5b"
+
+
 def test_model_family_entries(capsys):
     from galvatron_tpu.models import baichuan, gpt, llama
 
