@@ -109,7 +109,8 @@ def test_strategy_space_generation():
     tags = {(s.tp, s.tp_consec, s.dp_type, s.ckpt, s.sp) for s in cands}
     assert (1, True, "ddp", False, False) in tags
     assert (8, True, "ddp", False, True) in tags  # full TP + SP
-    assert (2, False, "zero3", True, False) in tags  # strided + fsdp + ckpt
+    # strided + fsdp + ckpt (ckpt=True normalizes to 'full', strategy.py)
+    assert (2, False, "zero3", "full", False) in tags
     assert all(s.tp * s.cp <= 8 for s in cands)
     # pp=4: per-stage device budget shrinks
     cands4 = generate_layer_strategies(space, pp=4)
