@@ -53,7 +53,10 @@ def _add_training_args(p: argparse.ArgumentParser):
     g.add_argument("--weight_decay", type=float, default=0.01)
     g.add_argument("--grad_clip", type=float, default=1.0)
     g.add_argument("--seed", type=int, default=1234)
-    g.add_argument("--mixed_precision", type=str, default="bf16", choices=["fp32", "bf16"])
+    g.add_argument("--mixed_precision", type=str, default="bf16",
+                   choices=["fp32", "bf16", "fp16"],
+                   help="fp16 adds dynamic loss scaling (skip-on-overflow); "
+                   "bf16 is the TPU-native choice")
     g.add_argument("--check_loss", type=int, default=0)
     g.add_argument("--profile", type=int, default=0, help="print per-iter time/memory")
     # hybrid-parallel GLOBAL flags (used when no galvatron_config_path)
@@ -69,6 +72,10 @@ def _add_training_args(p: argparse.ArgumentParser):
     )
     g.add_argument("--sequence_parallel", type=int, default=0)
     g.add_argument("--context_parallel_deg", type=int, default=1)
+    g.add_argument("--context_parallel_impl", type=str, default="ring",
+                   choices=["ring", "a2a"],
+                   help="ring = K/V rotation; a2a = Ulysses sequence/head "
+                   "all-to-all (needs num_heads divisible by the CP degree)")
     g.add_argument("--chunks", type=int, default=-1, help="-1 = heuristic")
     g.add_argument("--pipeline_type", type=str, default="gpipe", choices=["gpipe", "pipedream_flush"])
     g.add_argument("--vocab_tp", type=int, default=1)
@@ -228,6 +235,7 @@ def hybrid_config_from_args(ns: argparse.Namespace, num_layers: int, world: int)
             ckpt=ns.global_checkpoint,
             sp=bool(ns.sequence_parallel),
             cp=ns.context_parallel_deg,
+            cp_impl=ns.context_parallel_impl,
             chunks=chunks,
             pipeline_type=ns.pipeline_type,
             vocab_tp=ns.vocab_tp,

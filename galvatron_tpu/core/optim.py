@@ -50,6 +50,30 @@ def global_norm(tree) -> jax.Array:
     )
 
 
+def apply_update_with_scaler(state, loss, grads, adam: "AdamConfig", scaler_cfg):
+    """fp16 train-state transition: AdamW update skipped atomically on
+    gradient overflow, dynamic loss scale advanced (reference:
+    site_package/megatron/optimizer/grad_scaler.py DynamicGradScaler +
+    the skipped-iteration handling in megatron optimizer step).
+
+    ``grads`` must already be unscaled. ``state`` carries a ``scaler`` entry
+    from ``galvatron_tpu.core.schedules.init_scaler_state``.
+    """
+    import jax.numpy as jnp  # noqa: F811 — keep local for clarity
+
+    from galvatron_tpu.core.schedules import all_finite, scaler_update
+
+    finite = all_finite(grads) & jnp.isfinite(loss)
+    new_params, new_opt = adamw_update(state["params"], grads, state["opt"], adam)
+    select = lambda new, old: jax.tree.map(lambda a, b: jnp.where(finite, a, b), new, old)
+    return {
+        "params": select(new_params, state["params"]),
+        "opt": select(new_opt, state["opt"]),  # count advances only on clean steps
+        "step": state["step"] + 1,
+        "scaler": scaler_update(state["scaler"], finite, scaler_cfg),
+    }, loss
+
+
 def adamw_update(params, grads, opt_state, cfg: AdamConfig, lr_scale=1.0):
     """One AdamW step in fp32 master precision; returns (params, opt_state)."""
     count = opt_state["count"] + 1

@@ -162,21 +162,19 @@ def scaler_update(state: Dict[str, Any], finite, cfg: LossScalerConfig):
     return {"scale": new_scale, "good_steps": new_good}
 
 
-def scaled_grads_fn(loss_fn, scaler_state):
-    """Wrap ``loss_fn(params, batch) -> loss`` so gradients are computed on
-    ``loss * scale`` and then unscaled — the fp16 pattern. Returns
-    ``(loss, grads, finite)``; on overflow the caller must skip the update and
-    feed ``finite`` to ``scaler_update``."""
+def scaled_value_and_grad(loss_fn, scale):
+    """``value_and_grad`` with the fp16 loss-scaling pattern: the backward
+    runs on ``loss * scale``, gradients come back unscaled in fp32, the loss
+    value is exact (un-scaled primal). One definition of the overflow-
+    sensitive numerics shared by the pp=1 and GPipe train steps; finiteness
+    checking lives in ``optim.apply_update_with_scaler``."""
 
-    def run(params, batch):
-        scale = scaler_state["scale"]
-
+    def run(params, *args):
         def scaled(p):
-            return loss_fn(p, batch) * scale
+            l = loss_fn(p, *args)
+            return l * scale, l
 
-        sloss, sgrads = jax.value_and_grad(scaled)(params)
-        grads = jax.tree.map(lambda g: g.astype(jnp.float32) / scale, sgrads)
-        finite = all_finite(grads) & jnp.isfinite(sloss)
-        return sloss / scale, grads, finite
+        (_, loss), sgrads = jax.value_and_grad(scaled, has_aux=True)(params)
+        return loss, jax.tree.map(lambda g: g.astype(jnp.float32) / scale, sgrads)
 
     return run

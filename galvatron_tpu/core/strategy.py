@@ -63,8 +63,11 @@ class LayerStrategy:
       sp: Megatron-style sequence parallelism — activations sequence-sharded
         over the TP axes between blocks (reference: site_package/megatron/core/
         tensor_parallel/mappings_group.py:192-293).
-      cp: context-parallel (ring attention) degree over the minor data axes;
-        1 disables. A TPU-native capability the reference lacks (SURVEY §5).
+      cp: context-parallel degree over the minor data axes; 1 disables. A
+        TPU-native capability the reference lacks (SURVEY §5).
+      cp_impl: 'ring' (K/V rotation with online softmax, parallel/ring.py) or
+        'a2a' (Ulysses sequence↔head all-to-all, parallel/ulysses.py; needs
+        num_heads % cp == 0).
       ep: expert-parallel degree for MoE layers — experts sharded over the
         minor data-parallel axes (reference EP groups: site_package/megatron/
         core/parallel_state.py:450-478; SwitchMLP transformer.py:161-295).
@@ -77,6 +80,7 @@ class LayerStrategy:
     sp: bool = False
     cp: int = 1
     ep: int = 1
+    cp_impl: str = "ring"
 
     def __post_init__(self):
         try:
@@ -95,9 +99,11 @@ class LayerStrategy:
             raise ValueError("cp and ep both >1 is unsupported (they share mesh axes)")
         if self.cp > 1 and self.ckpt == "selective":
             raise ValueError(
-                "ckpt='selective' is not supported with cp>1 (the ring-attention "
-                "layer has no attention-core remat hook); use ckpt='full'"
+                "ckpt='selective' is not supported with cp>1 (the CP decoder "
+                "layers have no attention-core remat hook); use ckpt='full'"
             )
+        if self.cp_impl not in ("ring", "a2a"):
+            raise ValueError(f"cp_impl must be 'ring' or 'a2a', got {self.cp_impl!r}")
         if self.dp_type not in DP_TYPES:
             raise ValueError(f"dp_type must be one of {DP_TYPES}, got {self.dp_type}")
 
@@ -120,7 +126,9 @@ class HybridParallelConfig:
     vocab_tp: int = 1  # TP degree for embedding & LM head (vocab-parallel)
     vocab_sp: bool = False
     embed_dp_type: str = "ddp"  # 'embed_sdp' analogue: zero3 to shard embeddings
-    mixed_precision: str = "bf16"  # 'fp32' | 'bf16' (bf16 compute, fp32 master)
+    # 'fp32' | 'bf16' (bf16 compute, fp32 master) | 'fp16' (+ dynamic loss
+    # scaling with skip-on-overflow; reference: megatron grad_scaler.py)
+    mixed_precision: str = "bf16"
     default_dp_type: str = "ddp"
 
     def __post_init__(self):
@@ -181,6 +189,7 @@ class HybridParallelConfig:
             "checkpoint": ",".join(str(_CKPT_TO_INT[s.ckpt]) for s in ls),
             "sp_flags": ",".join(str(int(s.sp)) for s in ls),
             "cp_sizes_enc": ",".join(str(s.cp) for s in ls),
+            "cp_impls": ",".join(s.cp_impl for s in ls),
             "ep_sizes_enc": ",".join(str(s.ep) for s in ls),
             "pp_division": ",".join(str(n) for n in (self.pp_division or [])),
             "chunks": self.chunks,
@@ -212,6 +221,8 @@ class HybridParallelConfig:
         ckpt = ints("checkpoint") or [0] * n
         sp = ints("sp_flags") or [0] * n
         cp = ints("cp_sizes_enc") or [1] * n
+        cp_impls = d.get("cp_impls")
+        cp_impls = cp_impls.split(",") if cp_impls else ["ring"] * n
         ep = ints("ep_sizes_enc") or [1] * n
         strategies = [
             LayerStrategy(
@@ -221,6 +232,7 @@ class HybridParallelConfig:
                 ckpt=ckpt[i],
                 sp=bool(sp[i]),
                 cp=cp[i],
+                cp_impl=cp_impls[i],
                 ep=ep[i],
             )
             for i in range(n)
@@ -257,12 +269,14 @@ class HybridParallelConfig:
         ckpt: bool = False,
         sp: bool = False,
         cp: int = 1,
+        cp_impl: str = "ring",
         ep: int = 1,
         tp_consec: bool = True,
         **kw,
     ) -> "HybridParallelConfig":
         s = LayerStrategy(
-            tp=tp, tp_consec=tp_consec, dp_type=dp_type, ckpt=ckpt, sp=sp, cp=cp, ep=ep
+            tp=tp, tp_consec=tp_consec, dp_type=dp_type, ckpt=ckpt, sp=sp,
+            cp=cp, cp_impl=cp_impl, ep=ep,
         )
         return cls(pp=pp, layer_strategies=[s] * num_layers, vocab_tp=kw.pop("vocab_tp", tp), **kw)
 
@@ -295,7 +309,7 @@ def form_strategy(s: LayerStrategy, pp: int = 1, dp: int = 1) -> str:
     if s.sp:
         tag += "s"
     if s.cp > 1:
-        tag += f"r{s.cp}"
+        tag += (f"r{s.cp}" if s.cp_impl == "ring" else f"u{s.cp}")
     if s.ckpt == "full":
         tag += "-c"
     elif s.ckpt == "selective":

@@ -29,7 +29,17 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from galvatron_tpu.core.optim import AdamConfig, adamw_update, init_opt_state
+from galvatron_tpu.core.optim import (
+    AdamConfig,
+    adamw_update,
+    apply_update_with_scaler,
+    init_opt_state,
+)
+from galvatron_tpu.core.schedules import (
+    LossScalerConfig,
+    init_scaler_state,
+    scaled_value_and_grad,
+)
 from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
 from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
@@ -82,11 +92,14 @@ def state_specs(state_shape, cfg, hp, axes):
     """Specs for the full train state {params, opt{mu,nu,count}, step}."""
     pspec = model_param_specs(state_shape["params"], cfg, hp, axes)
     ospec = model_param_specs(state_shape["params"], cfg, hp, axes, for_opt_state=True)
-    return {
+    specs = {
         "params": pspec,
         "opt": {"mu": ospec, "nu": ospec, "count": P()},
         "step": P(),
     }
+    if "scaler" in state_shape:  # fp16 dynamic loss scale: replicated scalars
+        specs["scaler"] = jax.tree.map(lambda _: P(), state_shape["scaler"])
+    return specs
 
 
 @dataclass
@@ -112,7 +125,7 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
         s = hp.layer_strategies[i]
         x = constrain(x, mesh, activation_spec(axes, s))
         layer_cfg = cfg
-        if s.cp > 1:
+        if s.cp > 1 and s.cp_impl == "ring":
             layer_cfg = cfg.replace(attn_impl="ring")
         cos_sin = (
             modeling.rope_tables(layer_cfg, x.shape[1]) if layer_cfg.pos_embed == "rope" else None
@@ -125,11 +138,14 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
 
         def run(x_, lp_):
             if s.cp > 1:
+                cp_axes = axes.cp_axes(s.tp, s.tp_consec, s.cp)
+                if s.cp_impl == "a2a":
+                    from galvatron_tpu.parallel.ulysses import ulysses_decoder_layer
+
+                    return ulysses_decoder_layer(x_, lp_, layer_cfg, mesh, cp_axes, cos_sin)
                 from galvatron_tpu.parallel.ring import ring_decoder_layer
 
-                return ring_decoder_layer(
-                    x_, lp_, layer_cfg, mesh, axes.cp_axes(s.tp, s.tp_consec, s.cp), cos_sin
-                )
+                return ring_decoder_layer(x_, lp_, layer_cfg, mesh, cp_axes, cos_sin)
             return modeling.decoder_layer(
                 x_, lp_, layer_cfg, cos_sin, alibi, remat_attn=(s.ckpt == "selective")
             )
@@ -171,6 +187,14 @@ def build_runtime(
         cfg = cfg.replace(dtype=jnp.float32)
     if hp.mixed_precision == "bf16" and cfg.dtype == jnp.float32:
         cfg = cfg.replace(dtype=jnp.bfloat16)
+    # fp16 parity path (reference: --mixed_precision fp16, core/arguments.py:
+    # 104-106 + megatron grad_scaler): fp16 compute, fp32 master params,
+    # dynamic loss scaling with skip-on-overflow. bf16 is the TPU-native
+    # choice; fp16 exists so reference configs port unchanged.
+    fp16 = hp.mixed_precision == "fp16"
+    if fp16:
+        cfg = cfg.replace(dtype=jnp.float16)
+        scaler_cfg = LossScalerConfig()
 
     if hp.pp > 1:
         from galvatron_tpu.parallel.pipeline import build_pipeline_runtime
@@ -183,10 +207,18 @@ def build_runtime(
         return modeling.lm_loss(params, tokens_batch, cfg, layer_hook=hook)
 
     chunks = max(1, hp.chunks)
+    if global_batch_size % chunks != 0:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by chunks {chunks}"
+        )
 
-    def grads_fn(params, batch):
+    def grads_fn(params, batch, scale=None):
+        """(loss, grads); with ``scale`` (fp16) the backward runs on
+        ``loss * scale`` and grads are returned unscaled in fp32."""
         if chunks == 1:
-            return jax.value_and_grad(loss_fn)(params, batch)
+            if scale is None:
+                return jax.value_and_grad(loss_fn)(params, batch)
+            return scaled_value_and_grad(loss_fn, scale)(params, batch)
         # micro-batch gradient accumulation via scan (chunk_batch equivalent,
         # reference: galvatron/core/pipeline/utils.py:9-36). Accumulates
         # (nll_sum, token_count) so the result equals the unchunked global
@@ -199,8 +231,22 @@ def build_runtime(
             s, n = modeling.lm_loss_sum(params, mb, cfg, layer_hook=hook)
             return s, n
 
+        # fp16: seed on the mean-equivalent loss (sum / static token count) so
+        # cotangent magnitudes match the unchunked mean-loss path — a raw
+        # sum-loss seed multiplies O(1) per-token cotangents by the full scale
+        # and overflows fp16 immediately at the 2^16 initial scale
+        n_static = (b // chunks) * (batch.shape[1] - 1)
+
         def body(acc, mb):
-            (s, n), g = jax.value_and_grad(sum_fn, has_aux=True)(params, mb)
+            if scale is None:
+                (s, n), g = jax.value_and_grad(sum_fn, has_aux=True)(params, mb)
+            else:
+
+                def scaled(p, mb_):
+                    s_, n_ = sum_fn(p, mb_)
+                    return s_ * (scale / n_static), (s_, n_)
+
+                (_, (s, n)), g = jax.value_and_grad(scaled, has_aux=True)(params, mb)
             acc_s, acc_n, acc_g = acc
             return (acc_s + s, acc_n + n, jax.tree.map(jnp.add, acc_g, g)), None
 
@@ -211,16 +257,23 @@ def build_runtime(
         )
         (tot_s, tot_n, tot_g), _ = jax.lax.scan(body, zero, mbs)
         denom = jnp.maximum(tot_n, 1).astype(jnp.float32)
-        return tot_s / denom, jax.tree.map(lambda g: g / denom, tot_g)
+        gdenom = denom if scale is None else denom * scale / n_static
+        return tot_s / denom, jax.tree.map(lambda g: g / gdenom, tot_g)
 
     def train_step(state, batch):
+        if fp16:
+            loss, grads = grads_fn(state["params"], batch, state["scaler"]["scale"])
+            return apply_update_with_scaler(state, loss, grads, adam, scaler_cfg)
         loss, grads = grads_fn(state["params"], batch)
         new_params, new_opt = adamw_update(state["params"], grads, state["opt"], adam)
         return {"params": new_params, "opt": new_opt, "step": state["step"] + 1}, loss
 
     def init_state(key):
         params = modeling.init_model_params(key, cfg)
-        return {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
+        state = {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
+        if fp16:
+            state["scaler"] = init_scaler_state(scaler_cfg)
+        return state
 
     # shardings
     state_shape = jax.eval_shape(init_state, jax.random.key(0))

@@ -42,7 +42,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from galvatron_tpu.core.optim import AdamConfig, adamw_update, init_opt_state
+from galvatron_tpu.core.optim import (
+    AdamConfig,
+    adamw_update,
+    apply_update_with_scaler,
+    init_opt_state,
+)
+from galvatron_tpu.core.schedules import (
+    LossScalerConfig,
+    init_scaler_state,
+    scaled_value_and_grad,
+)
 from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
 from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
@@ -190,11 +200,14 @@ def make_stage_fn(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axes: 
 
             def run(x_, lp_):
                 if s.cp > 1:
+                    cp_axes = axes.cp_axes(s.tp, s.tp_consec, s.cp)
+                    if s.cp_impl == "a2a":
+                        from galvatron_tpu.parallel.ulysses import ulysses_decoder_layer
+
+                        return ulysses_decoder_layer(x_, lp_, cfg, mesh, cp_axes, cos_sin)
                     from galvatron_tpu.parallel.ring import ring_decoder_layer
 
-                    return ring_decoder_layer(
-                        x_, lp_, cfg, mesh, axes.cp_axes(s.tp, s.tp_consec, s.cp), cos_sin
-                    )
+                    return ring_decoder_layer(x_, lp_, cfg, mesh, cp_axes, cos_sin)
                 return modeling.decoder_layer(
                     x_, lp_, cfg, cos_sin, alibi, remat_attn=(s.ckpt == "selective")
                 )
@@ -307,14 +320,25 @@ def build_pipeline_runtime(
         s, n = modeling.cross_entropy_sum(logits, labels)
         return s / jnp.maximum(n, 1)
 
+    fp16 = hp.mixed_precision == "fp16"
+    scaler_cfg = LossScalerConfig()
+
     def train_step(state, batch):
+        if fp16:
+            loss, grads = scaled_value_and_grad(loss_fn, state["scaler"]["scale"])(
+                state["params"], batch
+            )
+            return apply_update_with_scaler(state, loss, grads, adam, scaler_cfg)
         loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
         new_params, new_opt = adamw_update(state["params"], grads, state["opt"], adam)
         return {"params": new_params, "opt": new_opt, "step": state["step"] + 1}, loss
 
     def init_state(key):
         params = init_pipeline_params(key, cfg, hp)
-        return {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
+        state = {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
+        if fp16:
+            state["scaler"] = init_scaler_state(scaler_cfg)
+        return state
 
     state_shape = jax.eval_shape(init_state, jax.random.key(0))
     specs = {
@@ -326,6 +350,8 @@ def build_pipeline_runtime(
         },
         "step": P(),
     }
+    if "scaler" in state_shape:
+        specs["scaler"] = jax.tree.map(lambda _: P(), state_shape["scaler"])
     shardings = sharding_tree(mesh, specs)
     batch_sharding = NamedSharding(mesh, P(("pp",) + axes.data_axes, None))
 

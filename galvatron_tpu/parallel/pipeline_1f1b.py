@@ -33,7 +33,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from galvatron_tpu.core.optim import AdamConfig, adamw_update, init_opt_state
+from galvatron_tpu.core.optim import (
+    AdamConfig,
+    adamw_update,
+    apply_update_with_scaler,
+    init_opt_state,
+)
+from galvatron_tpu.core.schedules import LossScalerConfig, init_scaler_state
 from galvatron_tpu.core.strategy import HybridParallelConfig
 from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
@@ -75,15 +81,18 @@ def make_1f1b_train_step(
         raise ValueError(f"global batch {global_batch_size} not divisible by chunks {chunks}")
     mb = global_batch_size // chunks
     n_stash = min(chunks, 2 * (pp - 1) + 1)
+    n_static = (global_batch_size // chunks) * seq_len  # tokens per micro-batch
     T = chunks + 2 * (pp - 1)
     up_perm = [(i, i + 1) for i in range(pp - 1)]
     down_perm = [(i + 1, i) for i in range(pp - 1)]
     head_keys = ("final_norm", "embed") if cfg.tie_word_embeddings else ("final_norm", "head")
     full_spec = P(("pp",) + axes.data_axes, None, None)
 
-    def pipeline_body(stage_params, head_sub, x_mbs, labels_mbs):
+    def pipeline_body(stage_params, head_sub, x_mbs, labels_mbs, scale):
         """Runs under shard_map(manual={'pp'}). Returns per-stage-stacked
-        (loss_sum, tok_count, d_stages, d_head, dx_embed)."""
+        (loss_sum, tok_count, d_stages, d_head, dx_embed). ``scale`` seeds the
+        backward cotangent (fp16 loss scaling; 1.0 otherwise) so in-flight
+        fp16 cotangents stay in range — all weight grads come back scaled."""
         # strip the size-1 local stage dim from the pp-stacked params
         stage_params = jax.tree.map(lambda a: jnp.squeeze(a, 0), stage_params)
         stage = jax.lax.axis_index("pp")
@@ -135,7 +144,10 @@ def make_1f1b_train_step(
                 lambda hs, y: _head_loss(hs, y, labels, cfg), head_sub, out, has_aux=True
             )
             head_mask = (is_last & fwd_valid).astype(jnp.float32)
-            dhead_mb, dy_head = head_vjp(head_mask)  # masked cotangent seed
+            # seed normalized by the static micro-batch token count so the
+            # scaled cotangents have mean-loss magnitude (a raw sum-loss seed
+            # overflows fp16 at the initial 2^16 scale)
+            dhead_mb, dy_head = head_vjp(head_mask * scale / n_static)
 
             # backward: recompute stage forward from the stashed input. Reads
             # the *updated* stash: the last stage backwards a micro-batch in
@@ -183,14 +195,18 @@ def make_1f1b_train_step(
     body_sm = jax.shard_map(
         pipeline_body,
         mesh=mesh,
-        in_specs=(P("pp"), P(), P(), P()),
+        in_specs=(P("pp"), P(), P(), P(), P()),
         out_specs=(P("pp"), P("pp"), P("pp"), P("pp"), P("pp")),
         axis_names={"pp"},
         check_vma=False,
     )
 
+    fp16 = hp.mixed_precision == "fp16"
+    scaler_cfg = LossScalerConfig()
+
     def train_step(state, batch):
         params = state["params"]
+        scale = state["scaler"]["scale"] if fp16 else jnp.ones((), jnp.float32)
         tokens, labels = batch[:, :-1], batch[:, 1:]
         head_sub = {k: params[k] for k in head_keys}
 
@@ -204,7 +220,7 @@ def make_1f1b_train_step(
         labels_mbs = labels.reshape(chunks, mb, -1)
 
         loss_s, tok_s, d_stages, d_head_s, dx_embed_s = body_sm(
-            params["stages"], head_sub, x_mbs, labels_mbs
+            params["stages"], head_sub, x_mbs, labels_mbs, scale
         )
         loss_sum = loss_s[-1]
         tok = jnp.maximum(tok_s[-1], 1.0)
@@ -221,9 +237,12 @@ def make_1f1b_train_step(
                 )
             else:
                 grads[k] = d_head[k]
-        grads = {k: jax.tree.map(lambda g: g / tok, v) for k, v in grads.items()}
+        gdenom = tok * scale / n_static  # unscale the seeded backward + token-mean
+        grads = {k: jax.tree.map(lambda g: g / gdenom, v) for k, v in grads.items()}
         loss = loss_sum / tok
 
+        if fp16:
+            return apply_update_with_scaler(state, loss, grads, adam, scaler_cfg)
         new_params, new_opt = adamw_update(params, grads, state["opt"], adam)
         return {"params": new_params, "opt": new_opt, "step": state["step"] + 1}, loss
 
@@ -238,12 +257,16 @@ def make_1f1b_train_step(
             head_sub,
             x.reshape(chunks, mb, *x.shape[1:]),
             labels.reshape(chunks, mb, -1),
+            jnp.ones((), jnp.float32),
         )
         return loss_s[-1] / jnp.maximum(tok_s[-1], 1.0)
 
     def init_state(key):
         params = init_pipeline_params(key, cfg, hp)
-        return {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
+        state = {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
+        if fp16:
+            state["scaler"] = init_scaler_state(scaler_cfg)
+        return state
 
     state_shape = jax.eval_shape(init_state, jax.random.key(0))
     specs = {
@@ -255,6 +278,8 @@ def make_1f1b_train_step(
         },
         "step": P(),
     }
+    if "scaler" in state_shape:
+        specs["scaler"] = jax.tree.map(lambda _: P(), state_shape["scaler"])
     shardings = sharding_tree(mesh, specs)
     batch_sharding = NamedSharding(mesh, P(("pp",) + axes.data_axes, None))
 

@@ -1,0 +1,97 @@
+"""Ulysses-style context parallelism: sequence all-to-all over ICI.
+
+The second long-context capability beyond the reference (SURVEY §2.3: no
+CP/ring/Ulysses anywhere in Galvatron) and the alternative to ring attention
+(galvatron_tpu.parallel.ring): instead of rotating K/V blocks around a ring,
+one ``all_to_all`` re-shards activations from sequence-sharded to
+head-sharded, each device runs *full-sequence* attention for its head subset
+(on TPU: the Pallas flash kernel), and a second ``all_to_all`` restores
+sequence sharding.
+
+Trade-off vs ring (why both exist): Ulysses moves 2×(q+k+v+o)/cp bytes in two
+bursty all-to-alls and keeps the attention core un-tiled (best when heads ≥
+cp and the MXU-friendly full-length kernel wins); ring moves k+v per step
+overlapped with compute and has no head-count constraint (best at extreme
+sequence lengths or few heads). The strategy dimension ``cp_impl`` selects
+per layer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from galvatron_tpu.models import modeling
+from galvatron_tpu.models.modeling import ModelConfig
+
+
+def _a2a_attn_local(q, k, v, cfg: ModelConfig, axis_name, cp: int):
+    """Runs inside shard_map with ``axis_name`` manual. q local:
+    (B, S/cp, n, d) sequence-sharded; k/v may still be at kv_heads — the
+    attention core GQA-repeats after the all-to-all, so grouped K/V cross the
+    CP axes at 1/group_factor of the repeated volume."""
+    # seq-sharded → head-sharded: (B, S/cp, n, d) → (B, S, n/cp, d)
+    q = jax.lax.all_to_all(q, axis_name, 2, 1, tiled=True)
+    k = jax.lax.all_to_all(k, axis_name, 2, 1, tiled=True)
+    v = jax.lax.all_to_all(v, axis_name, 2, 1, tiled=True)
+    o = modeling.attention(q, k, v, cfg)  # full-sequence causal core
+    # head-sharded → seq-sharded
+    return jax.lax.all_to_all(o, axis_name, 1, 2, tiled=True)
+
+
+def ulysses_attention(q, k, v, cfg: ModelConfig, mesh: Mesh, cp_axes: Sequence[str]):
+    """q/k/v: (B, S, n, d) global arrays, sequence sharded over ``cp_axes``;
+    n must be divisible by the CP degree (the Ulysses head constraint)."""
+    cp = int(np.prod([mesh.shape[a] for a in cp_axes]))
+    if q.shape[2] % cp != 0:
+        raise ValueError(
+            f"cp_impl='a2a' needs num_heads {q.shape[2]} divisible by cp={cp} "
+            "(use cp_impl='ring' for few-head models)"
+        )
+    if k.shape[2] % cp != 0:  # grouped K/V can't split over cp — repeat first
+        k = modeling._repeat_kv(k, q.shape[2] // k.shape[2])
+        v = modeling._repeat_kv(v, q.shape[2] // v.shape[2])
+    if cfg.attn_impl == "ring":  # never recurse into the ring dispatch
+        cfg = cfg.replace(attn_impl="xla")
+    axis = tuple(cp_axes)
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(_a2a_attn_local, cfg=cfg, axis_name=axis, cp=cp),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names=set(cp_axes),
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def ulysses_decoder_layer(x, p, cfg: ModelConfig, mesh, cp_axes, cos_sin):
+    """Decoder layer with the attention core Ulysses-parallelized (drop-in for
+    modeling.decoder_layer when a layer strategy sets cp > 1, cp_impl='a2a').
+    Projections and RoPE run at the global level (GSPMD shards them over the
+    sequence); only the core crosses the CP axes."""
+
+    def attn(xn):
+        b, s, h = xn.shape
+        hd = cfg.head_dim
+        q = (xn @ p["attn"]["wq"].astype(xn.dtype)).reshape(b, s, cfg.num_heads, hd)
+        k = (xn @ p["attn"]["wk"].astype(xn.dtype)).reshape(b, s, cfg.kv_heads, hd)
+        v = (xn @ p["attn"]["wv"].astype(xn.dtype)).reshape(b, s, cfg.kv_heads, hd)
+        if cfg.pos_embed == "rope":
+            cos, sin = cos_sin
+            q = modeling.apply_rope(q, cos, sin)
+            k = modeling.apply_rope(k, cos, sin)
+        # K/V stay at kv_heads across the all-to-all (GQA repeat happens in
+        # the local attention core) — group_factor× less CP traffic
+        o = ulysses_attention(q, k, v, cfg, mesh, cp_axes)
+        return o.reshape(b, s, cfg.num_heads * hd) @ p["attn"]["wo"].astype(xn.dtype)
+
+    x = x + attn(modeling.norm(x, p["attn_norm"], cfg))
+    x = x + modeling.mlp_block(modeling.norm(x, p["mlp_norm"], cfg), p["mlp"], cfg)
+    return x

@@ -145,7 +145,7 @@ def layer_memory_cost(
     dp = world // (pp * s.tp * s.cp)
     p_mb = lt.parameter_mb / s.tp  # fp32 MB after TP sharding
     # fp32 master + grad + two Adam moments = 4x; bf16 adds a half-weight cast
-    cast = 0.5 * p_mb if mixed_precision == "bf16" else 0.0
+    cast = 0.5 * p_mb if mixed_precision in ("bf16", "fp16") else 0.0
     if s.dp_type == "zero3":
         states = 4.0 * p_mb / dp + cast  # cast buffer = gathered working copy
     elif s.dp_type == "zero2":
@@ -185,7 +185,7 @@ def other_memory_cost(
     over pp and sharded by vocab_tp (+ZeRO over the data axes)."""
     dp = world // (pp * vocab_tp)
     p_mb = costs.other_param_mb / vocab_tp
-    cast = 0.5 * p_mb if mixed_precision == "bf16" else 0.0
+    cast = 0.5 * p_mb if mixed_precision in ("bf16", "fp16") else 0.0
     if embed_dp_type == "zero3":
         states = 4.0 * p_mb / dp + cast
     else:
@@ -220,7 +220,7 @@ def layer_time_cost(
     # attention core (~1/3 of layer FLOPs at reference shapes)
     compute = fwd * (4.0 if s.ckpt == "full" else 3.33 if s.ckpt == "selective" else 3.0)
 
-    comm_bytes_factor = 0.5 if mixed_precision == "bf16" else 1.0
+    comm_bytes_factor = 0.5 if mixed_precision in ("bf16", "fp16") else 1.0
     # TP: 2 allreduces fwd + 2 bwd of one (b, s, h) activation (Megatron f/g;
     # with SP the all-gather+reduce-scatter pair moves the same volume)
     act_msg = lt.boundary_activation_mb_per_sample * local_bsz * comm_bytes_factor

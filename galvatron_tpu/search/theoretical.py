@@ -64,7 +64,7 @@ def layer_states_mb(
     analytic form of layer_memory_cost's states term."""
     dp = world // (pp * s.tp * s.cp)
     p_mb = layer_param_count(cfg) * 4 / 1e6 / s.tp  # fp32 MB after TP
-    cast = 0.5 * p_mb if mixed_precision == "bf16" else 0.0
+    cast = 0.5 * p_mb if mixed_precision in ("bf16", "fp16") else 0.0
     if s.dp_type == "zero3":
         return 4.0 * p_mb / dp + cast
     if s.dp_type == "zero2":

@@ -1,0 +1,74 @@
+"""fp16 mixed precision with dynamic loss scaling, across all three execution
+paths (pp=1 direct, pp=1 accumulated, pp>1 1F1B). Reference: --mixed_precision
+fp16 (galvatron/core/arguments.py:104-106) + megatron/optimizer/grad_scaler.py
+DynamicGradScaler skip-on-overflow semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from galvatron_tpu.core.optim import AdamConfig
+from galvatron_tpu.core.strategy import HybridParallelConfig
+from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.parallel.hybrid import build_runtime
+
+CFG = ModelConfig(
+    vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+    ffn_dim=128, max_seq_len=32, dtype=jnp.float32,
+)
+ADAM = AdamConfig(lr=1e-3, grad_clip=1.0)
+
+
+def batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [jnp.asarray(rng.randint(0, 128, (8, 33)), jnp.int32) for _ in range(n)]
+
+
+@pytest.mark.parametrize(
+    "hp",
+    [
+        HybridParallelConfig.uniform(2, tp=1, mixed_precision="fp16"),
+        HybridParallelConfig.uniform(2, tp=2, mixed_precision="fp16", vocab_tp=2, chunks=2),
+        HybridParallelConfig.uniform(
+            2, pp=2, tp=1, mixed_precision="fp16", chunks=2,
+            pipeline_type="pipedream_flush",
+        ),
+    ],
+    ids=["pp1", "pp1_tp2_accum", "pp2_1f1b"],
+)
+def test_fp16_trains_and_tracks_fp32(hp):
+    """fp16 losses track the fp32 trajectory loosely and stay finite; the
+    scaler state advances."""
+    fp32_hp = HybridParallelConfig.from_json_dict(hp.to_json_dict())
+    fp32_hp.mixed_precision = "fp32"
+    rt16 = build_runtime(CFG, hp, adam=ADAM, global_batch_size=8, seq_len=32)
+    rt32 = build_runtime(CFG, fp32_hp, adam=ADAM, global_batch_size=8, seq_len=32)
+    s16 = rt16.init_state(jax.random.key(0))
+    s32 = rt32.init_state(jax.random.key(0))
+    assert "scaler" in s16 and float(s16["scaler"]["scale"]) == 2.0**16
+    l16, l32 = [], []
+    for b in batches(3):
+        s16, a = rt16.train_step(s16, b)
+        s32, c = rt32.train_step(s32, b)
+        l16.append(float(a))
+        l32.append(float(c))
+    assert np.isfinite(l16).all()
+    np.testing.assert_allclose(l16, l32, rtol=0.05, atol=0.05)
+    assert int(s16["scaler"]["good_steps"]) == 3  # three clean steps
+
+
+def test_fp16_overflow_skips_update_and_backs_off():
+    """With an absurd loss scale the grads overflow fp16 range: params must be
+    untouched and the scale halved (skip-on-overflow)."""
+    hp = HybridParallelConfig.uniform(2, tp=1, mixed_precision="fp16")
+    rt = build_runtime(CFG, hp, adam=ADAM, global_batch_size=8, seq_len=32)
+    state = rt.init_state(jax.random.key(0))
+    huge = jnp.asarray(2.0**120, jnp.float32)
+    state["scaler"]["scale"] = jax.device_put(huge, rt.state_shardings["scaler"]["scale"])
+    before = np.asarray(state["params"]["final_norm"]["scale"])
+    state, loss = rt.train_step(state, batches(1)[0])
+    after = np.asarray(state["params"]["final_norm"]["scale"])
+    np.testing.assert_array_equal(before, after)
+    assert float(state["scaler"]["scale"]) == 2.0**119
+    assert int(state["scaler"]["good_steps"]) == 0

@@ -14,7 +14,7 @@ from galvatron_tpu.core.schedules import (
     LRSchedule,
     all_finite,
     init_scaler_state,
-    scaled_grads_fn,
+    scaled_value_and_grad,
     scaler_update,
 )
 
@@ -84,19 +84,17 @@ def test_loss_scaler_growth_and_backoff():
     assert float(st["scale"]) == 16.0 and int(st["good_steps"]) == 0
 
 
-def test_scaled_grads_detect_overflow():
+def test_scaled_value_and_grad():
     def loss_fn(p, b):
         return jnp.sum(p["w"] * b)
 
-    state = init_scaler_state(LossScalerConfig(initial_scale=4.0))
-    run = scaled_grads_fn(loss_fn, state)
+    run = scaled_value_and_grad(loss_fn, jnp.asarray(4.0, jnp.float32))
     p = {"w": jnp.ones((2,), jnp.float32)}
-    loss, grads, finite = run(p, jnp.ones((2,), jnp.float32))
-    assert bool(finite)
-    np.testing.assert_allclose(grads["w"], [1.0, 1.0], rtol=1e-6)
-    assert float(loss) == pytest.approx(2.0)
-    _, _, finite2 = run(p, jnp.asarray([jnp.inf, 1.0], jnp.float32))
-    assert not bool(finite2)
+    loss, grads = run(p, jnp.ones((2,), jnp.float32))
+    np.testing.assert_allclose(grads["w"], [1.0, 1.0], rtol=1e-6)  # unscaled
+    assert float(loss) == pytest.approx(2.0)  # exact primal, not scaled
+    _, grads2 = run(p, jnp.asarray([jnp.inf, 1.0], jnp.float32))
+    assert not bool(all_finite(grads2))
     assert not bool(all_finite({"g": jnp.asarray([jnp.nan])}))
 
 
