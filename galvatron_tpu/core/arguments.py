@@ -61,6 +61,9 @@ def _add_training_args(p: argparse.ArgumentParser):
     g.add_argument("--profile", type=int, default=0, help="print per-iter time/memory")
     # hybrid-parallel GLOBAL flags (used when no galvatron_config_path)
     g.add_argument("--pp_deg", type=int, default=1)
+    g.add_argument("--vpp_deg", type=int, default=1,
+                   help="virtual pipeline chunks per device (interleaved "
+                   "schedule; needs layers % (pp*vpp) == 0 and chunks % pp == 0)")
     g.add_argument("--global_tp_deg", type=int, default=1)
     g.add_argument("--global_tp_consec", type=int, default=1)
     g.add_argument("--sdp", type=int, default=0, help="1 = zero3 on all layers")
@@ -229,6 +232,7 @@ def hybrid_config_from_args(ns: argparse.Namespace, num_layers: int, world: int)
         hp = HybridParallelConfig.uniform(
             num_layers,
             pp=ns.pp_deg,
+            vpp=ns.vpp_deg,
             tp=ns.global_tp_deg,
             tp_consec=bool(ns.global_tp_consec),
             dp_type=dp_type,

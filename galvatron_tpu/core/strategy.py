@@ -118,6 +118,12 @@ class HybridParallelConfig:
     """
 
     pp: int = 1
+    # virtual pipeline chunks per device (interleaved schedule; 1 = off).
+    # Device s holds virtual stages {s, s+pp, ..., s+(vpp-1)pp}; the bubble
+    # shrinks by the vpp factor (reference: the interleaved 1F1B of vendored
+    # megatron core/pipeline_parallel/schedules.py:367, unused by Galvatron's
+    # own engine — first-class here).
+    vpp: int = 1
     layer_strategies: List[LayerStrategy] = field(default_factory=list)
     # layers per pipeline stage; len == pp, sum == len(layer_strategies)
     pp_division: Optional[List[int]] = None
@@ -172,6 +178,27 @@ class HybridParallelConfig:
                 raise ValueError("pp_division must sum to the layer count")
         if self.pp > 1 and self.chunks < 1:
             raise ValueError("chunks must be >= 1")
+        if self.vpp < 1:
+            raise ValueError("vpp must be >= 1")
+        if self.vpp > 1:
+            if self.pp == 1:
+                raise ValueError("vpp>1 (interleaved schedule) requires pp>1")
+            if self.pipeline_type != "gpipe":
+                raise ValueError(
+                    "vpp>1 is implemented for pipeline_type='gpipe' (the "
+                    "interleaved clocked scan; 1F1B+vpp is future work)"
+                )
+            if self.num_layers % (self.pp * self.vpp) != 0:
+                raise ValueError(
+                    f"vpp={self.vpp} needs the layer count {self.num_layers} "
+                    f"divisible by pp*vpp={self.pp * self.vpp}"
+                )
+            if self.chunks % self.pp != 0:
+                raise ValueError(
+                    f"interleaved schedule needs chunks {self.chunks} divisible "
+                    f"by pp={self.pp} (micro-batches flow in groups of pp; "
+                    "reference: megatron interleaved requires the same)"
+                )
 
     # --- JSON codec (reference schema: comma-joined per-layer strings;
     # galvatron/utils/config_utils.py:34-50, search_engine.py:326-367) ---
@@ -180,6 +207,7 @@ class HybridParallelConfig:
         ls = self.layer_strategies
         return {
             "pp_deg": self.pp,
+            "vpp_deg": self.vpp,
             "tp_sizes_enc": ",".join(str(s.tp) for s in ls),
             "tp_consecutive_flags": ",".join(str(int(s.tp_consec)) for s in ls),
             "dp_types_enc": ",".join(str(_DP_TYPE_TO_INT[s.dp_type]) for s in ls),
@@ -239,6 +267,7 @@ class HybridParallelConfig:
         ]
         return cls(
             pp=int(d.get("pp_deg", 1)),
+            vpp=int(d.get("vpp_deg", 1)),
             layer_strategies=strategies,
             pp_division=ints("pp_division"),
             chunks=int(d.get("chunks", 1)),

@@ -177,11 +177,13 @@ def pipeline_param_specs(
 # ---------------------------------------------------------------------------
 
 
-def make_stage_fn(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axes: MeshAxes):
-    """One pipeline stage: layers-per-stage decoder layers with per-position
-    sharding constraints + remat (the per-layer wrap steps [3,5,6] of the
-    reference construction, galvatron/core/hybrid_parallel_model.py:81-153)."""
-    lps = cfg.num_layers // hp.pp
+def make_block_fn(
+    cfg: ModelConfig, strategies: List[LayerStrategy], mesh: Mesh, axes: MeshAxes
+):
+    """Run ``len(strategies)`` decoder layers with per-position sharding
+    constraints + remat (the per-layer wrap steps [3,5,6] of the reference
+    construction, galvatron/core/hybrid_parallel_model.py:81-153). Used as one
+    pipeline stage (gpipe/1F1B) or one virtual stage (interleaved)."""
 
     def act_spec(s: LayerStrategy) -> P:
         bs = batch_spec(axes, s)
@@ -194,8 +196,7 @@ def make_stage_fn(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axes: 
             if cfg.pos_embed == "alibi"
             else None
         )
-        for j in range(lps):
-            s = hp.layer_strategies[j]
+        for j, s in enumerate(strategies):
             x = constrain(x, mesh, act_spec(s))
 
             def run(x_, lp_):
@@ -218,6 +219,13 @@ def make_stage_fn(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axes: 
         return x
 
     return stage_fn
+
+
+def make_stage_fn(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axes: MeshAxes):
+    """One physical pipeline stage: the first stage's position strategies
+    (validate_pipeline_strategies guarantees stages agree per position)."""
+    lps = cfg.num_layers // hp.pp
+    return make_block_fn(cfg, hp.layer_strategies[:lps], mesh, axes)
 
 
 # ---------------------------------------------------------------------------
@@ -279,20 +287,39 @@ def build_pipeline_runtime(
     from galvatron_tpu.parallel.hybrid import HybridParallelRuntime
 
     pp, chunks = hp.pp, max(1, hp.chunks)
-    lps = validate_pipeline_strategies(cfg, hp)
     if global_batch_size % chunks != 0:
         raise ValueError(f"global batch {global_batch_size} not divisible by chunks {chunks}")
     mb = global_batch_size // chunks
 
-    stage_fn = make_stage_fn(cfg, hp, mesh, axes)
-    if hp.pipeline_type == "pipedream_flush":
-        from galvatron_tpu.parallel.pipeline_1f1b import make_1f1b_train_step
-
-        return make_1f1b_train_step(
-            cfg, hp, mesh, axes, adam, global_batch_size, seq_len, stage_fn
+    interleaved = hp.vpp > 1
+    if interleaved:
+        from galvatron_tpu.parallel.pipeline_interleaved import (
+            init_interleaved_params,
+            interleaved_param_specs,
+            interleaved_pipeline,
+            validate_interleaved_strategies,
         )
 
-    pipe = gpipe_pipeline(stage_fn, pp, chunks, mesh)
+        lpvs = validate_interleaved_strategies(cfg, hp)
+        block_fn = make_block_fn(cfg, hp.layer_strategies[:lpvs], mesh, axes)
+        pipe = interleaved_pipeline(block_fn, pp, hp.vpp, chunks, mesh)
+        init_params_fn = lambda key: init_interleaved_params(key, cfg, hp)
+        param_specs_fn = interleaved_param_specs
+        out_stage = 0  # finished micro-batches surface on device 0
+    else:
+        validate_pipeline_strategies(cfg, hp)
+        stage_fn = make_stage_fn(cfg, hp, mesh, axes)
+        if hp.pipeline_type == "pipedream_flush":
+            from galvatron_tpu.parallel.pipeline_1f1b import make_1f1b_train_step
+
+            return make_1f1b_train_step(
+                cfg, hp, mesh, axes, adam, global_batch_size, seq_len, stage_fn
+            )
+
+        pipe = gpipe_pipeline(stage_fn, pp, chunks, mesh)
+        init_params_fn = lambda key: init_pipeline_params(key, cfg, hp)
+        param_specs_fn = pipeline_param_specs
+        out_stage = pp - 1  # last stage holds GPipe outputs
     # full-batch spec for embedding/head compute: batch over pp + all data axes
     full_spec = P(("pp",) + axes.data_axes, None, None)
 
@@ -307,13 +334,15 @@ def build_pipeline_runtime(
         check_vma=False,
     )
 
+    layer_params_key = "vstages" if interleaved else "stages"
+
     def loss_fn(params, batch):
         tokens, labels = batch[:, :-1], batch[:, 1:]
         x = modeling.embed(tokens, params, cfg)
         x = constrain(x, mesh, full_spec)
         x_mbs = x.reshape(chunks, mb, *x.shape[1:])
-        ys = pipe_sm(params["stages"], x_mbs)  # (pp, chunks, mb, S, H)
-        y = ys[-1].reshape(global_batch_size, *x.shape[1:])
+        ys = pipe_sm(params[layer_params_key], x_mbs)  # (pp, chunks, mb, S, H)
+        y = ys[out_stage].reshape(global_batch_size, *x.shape[1:])
         y = constrain(y, mesh, full_spec)
         y = modeling.norm(y, params["final_norm"], cfg)
         logits = modeling.lm_head(y, params, cfg)
@@ -334,7 +363,7 @@ def build_pipeline_runtime(
         return {"params": new_params, "opt": new_opt, "step": state["step"] + 1}, loss
 
     def init_state(key):
-        params = init_pipeline_params(key, cfg, hp)
+        params = init_params_fn(key)
         state = {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
         if fp16:
             state["scaler"] = init_scaler_state(scaler_cfg)
@@ -342,10 +371,10 @@ def build_pipeline_runtime(
 
     state_shape = jax.eval_shape(init_state, jax.random.key(0))
     specs = {
-        "params": pipeline_param_specs(state_shape["params"], cfg, hp, axes),
+        "params": param_specs_fn(state_shape["params"], cfg, hp, axes),
         "opt": {
-            "mu": pipeline_param_specs(state_shape["params"], cfg, hp, axes, for_opt_state=True),
-            "nu": pipeline_param_specs(state_shape["params"], cfg, hp, axes, for_opt_state=True),
+            "mu": param_specs_fn(state_shape["params"], cfg, hp, axes, for_opt_state=True),
+            "nu": param_specs_fn(state_shape["params"], cfg, hp, axes, for_opt_state=True),
             "count": P(),
         },
         "step": P(),

@@ -1,0 +1,190 @@
+"""Interleaved (virtual-pipeline-stage) schedule over the pp ring.
+
+TPU-native rendering of the interleaved schedule the reference carries only
+in its vendored Megatron (core/pipeline_parallel/schedules.py:367,
+``--num-layers-per-virtual-pipeline-stage``) and never wires into Galvatron's
+own engine (SURVEY §2.3 'PP' row). Here it is first-class: the model is cut
+into ``vpp * pp`` *virtual stages*; device ``s`` holds virtual stages
+``{s, s+pp, ..., s+(vpp-1)·pp}``, so each micro-batch travels the device ring
+``vpp`` times. Ticks are one virtual stage long (1/vpp of a physical stage),
+shrinking the pipeline-fill bubble from ``(pp-1)·T/pp`` to ``(pp-1)·T/(pp·vpp)``
+— the same bubble/vpp factor as Megatron's interleaved 1F1B.
+
+Schedule (all static arithmetic, one ``lax.scan``): micro-batches flow in
+groups of ``pp`` (hence ``chunks % pp == 0``, the reference's own interleaved
+constraint). At tick ``t`` device ``s`` computes virtual chunk ``j`` of
+micro-batch ``m`` where, with ``n = t - s``::
+
+    r = n mod pp;  q = n div pp;  j = q mod vpp;  g = q div vpp;  m = g·pp + r
+
+This is a bijection (r, j, g) ↔ n, so every device is busy every tick of
+``[s, s + vpp·chunks)`` — the only idle ticks are the ``pp-1``-tick ramp.
+Sends ride one ring ``ppermute`` (the pp-1 → 0 edge carries the
+chunk-boundary handoff); finished micro-batches surface on device 0's receive
+port at ``j == 0`` ticks. Backward = autodiff reversing the scan (GPipe
+ordering); activation footprint is that of the forward scan, reduced per
+layer by the usual remat strategies.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+from galvatron_tpu.models import modeling
+from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.parallel.mesh import MeshAxes
+from galvatron_tpu.parallel.sharding import param_spec
+
+
+def validate_interleaved_strategies(cfg: ModelConfig, hp: HybridParallelConfig) -> int:
+    """Check the stacking constraint; returns layers per *virtual* stage.
+
+    All virtual stages share one (pp, vpp)-stacked param array per position,
+    hence one sharding: layer strategies must repeat with period
+    ``num_layers / (pp*vpp)`` across the whole model."""
+    L, pp, vpp = cfg.num_layers, hp.pp, hp.vpp
+    if L % (pp * vpp) != 0:
+        raise ValueError(f"pp*vpp={pp * vpp} must divide the layer count {L}")
+    lpvs = L // (pp * vpp)
+    for q in range(lpvs):
+        base = hp.layer_strategies[q]
+        for k in range(1, pp * vpp):
+            other = hp.layer_strategies[k * lpvs + q]
+            if other != base:
+                raise ValueError(
+                    f"interleaved schedule: layers at virtual-stage position {q} "
+                    f"must share one strategy across all {pp * vpp} virtual "
+                    f"stages (virtual stage 0 has {base}, {k} has {other})"
+                )
+    return lpvs
+
+
+def init_interleaved_params(key, cfg: ModelConfig, hp: HybridParallelConfig):
+    """Param tree: embed/final_norm/head as in the plain pipeline;
+    ``vstages[q]`` = position-q layer params stacked (pp, vpp, ...) — entry
+    [s, j] belongs to layer ``(s + j·pp)·lpvs + q``."""
+    lpvs = validate_interleaved_strategies(cfg, hp)
+    pp, vpp = hp.pp, hp.vpp
+    ks = jax.random.split(key, 4)
+    base = {
+        "embed": {
+            "tok": jax.random.normal(ks[0], (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+            * 0.02
+        },
+        "final_norm": {"scale": jnp.ones((cfg.hidden_size,), cfg.param_dtype)},
+    }
+    if cfg.pos_embed == "learned":
+        base["embed"]["pos"] = (
+            jax.random.normal(ks[1], (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype) * 0.02
+        )
+    if cfg.norm_type == "layernorm":
+        base["final_norm"]["bias"] = jnp.zeros((cfg.hidden_size,), cfg.param_dtype)
+    if not cfg.tie_word_embeddings:
+        base["head"] = {
+            "w": modeling._dense_init(ks[2], cfg.hidden_size, cfg.vocab_size, cfg.param_dtype)
+        }
+    layer_keys = jax.random.split(ks[3], cfg.num_layers)
+    vstages = []
+    for q in range(lpvs):
+        keys_q = jnp.stack(
+            [
+                jnp.stack([layer_keys[(s + j * pp) * lpvs + q] for j in range(vpp)])
+                for s in range(pp)
+            ]
+        )  # (pp, vpp, key)
+        vstages.append(
+            jax.vmap(jax.vmap(lambda k: modeling.init_layer_params(k, cfg)))(keys_q)
+        )
+    base["vstages"] = vstages
+    return base
+
+
+def interleaved_param_specs(
+    params_shape, cfg: ModelConfig, hp: HybridParallelConfig, axes: MeshAxes,
+    *, for_opt_state: bool = False,
+):
+    """vstages[q] leaves get P('pp', None, *strategy_q_spec) — the vpp dim is
+    replicated-by-stacking (each [s, j] slice is a distinct layer's params);
+    embed/head/norm identical to the plain pipeline."""
+    from galvatron_tpu.parallel.pipeline import pipeline_param_specs
+
+    lpvs = cfg.num_layers // (hp.pp * hp.vpp)
+    annots = modeling.layer_annotations(cfg)
+    is_leaf = lambda x: hasattr(x, "shape")
+    # embed/head/norm: reuse the plain-pipeline spec builder on a shape tree
+    # without the layer stacks
+    other_shape = {k: v for k, v in params_shape.items() if k != "vstages"}
+    specs = pipeline_param_specs(other_shape, cfg, hp, axes, for_opt_state=for_opt_state)
+    specs["vstages"] = []
+    for q in range(lpvs):
+        s_q = hp.layer_strategies[q]
+        specs["vstages"].append(
+            jax.tree.map(
+                lambda leaf, a: P(
+                    "pp", None,
+                    *param_spec(leaf.shape[2:], a, axes, s_q, for_opt_state=for_opt_state),
+                ),
+                params_shape["vstages"][q],
+                annots,
+                is_leaf=is_leaf,
+            )
+        )
+    return specs
+
+
+def interleaved_pipeline(block_fn, pp: int, vpp: int, chunks: int, mesh: Mesh):
+    """Returns f(vstage_params_local, x_mbs) -> ys for a manual-'pp' shard_map.
+    ``ys`` is (1, chunks, mb, S, H) locally; globally stacked over pp with the
+    real outputs in the pp=0 slice (finished micro-batches surface at device
+    0's receive port)."""
+
+    ring = [(i, (i + 1) % pp) for i in range(pp)]
+    n_total = vpp * chunks
+    T = n_total + pp
+
+    def run(vstage_params, x_mbs):
+        # strip the size-1 local 'pp' stacking dim → leaves (vpp, ...)
+        vstage_params = jax.tree.map(lambda a: jnp.squeeze(a, 0), vstage_params)
+        s = jax.lax.axis_index("pp")
+        mb_shape = x_mbs.shape[1:]
+        send0 = jnp.zeros(mb_shape, x_mbs.dtype)
+        # chunks real slots + one sacrificial slot for invalid-tick writes
+        ys0 = jnp.zeros((chunks + 1,) + mb_shape, x_mbs.dtype)
+
+        def tick(carry, t):
+            send, ys = carry
+            recv = jax.lax.ppermute(send, "pp", ring)
+            n = t - s
+            nc = jnp.maximum(n, 0)  # decomposition below needs n >= 0
+            r = jnp.mod(nc, pp)
+            q2 = nc // pp
+            j = jnp.mod(q2, vpp)
+            g = q2 // vpp
+            m = g * pp + r
+            first_in = jax.lax.dynamic_index_in_dim(
+                x_mbs, jnp.clip(m, 0, chunks - 1), keepdims=False
+            )
+            x_in = jnp.where((s == 0) & (j == 0), first_in, recv)
+            params_j = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, j, 0, keepdims=False),
+                vstage_params,
+            )
+            out = block_fn(params_j, x_in)
+            # capture: on device 0 a j==0 tick's incoming value is the finished
+            # output of micro-batch m - pp (sent by device pp-1, virtual chunk
+            # vpp-1, one tick earlier)
+            m_out = m - pp
+            cap = (s == 0) & (j == 0) & (m_out >= 0) & (m_out < chunks) & (n >= 0)
+            slot = jnp.where(cap, jnp.clip(m_out, 0, chunks - 1), chunks)
+            ys = jax.lax.dynamic_update_index_in_dim(ys, recv, slot, 0)
+            return (out, ys), None
+
+        (send, ys), _ = jax.lax.scan(tick, (send0, ys0), jnp.arange(T))
+        return ys[None, :chunks]
+
+    return run
