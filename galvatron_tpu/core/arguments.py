@@ -86,6 +86,9 @@ def _add_training_args(p: argparse.ArgumentParser):
     g.add_argument("--galvatron_config_path", type=str, default=None)
     g.add_argument("--attn_impl", type=str, default="auto", choices=["auto", "flash", "xla"])
     # checkpoint/resume (capability the reference only gestures at; SURVEY §5)
+    g.add_argument("--data_path", type=str, default=None,
+                   help="indexed-corpus prefix (<prefix>.bin/.idx.json, see "
+                   "galvatron_tpu.core.data); default = synthetic tokens")
     g.add_argument("--metrics_path", type=str, default=None,
                    help="JSONL structured metrics sink (per-iter loss/time)")
     g.add_argument("--save", type=str, default=None, help="checkpoint directory")

@@ -58,6 +58,22 @@ class RandomTokenDataset:
 
 
 def build_dataloader(cfg, global_batch_size: int, seq_len: Optional[int] = None,
-                     size: int = 1024, seed: int = 1234, start_batch: int = 0):
-    ds = RandomTokenDataset(cfg.vocab_size, seq_len or cfg.max_seq_len, size, seed)
+                     size: int = 1024, seed: int = 1234, start_batch: int = 0,
+                     data_path: Optional[str] = None):
+    """``data_path`` selects the real-corpus path: a ``write_indexed_dataset``
+    prefix is loaded memory-mapped and sampled GPT-window style
+    (galvatron_tpu.core.data); otherwise the synthetic random-token stream."""
+    seq_len = seq_len or cfg.max_seq_len
+    if data_path:
+        from galvatron_tpu.core.data import GPTWindowDataset, IndexedTokenDataset
+
+        indexed = IndexedTokenDataset(data_path)
+        if indexed.meta["vocab_size"] > cfg.vocab_size:
+            raise ValueError(
+                f"corpus vocab {indexed.meta['vocab_size']} exceeds the model "
+                f"vocab {cfg.vocab_size}"
+            )
+        ds = GPTWindowDataset(indexed, seq_len, seed)
+        return ds.batch_iterator(global_batch_size, start_batch=start_batch)
+    ds = RandomTokenDataset(cfg.vocab_size, seq_len, size, seed)
     return ds.batch_iterator(global_batch_size, start_batch=start_batch)

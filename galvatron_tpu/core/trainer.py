@@ -87,7 +87,8 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
     # start_batch fast-forwards by index arithmetic so resume sees the batches
     # an uninterrupted run would (reference has no resume at all)
     loader = build_dataloader(
-        cfg, ns.global_train_batch_size, seq, seed=ns.seed, start_batch=start_step
+        cfg, ns.global_train_batch_size, seq, seed=ns.seed, start_batch=start_step,
+        data_path=getattr(ns, "data_path", None),
     )
     from galvatron_tpu.core.signals import GracefulExitHandler
     from galvatron_tpu.utils.metrics import MetricsLogger
@@ -123,6 +124,7 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
                     loader = build_dataloader(
                         cfg, bs, seq, seed=ns.seed + bs,
                         start_batch=batches_at_size.get(bs, 0),
+                        data_path=getattr(ns, "data_path", None),
                     )
                 batches_at_size[bs] = batches_at_size.get(bs, 0) + 1
                 consumed += bs

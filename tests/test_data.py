@@ -1,0 +1,107 @@
+"""Indexed memory-mapped dataset tests (the megatron data/ subsystem role,
+SURVEY §2.6 — reference carries it unused; here it feeds the trainer)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from galvatron_tpu.core.data import (
+    GPTWindowDataset,
+    IndexedTokenDataset,
+    tokenize_text_file,
+    write_indexed_dataset,
+)
+
+
+def make_corpus(tmp_path, docs, vocab=256):
+    prefix = str(tmp_path / "corpus")
+    write_indexed_dataset(prefix, docs, vocab)
+    return prefix
+
+
+def test_roundtrip_docs(tmp_path):
+    docs = [[1, 2, 3], [4, 5], list(range(100, 150))]
+    prefix = make_corpus(tmp_path, docs)
+    ds = IndexedTokenDataset(prefix)
+    assert ds.num_docs == 3
+    assert ds.num_tokens == sum(len(d) for d in docs)
+    for i, d in enumerate(docs):
+        np.testing.assert_array_equal(ds.doc(i), d)
+    # uint16 chosen for small vocab
+    assert ds.dtype == np.uint16
+
+
+def test_int32_for_large_vocab(tmp_path):
+    prefix = str(tmp_path / "big")
+    write_indexed_dataset(prefix, [[0, 70000]], vocab_size=100000)
+    ds = IndexedTokenDataset(prefix)
+    assert ds.dtype == np.int32
+    np.testing.assert_array_equal(ds.doc(0), [0, 70000])
+
+
+def test_out_of_range_tokens_rejected(tmp_path):
+    with pytest.raises(ValueError, match="outside"):
+        write_indexed_dataset(str(tmp_path / "x"), [[5, 999]], vocab_size=256)
+
+
+def test_window_sampling_covers_stream(tmp_path):
+    stream = list(range(0, 201))  # 201 tokens, seq 10 → 20 windows
+    prefix = make_corpus(tmp_path, [stream], vocab=256)
+    ds = GPTWindowDataset(IndexedTokenDataset(prefix), seq_len=10, seed=0)
+    assert len(ds) == 20
+    s0 = ds.sample(0)
+    np.testing.assert_array_equal(s0, np.arange(0, 11))
+    s19 = ds.sample(19)
+    np.testing.assert_array_equal(s19, np.arange(190, 201))
+
+
+def test_batch_iterator_resume_determinism(tmp_path):
+    prefix = make_corpus(tmp_path, [list(np.random.RandomState(0).randint(0, 256, 500))])
+    ds = GPTWindowDataset(IndexedTokenDataset(prefix), seq_len=8, seed=7)
+    full = [b.copy() for _, b in zip(range(9), ds.batch_iterator(4))]
+    resumed = [b.copy() for _, b in zip(range(4), ds.batch_iterator(4, start_batch=5))]
+    for a, b in zip(full[5:], resumed):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_tokenize_text_file(tmp_path):
+    from galvatron_tpu.models.tokenizer import ByteTokenizer
+
+    txt = tmp_path / "t.txt"
+    txt.write_text("hello world\nsecond doc\n\n")
+    prefix = str(tmp_path / "tok")
+    tok = ByteTokenizer()
+    meta = tokenize_text_file(prefix, str(txt), tok)
+    ds = IndexedTokenDataset(prefix)
+    assert ds.num_docs == 2  # blank line skipped
+    assert tok.decode(list(ds.doc(0))).endswith("hello world")
+
+
+def test_corrupt_index_rejected(tmp_path):
+    prefix = make_corpus(tmp_path, [[1, 2, 3]])
+    meta = json.load(open(prefix + ".idx.json"))
+    meta["num_tokens"] = 99
+    json.dump(meta, open(prefix + ".idx.json", "w"))
+    with pytest.raises(ValueError, match="corrupt"):
+        IndexedTokenDataset(prefix)
+
+
+def test_train_on_indexed_corpus_cli(tmp_path, capsys):
+    """End-to-end: build a corpus, train on it via --data_path, loss drops
+    toward memorization (real-data path through the trainer)."""
+    from galvatron_tpu.cli import main as cli_main
+
+    rng = np.random.RandomState(3)
+    prefix = make_corpus(tmp_path, [list(rng.randint(0, 128, 2000))], vocab=128)
+    rc = cli_main(
+        ["train", "--model_size", "llama-0.3b",
+         "--hidden_size", "64", "--num_layers", "2", "--num_heads", "4",
+         "--ffn_dim", "128", "--vocab_size", "128", "--seq_length", "32",
+         "--global_train_batch_size", "8", "--train_iters", "3",
+         "--mixed_precision", "fp32", "--check_loss", "1",
+         "--data_path", prefix]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "iter 2: loss" in out
