@@ -79,6 +79,7 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             allow_zero3=not ns.disable_sdp,
             allow_strided=not ns.disable_tp_consec,
             allow_cp=bool(ns.enable_cp),
+            max_vpp=ns.max_vpp_deg,
         )
         if ns.search_space == "dp":
             sspace.max_tp, sspace.pp_choices = 1, [1]

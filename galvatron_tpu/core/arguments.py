@@ -114,6 +114,9 @@ def _add_search_args(p: argparse.ArgumentParser):
     g.add_argument("--disable_tp_consec", type=int, default=0)
     g.add_argument("--enable_cp", type=int, default=0)
     g.add_argument("--max_tp_deg", type=int, default=8)
+    g.add_argument("--max_vpp_deg", type=int, default=1,
+                   help="search interleaved virtual-stage degrees up to this "
+                   "(powers of two; 1 = plain schedules only)")
     g.add_argument("--analytic_costs", type=int, default=0,
                    help="1 = search on analytic (unprofiled) model costs "
                    "(theoretical_memory_usage equivalent)")

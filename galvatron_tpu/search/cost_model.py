@@ -263,13 +263,19 @@ def pipeline_time_cost(
     pp: int,
     chunks: int,
     hw: ProfiledHardware,
+    vpp: int = 1,
 ) -> float:
     """Iteration time of the clocked pipeline (reference: pipeline_costmodel,
     galvatron/core/cost_model.py:372-427): fill + steady-state bottleneck.
-    stage_ms: per-stage per-micro-batch compute+TP time."""
+    stage_ms: per-stage per-micro-batch compute+TP time.
+
+    vpp>1 (interleaved schedule): ticks are one virtual stage (1/vpp of a
+    physical stage) long, so the pp-1-tick fill bubble shrinks by vpp, while
+    every micro-batch crosses vpp× more ring boundaries (p2p volume ×vpp).
+    The vpp=1 case reduces to the plain formula."""
     if pp == 1:
         return sum(stage_ms)
     p2p_ms = boundary_msg_mb / hw.p2p(pp) if boundary_msg_mb else 0.0
-    per_tick = [c + p2p_ms for c in stage_ms]
+    per_tick = [c / vpp + p2p_ms for c in stage_ms]
     bottleneck = max(per_tick)
-    return sum(per_tick) + bottleneck * (chunks - 1)
+    return sum(per_tick) + bottleneck * (vpp * chunks - 1)

@@ -50,6 +50,9 @@ class SearchSpace:
     allow_cp: bool = False
     pp_choices: Optional[List[int]] = None
     pipeline_types: Tuple[str, ...] = ("gpipe", "pipedream_flush")
+    # interleaved virtual stages: search vpp ∈ powers of two up to max_vpp
+    # (gpipe schedule only; 1 = off)
+    max_vpp: int = 1
 
 
 def _pow2s(n: int) -> List[int]:
@@ -128,7 +131,7 @@ class SearchEngine:
     # -- single (pp, bsz, chunks, pipeline_type) evaluation ------------------
 
     def evaluate(
-        self, pp: int, global_bsz: int, chunks: int, pipeline_type: str
+        self, pp: int, global_bsz: int, chunks: int, pipeline_type: str, vpp: int = 1
     ) -> Optional[SearchResult]:
         space = self.space
         world = space.world_size
@@ -136,6 +139,12 @@ class SearchEngine:
             return None
         if global_bsz % chunks:
             return None
+        if vpp > 1:
+            # interleaved-schedule constraints (strategy.py validate)
+            if pp == 1 or pipeline_type != "gpipe":
+                return None
+            if self.L % (pp * vpp) or chunks % pp:
+                return None
         lps = self.L // pp
         cands = generate_layer_strategies(space, pp)
         # the micro-batch (global_bsz / chunks) must split over each
@@ -158,9 +167,10 @@ class SearchEngine:
         V = int(budget / self.unit)
 
         # positions: pp=1 → every layer; pp>1 → one per stage position (the
-        # stage-stacking constraint makes positions the DP unit); memory is
-        # identical across stages, stage 0 carries the 1F1B worst case
-        n_pos = self.L if pp == 1 else lps
+        # stage-stacking constraint makes positions the DP unit; vpp>1 tightens
+        # the period to layers-per-virtual-stage); memory is identical across
+        # stages, stage 0 carries the 1F1B worst case
+        n_pos = self.L if pp == 1 else lps // vpp
         mem = np.zeros((n_pos, S), np.int32)
         intra = np.zeros((n_pos, S), np.float64)
         for j in range(n_pos):
@@ -170,7 +180,8 @@ class SearchEngine:
                     lt, s, world, pp, global_bsz, chunks, stage_idx=0,
                     pipeline_type=pipeline_type, mixed_precision=self.mp,
                 )
-                mem[j, k] = max(1, int(np.ceil(mc.total_mb / self.unit)))
+                # a device holds vpp layers per searched position (interleaved)
+                mem[j, k] = max(1, int(np.ceil(vpp * mc.total_mb / self.unit)))
                 intra[j, k] = layer_time_cost(
                     lt, s, self.hw, world, pp, global_bsz, mixed_precision=self.mp
                 )
@@ -188,17 +199,20 @@ class SearchEngine:
 
         chosen = [cands[k] for k in res]
         if pp > 1:
-            layer_strategies = chosen * pp  # same per-position pattern each stage
-            per_stage_ms = sum(intra[j, res[j]] for j in range(lps)) / chunks
+            # same per-position pattern in every (virtual) stage
+            layer_strategies = chosen * (pp * vpp)
+            per_stage_ms = sum(intra[j, res[j]] for j in range(n_pos)) * vpp / chunks
             stage_ms = [per_stage_ms] * pp
             boundary_msg = (
                 lt0.boundary_activation_mb_per_sample
                 * (global_bsz / chunks)
-                * (0.5 if self.mp == "bf16" else 1.0)
+                * (0.5 if self.mp in ("bf16", "fp16") else 1.0)
             )
-            total_ms = pipeline_time_cost(stage_ms, boundary_msg, pp, chunks, self.hw)
+            total_ms = pipeline_time_cost(
+                stage_ms, boundary_msg, pp, chunks, self.hw, vpp=vpp
+            )
             total_ms += sum(
-                inter[res[j], res[j + 1]] for j in range(lps - 1)
+                inter[res[j], res[j + 1]] for j in range(n_pos - 1)
             )
         else:
             layer_strategies = chosen
@@ -207,6 +221,7 @@ class SearchEngine:
         total_ms += self.costs.other_fwd_ms_per_sample * global_bsz / world * 3.0
         hp = HybridParallelConfig(
             pp=pp,
+            vpp=vpp,
             layer_strategies=layer_strategies,
             chunks=chunks,
             pipeline_type=pipeline_type,
@@ -221,7 +236,7 @@ class SearchEngine:
             throughput_samples_per_s=global_bsz / (total_ms / 1000.0),
             global_bsz=global_bsz,
             memory_mb=float(mem_used * self.unit),
-            details={"pp": pp, "chunks": chunks, "pipeline_type": pipeline_type},
+            details={"pp": pp, "vpp": vpp, "chunks": chunks, "pipeline_type": pipeline_type},
         )
 
     # -- full optimization loop ---------------------------------------------
@@ -245,19 +260,28 @@ class SearchEngine:
                     if pp == 1 and chunks > 1 and len(chunk_opts) > 1:
                         pass  # accumulation also searched at pp=1
                     for ptype in self.space.pipeline_types if pp > 1 else ("gpipe",):
-                        r = self.evaluate(pp, bsz, chunks, ptype)
-                        if r is None:
-                            continue
-                        if verbose:
-                            print(
-                                f"bsz={bsz} pp={pp} chunks={chunks} {ptype}: "
-                                f"{r.cost_ms:.1f} ms, {r.throughput_samples_per_s:.2f} samples/s, "
-                                f"mem {r.memory_mb:.0f} MB"
-                            )
-                        if best is None or (
-                            r.throughput_samples_per_s > best.throughput_samples_per_s
-                        ):
-                            best = r
+                        vpps = [1]
+                        if pp > 1 and ptype == "gpipe":
+                            vpps = [
+                                v for v in _pow2s(self.space.max_vpp)
+                                if self.L % (pp * v) == 0
+                            ]
+                        for vpp in vpps:
+                            r = self.evaluate(pp, bsz, chunks, ptype, vpp=vpp)
+                            if r is None:
+                                continue
+                            if verbose:
+                                vtag = f" vpp={vpp}" if vpp > 1 else ""
+                                print(
+                                    f"bsz={bsz} pp={pp} chunks={chunks} {ptype}{vtag}: "
+                                    f"{r.cost_ms:.1f} ms, "
+                                    f"{r.throughput_samples_per_s:.2f} samples/s, "
+                                    f"mem {r.memory_mb:.0f} MB"
+                                )
+                            if best is None or (
+                                r.throughput_samples_per_s > best.throughput_samples_per_s
+                            ):
+                                best = r
         if best is not None and verbose:
             s0 = best.config.layer_strategies[0]
             dp = self.space.world_size // (best.config.pp * s0.tp * s0.cp)

@@ -181,3 +181,26 @@ def test_pipeline_search_respects_stacking():
         base = hp.layer_strategies[j]
         for s in range(1, hp.pp):
             assert hp.layer_strategies[s * lps + j] == base
+
+
+def test_vpp_searched_and_reduces_pipeline_cost():
+    """Interleaved schedule in the search: the vpp>1 evaluation must beat the
+    plain gpipe cost for the same (pp, chunks) — the bubble shrinks by vpp —
+    and the winning config must carry vpp through the JSON codec."""
+    eng = make_engine(3000.0, max_vpp=2, pipeline_types=("gpipe",))
+    r1 = eng.evaluate(pp=2, global_bsz=16, chunks=4, pipeline_type="gpipe")
+    r2 = eng.evaluate(pp=2, global_bsz=16, chunks=4, pipeline_type="gpipe", vpp=2)
+    assert r1 is not None and r2 is not None
+    assert r2.cost_ms < r1.cost_ms
+    assert r2.config.vpp == 2 and len(r2.config.layer_strategies) == 8
+    # constraints: chunks % pp and layers % (pp*vpp)
+    assert eng.evaluate(2, 16, 2, "gpipe", vpp=8) is None  # 8 layers % 16 != 0
+    assert eng.evaluate(2, 18, 3, "gpipe", vpp=2) is None  # chunks 3 % pp 2
+    assert eng.evaluate(2, 16, 4, "pipedream_flush", vpp=2) is None
+    # the full sweep explores vpp when enabled
+    best = eng.search([16])
+    assert best is not None
+    d = best.config.to_json_dict()
+    from galvatron_tpu.core.strategy import HybridParallelConfig
+
+    assert HybridParallelConfig.from_json_dict(d).vpp == best.config.vpp
