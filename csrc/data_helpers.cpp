@@ -1,0 +1,40 @@
+// Native data-pipeline helpers (C ABI, bound via ctypes).
+//
+// Role of the reference's megatron/data/helpers.cpp (C++ sample-map /
+// shuffle-index builders behind the GPT dataset): epoch shuffles over
+// millions of sample windows are built natively instead of in Python.
+//
+// The permutation is a keyed-hash argsort: key(i) = splitmix64(seed ^ i),
+// order = stable-sort of indices by key. The same arithmetic is implemented
+// in numpy as the fallback (galvatron_tpu/core/data_native.py), so the
+// shuffle is bit-identical whether or not the native library is available —
+// resume determinism never depends on the build environment.
+
+#include <algorithm>
+#include <cstdint>
+#include <numeric>
+#include <vector>
+
+static inline uint64_t splitmix64(uint64_t x) {
+  uint64_t z = x + 0x9E3779B97F4A7C15ULL;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+extern "C" {
+
+// Fill out[0..n) with the permutation of [0, n) ordered by
+// splitmix64(seed ^ i). Stable sort, matching numpy's stable argsort.
+void galvatron_shuffle_index(int64_t n, uint64_t seed, int64_t* out) {
+  std::vector<uint64_t> keys(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) {
+    keys[static_cast<size_t>(i)] = splitmix64(seed ^ static_cast<uint64_t>(i));
+  }
+  std::iota(out, out + n, static_cast<int64_t>(0));
+  std::stable_sort(out, out + n, [&](int64_t a, int64_t b) {
+    return keys[static_cast<size_t>(a)] < keys[static_cast<size_t>(b)];
+  });
+}
+
+}  // extern "C"

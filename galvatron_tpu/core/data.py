@@ -140,10 +140,11 @@ class GPTWindowDataset:
                 f"global_batch_size {global_batch_size} exceeds the "
                 f"{self.num_samples} available windows"
             )
+        from galvatron_tpu.core.data_native import shuffle_index
+
         epoch, skip = divmod(start_batch, per_epoch)
         while epochs is None or epoch < epochs:
-            rng = np.random.RandomState(self.seed + epoch)
-            order = rng.permutation(self.num_samples)
+            order = shuffle_index(self.num_samples, self.seed + epoch)
             for b in range(skip, per_epoch):
                 idx = order[b * global_batch_size : (b + 1) * global_batch_size]
                 yield np.stack([self.sample(int(i)) for i in idx])

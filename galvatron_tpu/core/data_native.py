@@ -1,0 +1,83 @@
+"""Loader for the native data helpers (csrc/data_helpers.cpp).
+
+Same build/bind pattern as the DP core (galvatron_tpu.search.native): g++ on
+first use, C ABI via ctypes, and a NumPy fallback computing the *identical*
+permutation (keyed-hash argsort with splitmix64), so epoch shuffles are
+bit-equal with or without the native library. Reference analogue:
+megatron/data/helpers.cpp sample/shuffle index builders.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_SRC = _REPO_ROOT / "csrc" / "data_helpers.cpp"
+_BUILD_DIR = _REPO_ROOT / "build"
+_SO = _BUILD_DIR / "libgalvatron_data_helpers.so"
+
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    _BUILD_DIR.mkdir(exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SRC), "-o", str(_SO)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_data_helpers() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    try:
+        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+            if not _build():
+                _load_failed = True
+                return None
+        lib = ctypes.CDLL(str(_SO))
+        lib.galvatron_shuffle_index.restype = None
+        lib.galvatron_shuffle_index.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_uint64,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ]
+        _lib = lib
+        return _lib
+    except Exception:
+        _load_failed = True
+        return None
+
+
+def _splitmix64_np(x: np.ndarray) -> np.ndarray:
+    z = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+def shuffle_index(n: int, seed: int) -> np.ndarray:
+    """Deterministic permutation of [0, n): stable argsort of
+    splitmix64(seed ^ i). Native when available, numpy otherwise — identical
+    output either way."""
+    lib = get_data_helpers()
+    if lib is not None:
+        out = np.empty((n,), np.int64)
+        lib.galvatron_shuffle_index(
+            np.int64(n), np.uint64(np.uint64(seed) & np.uint64(2**64 - 1)), out
+        )
+        return out
+    with np.errstate(over="ignore"):
+        keys = _splitmix64_np(np.uint64(seed) ^ np.arange(n, dtype=np.uint64))
+    return np.argsort(keys, kind="stable").astype(np.int64)
