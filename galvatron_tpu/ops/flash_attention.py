@@ -315,15 +315,17 @@ def flash_attention(
     v,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int = 1024,
+    block_k: int = 1024,
 ):
     """q, k, v: (batch, seq, heads, head_dim); returns same layout.
 
     GQA callers repeat kv heads first (modeling._repeat_kv). Tiles of
     (block_q, block_k); shapes that don't tile fall back to the einsum path.
-    Defaults tuned on v5e (seq 2048, d 128): 512/512 beats XLA attention on
-    both passes; 128/128 loses on the backward.
+    Defaults tuned on v5e (b8 x s2048 x h32 x d128): 1024/1024 runs the
+    forward at 18.5 ms and fwd+bwd at 29.6 ms vs 21.3/34.2 at 512/512 (XLA
+    attention: 45 ms forward); 2048/512 is marginally faster forward-only but
+    fails to compile the backward.
     """
     b, s, n, d = q.shape
     if sm_scale is None:
