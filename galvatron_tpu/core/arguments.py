@@ -53,6 +53,9 @@ def _add_training_args(p: argparse.ArgumentParser):
     g.add_argument("--weight_decay", type=float, default=0.01)
     g.add_argument("--grad_clip", type=float, default=1.0)
     g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--multihost", type=int, default=0,
+                   help="1 = jax.distributed.initialize() (TPU pod slices; "
+                   "every host runs the same command)")
     g.add_argument("--mixed_precision", type=str, default="bf16",
                    choices=["fp32", "bf16", "fp16"],
                    help="fp16 adds dynamic loss scaling (skip-on-overflow); "

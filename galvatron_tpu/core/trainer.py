@@ -29,6 +29,12 @@ from galvatron_tpu.profiling.runtime import RuntimeProfiler
 
 
 def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
+    if getattr(ns, "multihost", 0):
+        # join the multi-host job (TPU pods: coordinator/process id are
+        # auto-detected from the TPU metadata; DCN carries the collectives) —
+        # the reference's torch.distributed.init_process_group role
+        # (site_package/megatron/initialize.py _initialize_distributed)
+        jax.distributed.initialize()
     cfg = model_config_from_args(ns)
     if ns.attn_impl != "auto":
         cfg = cfg.replace(attn_impl=ns.attn_impl)
@@ -131,7 +137,7 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
             else:
                 consumed += cur_bs
             iters_run += 1
-            batch = jnp.asarray(next(loader))
+            batch = rt.shard_batch(next(loader))
             prof.begin_iter()
             state, loss = rt.train_step(state, batch)
             prof.end_iter(loss if (ns.profile or ns.check_loss) else None)

@@ -115,6 +115,27 @@ class HybridParallelRuntime:
     eval_loss: Callable  # (state, batch) -> loss
     init_state: Callable  # (key) -> state
     state_shardings: Any
+    batch_sharding: Any = None  # NamedSharding of the token batch
+
+    def shard_batch(self, batch_np):
+        """Global on-device batch from a (host-replicated) numpy batch.
+
+        Single-process: a device_put. Multi-host (TPU pods over DCN): every
+        process runs the same deterministic loader, and
+        ``jax.make_array_from_callback`` materializes only the rows this
+        process's addressable devices own — the distributed data path the
+        reference gets from DistributedSampler + NCCL
+        (utils/training_utils.py:14-23)."""
+        import numpy as _np
+
+        batch_np = _np.asarray(batch_np)
+        if self.batch_sharding is None or jax.process_count() == 1:
+            if self.batch_sharding is None:
+                return jnp.asarray(batch_np)
+            return jax.device_put(batch_np, self.batch_sharding)
+        return jax.make_array_from_callback(
+            batch_np.shape, self.batch_sharding, lambda idx: batch_np[idx]
+        )
 
 
 def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axes: MeshAxes):
@@ -297,5 +318,5 @@ def build_runtime(
     return HybridParallelRuntime(
         cfg=cfg, hp=hp, mesh=mesh, axes=axes, adam=adam,
         train_step=jit_train, eval_loss=jit_eval, init_state=jit_init,
-        state_shardings=shardings,
+        state_shardings=shardings, batch_sharding=batch_sharding,
     )

@@ -400,5 +400,5 @@ def build_pipeline_runtime(
     return HybridParallelRuntime(
         cfg=cfg, hp=hp, mesh=mesh, axes=axes, adam=adam,
         train_step=jit_train, eval_loss=jit_eval, init_state=jit_init,
-        state_shardings=shardings,
+        state_shardings=shardings, batch_sharding=batch_sharding,
     )

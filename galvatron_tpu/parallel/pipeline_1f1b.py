@@ -299,5 +299,5 @@ def make_1f1b_train_step(
     return HybridParallelRuntime(
         cfg=cfg, hp=hp, mesh=mesh, axes=axes, adam=adam,
         train_step=jit_train, eval_loss=jit_eval, init_state=jit_init,
-        state_shardings=shardings,
+        state_shardings=shardings, batch_sharding=batch_sharding,
     )

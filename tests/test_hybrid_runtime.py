@@ -156,3 +156,17 @@ def test_param_shardings_applied():
     assert wq3.sharding.spec[0] is None
     mu3 = state["opt"]["mu"]["layers"][3]["attn"]["wq"]
     assert mu3.sharding.spec[0] is not None
+
+
+def test_shard_batch_places_global_batch():
+    """rt.shard_batch device_puts with the batch sharding (single-process
+    path; the multi-host path uses the same sharding via
+    make_array_from_callback)."""
+    import numpy as np_
+
+    hp = HybridParallelConfig.uniform(4, tp=1, mixed_precision="fp32")
+    rt = build_runtime(CFG, hp, adam=ADAM, global_batch_size=8, seq_len=32)
+    b = np_.zeros((8, 33), np_.int32)
+    arr = rt.shard_batch(b)
+    assert arr.sharding == rt.batch_sharding
+    assert arr.shape == (8, 33)
