@@ -1,0 +1,17 @@
+"""BERT encoder family entry — masked-LM pretraining.
+
+The reference carries encoder support only as legacy branches (bert handling
+in galvatron/core/parallel.py:64-89 and cost_model.py model_type); here it is
+a live family: bidirectional attention (``causal=False``) through the same
+hybrid-parallel runtime, deterministic token-hash MLM objective
+(modeling.mlm_loss_sum), sizes bert-base/large.
+"""
+
+DEFAULT_MODEL = "bert-base"
+SIZES = ("bert-base", "bert-large")
+
+
+def main(argv=None):
+    from galvatron_tpu.cli import main as cli_main
+
+    return cli_main(argv, model_default=DEFAULT_MODEL)

@@ -1,0 +1,3 @@
+from galvatron_tpu.models.bert import main
+
+raise SystemExit(main())

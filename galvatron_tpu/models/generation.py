@@ -181,6 +181,11 @@ def generate(
     text_generation/generation.py generate_tokens_probs_and_return_on_first_
     stage). Returns (B, P + max_new_tokens); positions past a row's eos are
     ``pad_id``."""
+    if not cfg.causal or cfg.objective != "clm":
+        raise ValueError(
+            "generation requires a causal LM (encoder families like bert "
+            "train with objective='mlm' and cannot decode autoregressively)"
+        )
     b, p_len = prompt.shape
     if min_prompt_len is None:
         min_prompt_len = p_len

@@ -50,6 +50,14 @@ class ModelConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     attn_impl: str = "xla"  # 'xla' | 'flash' | 'ring'
+    # decoder (causal LM) vs encoder (bidirectional, e.g. BERT) attention.
+    # The reference's legacy encoder support (bert/vit branches,
+    # galvatron/core/parallel.py:64-89, cost_model.py model_type).
+    causal: bool = True
+    # training objective: 'clm' next-token LM; 'mlm' masked-LM (encoder
+    # pretraining) with deterministic token-hash masking (see mlm_loss_sum)
+    objective: str = "clm"
+    mlm_mask_rate: float = 0.15
     fused_norm: bool = True  # Pallas fused rms/layernorm on TPU (jnp on CPU)
     dtype: Any = jnp.bfloat16  # compute dtype
     param_dtype: Any = jnp.float32
@@ -278,10 +286,11 @@ def attention_xla(q, k, v, cfg: ModelConfig, bias=None, q_offset=0):
     scores = jnp.einsum("bqnh,bknh->bnqk", q, k).astype(jnp.float32) / np.sqrt(hd)
     if bias is not None:
         scores = scores + bias
-    q_pos = q_offset + jnp.arange(s)
-    k_pos = jnp.arange(k.shape[1])
-    causal = k_pos[None, :] <= q_pos[:, None]
-    scores = jnp.where(causal[None, None], scores, -1e30)
+    if cfg.causal:
+        q_pos = q_offset + jnp.arange(s)
+        k_pos = jnp.arange(k.shape[1])
+        causal = k_pos[None, :] <= q_pos[:, None]
+        scores = jnp.where(causal[None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bnqk,bknh->bqnh", probs, v)
 
@@ -293,7 +302,7 @@ def attention(q, k, v, cfg: ModelConfig, bias=None):
         nh = q.shape[2]
         k = _repeat_kv(k, nh // k.shape[2])
         v = _repeat_kv(v, nh // v.shape[2])
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=cfg.causal)
     return attention_xla(q, k, v, cfg, bias=bias)
 
 
@@ -405,9 +414,37 @@ def cross_entropy_loss(logits, labels, ignore_index: int = -100):
     return s / jnp.maximum(n, 1)
 
 
+def mlm_positions(tokens, cfg: ModelConfig):
+    """Deterministic masked-LM positions: multiplicative token⊕position hash
+    thresholded at ``mlm_mask_rate``. Keeping masking a pure function of the
+    batch (instead of RNG state) preserves the framework-wide contract that
+    loss depends only on (params, batch) — resume/parity tests hold for
+    encoders exactly as for decoders."""
+    pos = jnp.arange(tokens.shape[-1], dtype=jnp.uint32)
+    h = tokens.astype(jnp.uint32) * jnp.uint32(2654435761) + pos * jnp.uint32(40503)
+    h = (h ^ (h >> jnp.uint32(16))) * jnp.uint32(2246822519)
+    frac = (h & jnp.uint32(0xFFFF)).astype(jnp.float32) / 65536.0
+    return frac < cfg.mlm_mask_rate
+
+
+def mlm_loss_sum(params, batch, cfg: ModelConfig, layer_hook=None):
+    """(nll_sum, masked_token_count) BERT-style masked-LM pieces on the same
+    (B, S+1) token batches the CLM path uses. The last vocab id serves as
+    [MASK]; only masked positions contribute loss."""
+    tokens = batch[:, :-1]
+    mask = mlm_positions(tokens, cfg)
+    inputs = jnp.where(mask, cfg.vocab_size - 1, tokens)
+    labels = jnp.where(mask, tokens, -100)
+    logits = forward(params, inputs, cfg, layer_hook=layer_hook)
+    return cross_entropy_sum(logits, labels)
+
+
 def lm_loss_sum(params, batch, cfg: ModelConfig, layer_hook=None):
-    """(nll_sum, token_count) next-token loss pieces on a (B, S+1) token batch
-    (reference synthetic-data convention: models/llama_hf/dataloader.py:5-30)."""
+    """(nll_sum, token_count) loss pieces on a (B, S+1) token batch
+    (reference synthetic-data convention: models/llama_hf/dataloader.py:5-30).
+    Dispatches on cfg.objective: 'clm' next-token; 'mlm' masked-LM."""
+    if cfg.objective == "mlm":
+        return mlm_loss_sum(params, batch, cfg, layer_hook=layer_hook)
     tokens = batch[:, :-1]
     labels = batch[:, 1:]
     logits = forward(params, tokens, cfg, layer_hook=layer_hook)
@@ -456,6 +493,18 @@ PRESETS: Dict[str, ModelConfig] = {
         vocab_size=50257, hidden_size=4096, num_layers=32, num_heads=32,
         max_seq_len=2048, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
         tie_word_embeddings=True,
+    ),
+    # encoder families (reference legacy bert support: core/parallel.py:64-89,
+    # cost_model.py model_type handling)
+    "bert-base": ModelConfig(
+        vocab_size=30528, hidden_size=768, num_layers=12, num_heads=12,
+        max_seq_len=512, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
+        tie_word_embeddings=True, causal=False, objective="mlm",
+    ),
+    "bert-large": ModelConfig(
+        vocab_size=30528, hidden_size=1024, num_layers=24, num_heads=16,
+        max_seq_len=512, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
+        tie_word_embeddings=True, causal=False, objective="mlm",
     ),
     "baichuan-7b": ModelConfig(
         vocab_size=64000, hidden_size=4096, num_layers=32, num_heads=32,

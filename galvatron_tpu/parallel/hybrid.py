@@ -202,6 +202,11 @@ def build_runtime(
             f"strategy has {hp.num_layers} layer entries but model has {cfg.num_layers} layers"
         )
     hp.validate(mesh.devices.size)
+    if not cfg.causal and any(s.cp > 1 for s in hp.layer_strategies):
+        raise ValueError(
+            "context parallelism (cp>1) is causal-only (ring/Ulysses kernels "
+            "assume a causal mask); encoder models must use tp/sp instead"
+        )
     seq_len = seq_len or cfg.max_seq_len
 
     if cfg.dtype != jnp.float32 and hp.mixed_precision == "fp32":

@@ -182,3 +182,11 @@ def test_ulysses_layer_in_hybrid_runtime():
         state, loss = rt.train_step(state, b)
         losses.append(float(loss))
     np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_non_causal_matches_reference():
+    q, k, v = rand_qkv(jax.random.key(9), s=64)
+    out = flash_attention(q, k, v, causal=False, block_q=32, block_k=32)
+    cfg = ModelConfig(num_heads=2, hidden_size=64, causal=False)
+    ref = modeling.attention_xla(q, k, v, cfg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
