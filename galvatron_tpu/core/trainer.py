@@ -163,7 +163,26 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
     metrics.close()
     # throughput from actual samples processed (rampup runs at smaller sizes)
     avg_bs = (consumed - consumed_at_start) / iters_run if iters_run else 0
-    report = prof.report(avg_bs, seq) if prof.iter_times_ms else ""
+    # cost-model fidelity: predicted-vs-measured iteration time when training
+    # the searched strategy at its searched batch size (the benchmark the
+    # reference itself optimizes, SURVEY §6; search_cost_ms is written by
+    # SearchEngine.save_result)
+    predicted_ms = None
+    if ns.galvatron_config_path:
+        import json as _json
+
+        try:
+            with open(ns.galvatron_config_path) as f:
+                d = _json.load(f)
+            if d.get("global_bsz") == ns.global_train_batch_size:
+                predicted_ms = d.get("search_cost_ms")
+        except (OSError, ValueError):
+            pass
+    report = (
+        prof.report(avg_bs, seq, predicted_ms=predicted_ms)
+        if prof.iter_times_ms
+        else ""
+    )
     if verbose and report:
         print(report)
     return {

@@ -161,3 +161,23 @@ def test_model_family_entries(capsys):
         )
         assert rc == 0
         assert "iter 0: loss" in capsys.readouterr().out
+
+
+def test_fidelity_report_on_searched_config(tmp_path, capsys):
+    """Training the searched config at its searched batch size prints the
+    predicted-vs-measured fidelity line (SURVEY §6 — the benchmark the
+    reference itself optimizes)."""
+    cfg_path = str(tmp_path / "cfg.json")
+    rc = cli_main(
+        ["search", *TINY, "--num_devices", "8", "--memory_constraint_gb", "1",
+         "--settle_bsz", "8", "--output_config_path", cfg_path]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    rc = cli_main(
+        ["train", *TINY, "--global_train_batch_size", "8", "--train_iters", "3",
+         "--galvatron_config_path", cfg_path, "--mixed_precision", "fp32",
+         "--profile", "1"]
+    )
+    assert rc == 0
+    assert "cost-model fidelity: predicted" in capsys.readouterr().out
