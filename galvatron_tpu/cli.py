@@ -98,7 +98,7 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             sspace.allow_zero2 = sspace.allow_zero3 = False
             sspace.allow_ckpt = sspace.allow_sp = sspace.allow_strided = False
         eng = SearchEngine(
-            costs, hw, num_layers=cfg.num_layers, space=sspace,
+            costs, hw, num_layers=cfg.total_layers, space=sspace,
             memory_budget_mb=ns.memory_constraint_gb * 1024.0,
             mixed_precision="bf16",
         )

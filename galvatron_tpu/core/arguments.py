@@ -31,6 +31,9 @@ def _add_model_args(p: argparse.ArgumentParser):
     g.add_argument("--num_kv_heads", type=int, default=None)
     g.add_argument("--ffn_dim", type=int, default=None)
     g.add_argument("--seq_length", type=int, default=None)
+    g.add_argument("--enc_layers", type=int, default=None,
+                   help="encoder layers (enc-dec families; 0 = decoder-only)")
+    g.add_argument("--enc_seq", type=int, default=None)
 
 
 def _add_training_args(p: argparse.ArgumentParser):
@@ -205,6 +208,7 @@ def model_config_from_args(ns: argparse.Namespace):
         ("num_layers", "num_layers"), ("num_heads", "num_heads"),
         ("num_kv_heads", "num_kv_heads"), ("ffn_dim", "ffn_dim"),
         ("max_seq_len", "seq_length"),
+        ("enc_layers", "enc_layers"), ("enc_seq", "enc_seq"),
     ]:
         v = getattr(ns, attr, None)
         if v is not None:

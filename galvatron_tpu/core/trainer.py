@@ -41,7 +41,7 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
     elif jax.default_backend() != "cpu":
         cfg = cfg.replace(attn_impl="flash")
     world = len(jax.devices())
-    hp = hybrid_config_from_args(ns, cfg.num_layers, world)
+    hp = hybrid_config_from_args(ns, cfg.total_layers, world)
     lr_schedule = None
     if getattr(ns, "lr_warmup_iters", 0) or getattr(ns, "lr_decay_iters", 0):
         from galvatron_tpu.core.schedules import LRSchedule
@@ -76,7 +76,7 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
                     f"rampup batch size {bs} must be divisible by chunks "
                     f"{hp.chunks} (micro-batch gradient accumulation)"
                 )
-    seq = cfg.max_seq_len
+    seq = cfg.sample_len
     rt = build_runtime(
         cfg, hp, adam=adam, global_batch_size=ns.global_train_batch_size, seq_len=seq
     )

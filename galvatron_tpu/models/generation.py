@@ -181,10 +181,10 @@ def generate(
     text_generation/generation.py generate_tokens_probs_and_return_on_first_
     stage). Returns (B, P + max_new_tokens); positions past a row's eos are
     ``pad_id``."""
-    if not cfg.causal or cfg.objective != "clm":
+    if not cfg.causal or cfg.objective != "clm" or cfg.enc_layers > 0:
         raise ValueError(
-            "generation requires a causal LM (encoder families like bert "
-            "train with objective='mlm' and cannot decode autoregressively)"
+            "generation requires a decoder-only causal LM (encoder families "
+            "train with objective='mlm'; enc-dec decode is not implemented)"
         )
     b, p_len = prompt.shape
     if min_prompt_len is None:

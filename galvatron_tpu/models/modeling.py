@@ -54,6 +54,13 @@ class ModelConfig:
     # The reference's legacy encoder support (bert/vit branches,
     # galvatron/core/parallel.py:64-89, cost_model.py model_type).
     causal: bool = True
+    # encoder-decoder (T5-class; reference legacy t5 model_type): > 0 adds
+    # that many bidirectional encoder layers; the ``num_layers`` decoder
+    # layers gain cross-attention over the encoder output. Samples are
+    # (B, enc_seq + max_seq_len + 1) token rows: encoder input ‖ decoder
+    # stream (deviation from T5: RoPE/learned positions, not relative bias).
+    enc_layers: int = 0
+    enc_seq: int = 0
     # training objective: 'clm' next-token LM; 'mlm' masked-LM (encoder
     # pretraining) with deterministic token-hash masking (see mlm_loss_sum)
     objective: str = "clm"
@@ -70,6 +77,16 @@ class ModelConfig:
     @property
     def kv_heads(self) -> int:
         return self.num_kv_heads or self.num_heads
+
+    @property
+    def total_layers(self) -> int:
+        """Layers carrying a per-layer strategy: encoder + decoder."""
+        return self.enc_layers + self.num_layers
+
+    @property
+    def sample_len(self) -> int:
+        """Token length of one training sample (before the +1 label shift)."""
+        return self.enc_seq + self.max_seq_len if self.enc_layers else self.max_seq_len
 
     @property
     def head_dim(self) -> int:
@@ -99,7 +116,7 @@ def _dense_init(key, in_dim, out_dim, dtype):
     return jax.random.uniform(key, (in_dim, out_dim), dtype, -scale, scale)
 
 
-def init_layer_params(key, cfg: ModelConfig) -> Params:
+def init_layer_params(key, cfg: ModelConfig, cross: bool = False) -> Params:
     h, hd = cfg.hidden_size, cfg.head_dim
     q_out = cfg.num_heads * hd
     kv_out = cfg.kv_heads * hd
@@ -114,6 +131,17 @@ def init_layer_params(key, cfg: ModelConfig) -> Params:
         },
         "mlp_norm": {"scale": jnp.ones((h,), cfg.param_dtype)},
     }
+    if cross:  # enc-dec decoder layer: cross-attention over the encoder output
+        ck = jax.random.split(ks[7], 4)
+        p["cross_norm"] = {"scale": jnp.ones((h,), cfg.param_dtype)}
+        p["cross"] = {
+            "wq": _dense_init(ck[0], h, q_out, cfg.param_dtype),
+            "wk": _dense_init(ck[1], h, kv_out, cfg.param_dtype),
+            "wv": _dense_init(ck[2], h, kv_out, cfg.param_dtype),
+            "wo": _dense_init(ck[3], q_out, h, cfg.param_dtype),
+        }
+        if cfg.norm_type == "layernorm":
+            p["cross_norm"]["bias"] = jnp.zeros((h,), cfg.param_dtype)
     if cfg.moe_experts > 0:
         from galvatron_tpu.models import moe
 
@@ -135,7 +163,7 @@ def init_layer_params(key, cfg: ModelConfig) -> Params:
     return p
 
 
-def layer_annotations(cfg: ModelConfig) -> Params:
+def layer_annotations(cfg: ModelConfig, cross: bool = False) -> Params:
     """Logical axes per layer param: 'tp' = Megatron-sharded dim (column-out /
     row-in), 'fsdp' = the dim ZeRO shards (reference: FSDP flat-param sharding,
     galvatron/core/parallel.py:174-207)."""
@@ -149,6 +177,16 @@ def layer_annotations(cfg: ModelConfig) -> Params:
         },
         "mlp_norm": {"scale": ("fsdp",)},
     }
+    if cross:
+        a["cross_norm"] = {"scale": ("fsdp",)}
+        a["cross"] = {
+            "wq": ("fsdp", "tp"),
+            "wk": ("fsdp", "tp"),
+            "wv": ("fsdp", "tp"),
+            "wo": ("tp", "fsdp"),
+        }
+        if cfg.norm_type == "layernorm":
+            a["cross_norm"]["bias"] = ("fsdp",)
     if cfg.moe_experts > 0:
         from galvatron_tpu.models import moe
 
@@ -164,18 +202,30 @@ def layer_annotations(cfg: ModelConfig) -> Params:
 
 
 def init_model_params(key, cfg: ModelConfig) -> Params:
-    ks = jax.random.split(key, cfg.num_layers + 3)
+    ks = jax.random.split(key, cfg.total_layers + 3)
+    cross = cfg.enc_layers > 0
     params: Params = {
         "embed": {
             "tok": jax.random.normal(ks[0], (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
             * 0.02
         },
-        "layers": [init_layer_params(ks[i + 1], cfg) for i in range(cfg.num_layers)],
+        "layers": [
+            init_layer_params(ks[cfg.enc_layers + i + 1], cfg, cross=cross)
+            for i in range(cfg.num_layers)
+        ],
         "final_norm": {"scale": jnp.ones((cfg.hidden_size,), cfg.param_dtype)},
     }
+    if cross:
+        params["enc_layers"] = [
+            init_layer_params(ks[i + 1], cfg) for i in range(cfg.enc_layers)
+        ]
+        params["enc_final_norm"] = {"scale": jnp.ones((cfg.hidden_size,), cfg.param_dtype)}
+        if cfg.norm_type == "layernorm":
+            params["enc_final_norm"]["bias"] = jnp.zeros((cfg.hidden_size,), cfg.param_dtype)
     if cfg.pos_embed == "learned":
+        pos_len = max(cfg.max_seq_len, cfg.enc_seq)
         params["embed"]["pos"] = (
-            jax.random.normal(ks[-2], (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype) * 0.02
+            jax.random.normal(ks[-2], (pos_len, cfg.hidden_size), cfg.param_dtype) * 0.02
         )
     if cfg.norm_type == "layernorm":
         params["final_norm"]["bias"] = jnp.zeros((cfg.hidden_size,), cfg.param_dtype)
@@ -190,11 +240,17 @@ def model_annotations(cfg: ModelConfig) -> Params:
     """Embedding is vocab-parallel over its TP axes (reference:
     VocabParallelEmbedding, site_package/megatron/core/tensor_parallel/
     layers.py:157; vocab_tp flag galvatron/core/arguments.py:128-130)."""
+    cross = cfg.enc_layers > 0
     a: Params = {
         "embed": {"tok": ("tp", "fsdp")},
-        "layers": [layer_annotations(cfg) for _ in range(cfg.num_layers)],
+        "layers": [layer_annotations(cfg, cross=cross) for _ in range(cfg.num_layers)],
         "final_norm": {"scale": ("fsdp",)},
     }
+    if cross:
+        a["enc_layers"] = [layer_annotations(cfg) for _ in range(cfg.enc_layers)]
+        a["enc_final_norm"] = {"scale": ("fsdp",)}
+        if cfg.norm_type == "layernorm":
+            a["enc_final_norm"]["bias"] = ("fsdp",)
     if cfg.pos_embed == "learned":
         a["embed"]["pos"] = ("fsdp", None)
     if cfg.norm_type == "layernorm":
@@ -350,10 +406,39 @@ def mlp_block(x, p, cfg: ModelConfig, train: bool = True):
     return jax.nn.gelu(x @ p["w1"].astype(x.dtype), approximate=True) @ p["w2"].astype(x.dtype)
 
 
-def decoder_layer(x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: bool = False):
+def cross_attn_block(x, enc_out, p, cfg: ModelConfig):
+    """Cross-attention: queries from the decoder stream, keys/values from the
+    encoder output (reference legacy t5 model_type; architecture per standard
+    enc-dec transformers). Full (non-causal) visibility over encoder
+    positions; no rotary — positions live in the respective streams."""
+    b, s, h = x.shape
+    hd = cfg.head_dim
+    se = enc_out.shape[1]
+    q = (x @ p["wq"].astype(x.dtype)).reshape(b, s, cfg.num_heads, hd)
+    k = (enc_out.astype(x.dtype) @ p["wk"].astype(x.dtype)).reshape(b, se, cfg.kv_heads, hd)
+    v = (enc_out.astype(x.dtype) @ p["wv"].astype(x.dtype)).reshape(b, se, cfg.kv_heads, hd)
+    o = attention_xla(q, k, v, cfg.replace(causal=False))
+    return o.reshape(b, s, cfg.num_heads * hd) @ p["wo"].astype(x.dtype)
+
+
+def encoder_layer(x, p, cfg: ModelConfig, cos_sin=None, remat_attn: bool = False):
+    """Bidirectional self-attention + MLP (the enc-dec encoder stack)."""
+    ecfg = cfg if not cfg.causal else cfg.replace(causal=False)
+    x = x + attn_block(
+        norm(x, p["attn_norm"], cfg), p["attn"], ecfg, cos_sin, None, remat_attn=remat_attn
+    )
+    x = x + mlp_block(norm(x, p["mlp_norm"], cfg), p["mlp"], cfg)
+    return x
+
+
+def decoder_layer(
+    x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: bool = False, enc_out=None
+):
     x = x + attn_block(
         norm(x, p["attn_norm"], cfg), p["attn"], cfg, cos_sin, alibi, remat_attn=remat_attn
     )
+    if enc_out is not None and "cross" in p:
+        x = x + cross_attn_block(norm(x, p["cross_norm"], cfg), enc_out, p["cross"], cfg)
     x = x + mlp_block(norm(x, p["mlp_norm"], cfg), p["mlp"], cfg)
     return x
 
@@ -389,6 +474,30 @@ def forward(params, tokens, cfg: ModelConfig, layer_hook=None):
             x = decoder_layer(x, lp, cfg, cos_sin, alibi)
     x = norm(x, params["final_norm"], cfg)
     return lm_head(x, params, cfg)
+
+
+def forward_encdec(params, enc_tokens, dec_tokens, cfg: ModelConfig, layer_hook=None):
+    """Encoder-decoder forward → decoder logits. Layer-hook indices cover the
+    encoder stack first (0..enc_layers-1) then the decoder
+    (enc_layers..total_layers-1); decoder hooks receive ``enc_out``."""
+    E = cfg.enc_layers
+    cos_e = rope_tables(cfg, enc_tokens.shape[1]) if cfg.pos_embed == "rope" else None
+    cos_d = rope_tables(cfg, dec_tokens.shape[1]) if cfg.pos_embed == "rope" else None
+    x = embed(enc_tokens, params, cfg)
+    for i, lp in enumerate(params["enc_layers"]):
+        if layer_hook is not None:
+            x = layer_hook(i, x, lp)
+        else:
+            x = encoder_layer(x, lp, cfg, cos_e)
+    enc_out = norm(x, params["enc_final_norm"], cfg)
+    y = embed(dec_tokens, params, cfg)
+    for j, lp in enumerate(params["layers"]):
+        if layer_hook is not None:
+            y = layer_hook(E + j, y, lp, enc_out=enc_out)
+        else:
+            y = decoder_layer(y, lp, cfg, cos_d, None, enc_out=enc_out)
+    y = norm(y, params["final_norm"], cfg)
+    return lm_head(y, params, cfg)
 
 
 def cross_entropy_sum(logits, labels, ignore_index: int = -100):
@@ -442,9 +551,16 @@ def mlm_loss_sum(params, batch, cfg: ModelConfig, layer_hook=None):
 def lm_loss_sum(params, batch, cfg: ModelConfig, layer_hook=None):
     """(nll_sum, token_count) loss pieces on a (B, S+1) token batch
     (reference synthetic-data convention: models/llama_hf/dataloader.py:5-30).
-    Dispatches on cfg.objective: 'clm' next-token; 'mlm' masked-LM."""
+    Dispatches on cfg.objective: 'clm' next-token; 'mlm' masked-LM; enc-dec
+    models (enc_layers > 0) run seq2seq next-token loss on the decoder half
+    of the (B, enc_seq + dec_seq + 1) sample."""
     if cfg.objective == "mlm":
         return mlm_loss_sum(params, batch, cfg, layer_hook=layer_hook)
+    if cfg.enc_layers > 0:
+        enc_tokens = batch[:, : cfg.enc_seq]
+        dec = batch[:, cfg.enc_seq :]
+        logits = forward_encdec(params, enc_tokens, dec[:, :-1], cfg, layer_hook=layer_hook)
+        return cross_entropy_sum(logits, dec[:, 1:])
     tokens = batch[:, :-1]
     labels = batch[:, 1:]
     logits = forward(params, tokens, cfg, layer_hook=layer_hook)
@@ -505,6 +621,26 @@ PRESETS: Dict[str, ModelConfig] = {
         vocab_size=30528, hidden_size=1024, num_layers=24, num_heads=16,
         max_seq_len=512, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
         tie_word_embeddings=True, causal=False, objective="mlm",
+    ),
+    # encoder-decoder family (reference legacy t5 model_type; positions are
+    # learned, not T5 relative bias — documented deviation)
+    "t5-base": ModelConfig(
+        vocab_size=32128, hidden_size=768, num_layers=12, num_heads=12,
+        ffn_dim=3072, max_seq_len=512, enc_layers=12, enc_seq=512,
+        pos_embed="learned", norm_type="rms", act_fn="gelu",
+        tie_word_embeddings=True,
+    ),
+    "t5-large": ModelConfig(
+        vocab_size=32128, hidden_size=1024, num_layers=24, num_heads=16,
+        ffn_dim=4096, max_seq_len=512, enc_layers=24, enc_seq=512,
+        pos_embed="learned", norm_type="rms", act_fn="gelu",
+        tie_word_embeddings=True,
+    ),
+    "t5-3b": ModelConfig(
+        vocab_size=32128, hidden_size=1024, num_layers=24, num_heads=32,
+        ffn_dim=16384, max_seq_len=512, enc_layers=24, enc_seq=512,
+        pos_embed="learned", norm_type="rms", act_fn="gelu",
+        tie_word_embeddings=True,
     ),
     "baichuan-7b": ModelConfig(
         vocab_size=64000, hidden_size=4096, num_layers=32, num_heads=32,

@@ -1,0 +1,19 @@
+"""T5 encoder-decoder family entry.
+
+The reference carries t5 only as legacy branches (model_type handling in
+galvatron/core/parallel.py:64-89 and cost_model.py); here it is a live
+family: bidirectional encoder stack + causal decoder with cross-attention
+through the hybrid-parallel runtime (pp=1; per-layer strategies cover the
+encoder then the decoder — the two layer types feed the multi-layer-type
+search). Sizes t5-base/large/3b. Positions are learned embeddings rather
+than T5's relative bias (documented deviation, modeling.PRESETS).
+"""
+
+DEFAULT_MODEL = "t5-base"
+SIZES = ("t5-base", "t5-large", "t5-3b")
+
+
+def main(argv=None):
+    from galvatron_tpu.cli import main as cli_main
+
+    return cli_main(argv, model_default=DEFAULT_MODEL)

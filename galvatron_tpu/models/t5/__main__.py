@@ -1,0 +1,3 @@
+from galvatron_tpu.models.t5 import main
+
+raise SystemExit(main())

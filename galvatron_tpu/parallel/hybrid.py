@@ -67,11 +67,22 @@ def model_param_specs(
     ps = lambda leaf, a, s: param_spec(leaf.shape, a, axes, s, for_opt_state=for_opt_state)
     specs: Dict[str, Any] = {}
     is_leaf = lambda x: hasattr(x, "shape")
+    E = cfg.enc_layers  # strategy indices: encoder stack first, then decoder
     for key in params_shape:
-        if key == "layers":
-            specs["layers"] = [
+        if key == "enc_layers":
+            specs["enc_layers"] = [
                 jax.tree.map(
                     functools.partial(ps, s=hp.layer_strategies[i]),
+                    params_shape["enc_layers"][i],
+                    annots["enc_layers"][i],
+                    is_leaf=is_leaf,
+                )
+                for i in range(len(params_shape["enc_layers"]))
+            ]
+        elif key == "layers":
+            specs["layers"] = [
+                jax.tree.map(
+                    functools.partial(ps, s=hp.layer_strategies[E + i]),
                     params_shape["layers"][i],
                     annots["layers"][i],
                     is_leaf=is_leaf,
@@ -142,7 +153,7 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
     """Per-layer execution hook: sharding-constraint boundary (redistribution)
     + optional remat (checkpoint_wrapper) + ring-attention dispatch."""
 
-    def hook(i: int, x, lp):
+    def hook(i: int, x, lp, enc_out=None):
         s = hp.layer_strategies[i]
         x = constrain(x, mesh, activation_spec(axes, s))
         layer_cfg = cfg
@@ -156,8 +167,13 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
             if layer_cfg.pos_embed == "alibi"
             else None
         )
+        is_encoder = cfg.enc_layers > 0 and i < cfg.enc_layers
 
         def run(x_, lp_):
+            if is_encoder:
+                return modeling.encoder_layer(
+                    x_, lp_, layer_cfg, cos_sin, remat_attn=(s.ckpt == "selective")
+                )
             if s.cp > 1:
                 cp_axes = axes.cp_axes(s.tp, s.tp_consec, s.cp)
                 if s.cp_impl == "a2a":
@@ -168,7 +184,8 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
 
                 return ring_decoder_layer(x_, lp_, layer_cfg, mesh, cp_axes, cos_sin)
             return modeling.decoder_layer(
-                x_, lp_, layer_cfg, cos_sin, alibi, remat_attn=(s.ckpt == "selective")
+                x_, lp_, layer_cfg, cos_sin, alibi,
+                remat_attn=(s.ckpt == "selective"), enc_out=enc_out,
             )
 
         if s.ckpt == "full":
@@ -197,9 +214,10 @@ def build_runtime(
     if mesh is None:
         mesh, axes = build_mesh(pp=hp.pp)
     assert axes is not None
-    if hp.num_layers != cfg.num_layers:
+    if hp.num_layers != cfg.total_layers:
         raise ValueError(
-            f"strategy has {hp.num_layers} layer entries but model has {cfg.num_layers} layers"
+            f"strategy has {hp.num_layers} layer entries but model has "
+            f"{cfg.total_layers} (encoder + decoder) layers"
         )
     hp.validate(mesh.devices.size)
     if not cfg.causal and any(s.cp > 1 for s in hp.layer_strategies):
@@ -207,7 +225,15 @@ def build_runtime(
             "context parallelism (cp>1) is causal-only (ring/Ulysses kernels "
             "assume a causal mask); encoder models must use tp/sp instead"
         )
-    seq_len = seq_len or cfg.max_seq_len
+    if cfg.enc_layers > 0:
+        if hp.pp > 1:
+            raise ValueError(
+                "encoder-decoder models run at pp=1 (the SPMD stage stacking "
+                "needs homogeneous layer pytrees; enc and dec layers differ)"
+            )
+        if any(s.cp > 1 for s in hp.layer_strategies):
+            raise ValueError("context parallelism is not supported for enc-dec models")
+    seq_len = seq_len or cfg.sample_len
 
     if cfg.dtype != jnp.float32 and hp.mixed_precision == "fp32":
         cfg = cfg.replace(dtype=jnp.float32)

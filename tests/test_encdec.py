@@ -1,0 +1,153 @@
+"""Encoder-decoder (T5-class) support: cross-attention through the hybrid
+runtime + the multi-layer-type search (reference legacy t5 model_type and the
+multi-layer-type DP, galvatron/core/dynamic_programming.py:304-455)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from galvatron_tpu.core.optim import AdamConfig
+from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+from galvatron_tpu.models import modeling
+from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.parallel.hybrid import build_runtime
+
+T5 = ModelConfig(
+    vocab_size=128, hidden_size=64, num_layers=2, num_heads=4, ffn_dim=128,
+    max_seq_len=16, enc_layers=2, enc_seq=16, dtype=jnp.float32,
+    pos_embed="learned", norm_type="rms", act_fn="gelu", tie_word_embeddings=True,
+)
+
+
+def batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randint(0, 128, (8, T5.sample_len + 1)), jnp.int32)
+
+
+def test_cross_attention_uses_encoder():
+    """Changing the encoder input must change decoder logits."""
+    params = modeling.init_model_params(jax.random.key(0), T5)
+    b = batch()
+    enc, dec = b[:, : T5.enc_seq], b[:, T5.enc_seq : -1]
+    f = jax.jit(lambda e, d: modeling.forward_encdec(params, e, d, T5))
+    out1 = np.asarray(f(enc, dec))
+    out2 = np.asarray(f((enc + 1) % 128, dec))
+    assert not np.allclose(out1, out2)
+    # params actually carry cross-attention weights
+    assert "cross" in params["layers"][0] and "enc_layers" in params
+
+
+def test_encdec_trains_and_memorizes():
+    hp = HybridParallelConfig.uniform(4, tp=1, mixed_precision="fp32")
+    rt = build_runtime(T5, hp, adam=AdamConfig(lr=3e-3), global_batch_size=8)
+    state = rt.init_state(jax.random.key(0))
+    b = batch()
+    losses = []
+    for _ in range(5):
+        state, loss = rt.train_step(state, b)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_encdec_parity_tp2_and_heterogeneous():
+    """Hybrid strategies reproduce the single-device enc-dec loss, including
+    different strategies for encoder vs decoder layers."""
+    hp1 = HybridParallelConfig.uniform(4, tp=1, mixed_precision="fp32")
+    hp2 = HybridParallelConfig(
+        pp=1,
+        layer_strategies=[
+            LayerStrategy(tp=2, sp=True),        # enc 0
+            LayerStrategy(tp=1, dp_type="zero3"),  # enc 1
+            LayerStrategy(tp=2, ckpt=True),      # dec 0
+            LayerStrategy(tp=4, dp_type="zero2"),  # dec 1
+        ],
+        vocab_tp=2,
+        mixed_precision="fp32",
+    )
+    r1 = build_runtime(T5, hp1, adam=AdamConfig(lr=1e-3), global_batch_size=8)
+    r2 = build_runtime(T5, hp2, adam=AdamConfig(lr=1e-3), global_batch_size=8)
+    s1, s2 = r1.init_state(jax.random.key(0)), r2.init_state(jax.random.key(0))
+    b = batch()
+    np.testing.assert_allclose(
+        float(r1.eval_loss(s1, b)), float(r2.eval_loss(s2, b)), rtol=2e-5
+    )
+    # decoder layer 1 (strategy index 3) is tp=4 on wq
+    spec = s2["params"]["layers"][1]["attn"]["wq"].sharding.spec
+    assert spec[1] is not None and len(spec[1]) == 2  # two binary axes = tp4
+
+
+def test_encdec_rejects_pp_and_cp():
+    hp = HybridParallelConfig.uniform(4, pp=2, chunks=2, mixed_precision="fp32")
+    with pytest.raises(ValueError, match="pp=1"):
+        build_runtime(T5, hp, adam=AdamConfig(), global_batch_size=8)
+    hp2 = HybridParallelConfig.uniform(4, cp=2, mixed_precision="fp32")
+    with pytest.raises(ValueError, match="enc-dec"):
+        build_runtime(T5, hp2, adam=AdamConfig(), global_batch_size=8)
+
+
+def test_multi_layer_type_search():
+    """Enc and dec layer types with different costs flow through the search
+    (the reference's multi-layer-type DP) and the result trains."""
+    from galvatron_tpu.search.cost_model import (
+        ProfiledHardware,
+        ProfiledLayerType,
+        ProfiledModelCosts,
+    )
+    from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+
+    enc_lt = ProfiledLayerType(
+        fwd_ms_per_sample=1.0, parameter_mb=40.0,
+        activation_mb_per_sample={1: 20.0, 2: 10.0, 4: 5.0},
+        boundary_activation_mb_per_sample=2.0,
+    )
+    dec_lt = ProfiledLayerType(
+        fwd_ms_per_sample=2.5, parameter_mb=70.0,  # cross-attn makes dec heavier
+        activation_mb_per_sample={1: 40.0, 2: 20.0, 4: 10.0},
+        boundary_activation_mb_per_sample=2.0,
+    )
+    costs = ProfiledModelCosts(
+        layer_types={0: enc_lt, 1: enc_lt, 2: dec_lt, 3: dec_lt},
+        other_param_mb=30.0, other_act_mb_per_sample=4.0,
+        other_fwd_ms_per_sample=0.2,
+    )
+    hw = ProfiledHardware(
+        allreduce_bw={"2_1": 150.0, "2_0": 30.0, "4_1": 140.0, "8_1": 120.0},
+        p2p_bw={2: 50.0}, overlap_coe=1.1,
+    )
+    eng = SearchEngine(
+        costs, hw, num_layers=4,
+        space=SearchSpace(world_size=8, pp_choices=[1]),
+        memory_budget_mb=700.0,
+    )
+    res = eng.search([8])
+    assert res is not None
+    hp = res.config
+    assert len(hp.layer_strategies) == 4
+    # heavier decoder layers must shave more memory than encoder layers can
+    # afford to keep (or at minimum the plan is feasible and trains):
+    rt = build_runtime(
+        T5, HybridParallelConfig(
+            pp=1, layer_strategies=hp.layer_strategies, chunks=hp.chunks,
+            vocab_tp=hp.vocab_tp, mixed_precision="fp32",
+        ),
+        adam=AdamConfig(lr=1e-3), global_batch_size=8,
+    )
+    state = rt.init_state(jax.random.key(0))
+    state, loss = rt.train_step(state, batch())
+    assert np.isfinite(float(loss))
+
+
+def test_t5_family_entry(capsys):
+    from galvatron_tpu.models import t5
+
+    rc = t5.main(
+        ["train", "--model_size", "t5-base",
+         "--hidden_size", "64", "--num_layers", "2", "--num_heads", "4",
+         "--ffn_dim", "128", "--vocab_size", "128", "--seq_length", "16",
+         "--enc_layers", "2", "--enc_seq", "16",
+         "--global_train_batch_size", "8", "--train_iters", "1",
+         "--mixed_precision", "fp32", "--check_loss", "1"]
+    )
+    assert rc == 0
+    assert "iter 0: loss" in capsys.readouterr().out
