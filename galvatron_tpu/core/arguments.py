@@ -34,6 +34,13 @@ def _add_model_args(p: argparse.ArgumentParser):
     g.add_argument("--enc_layers", type=int, default=None,
                    help="encoder layers (enc-dec families; 0 = decoder-only)")
     g.add_argument("--enc_seq", type=int, default=None)
+    g.add_argument("--image_size", type=int, default=None,
+                   help="vision families: input image side (pixels)")
+    g.add_argument("--patch_size", type=int, default=None)
+    g.add_argument("--num_classes", type=int, default=None)
+    g.add_argument("--swin_window", type=int, default=None)
+    g.add_argument("--swin_depths", type=str, default=None,
+                   help="comma list, e.g. 2,2,18,2 (must sum to --num_layers)")
 
 
 def _add_training_args(p: argparse.ArgumentParser):
@@ -209,10 +216,16 @@ def model_config_from_args(ns: argparse.Namespace):
         ("num_kv_heads", "num_kv_heads"), ("ffn_dim", "ffn_dim"),
         ("max_seq_len", "seq_length"),
         ("enc_layers", "enc_layers"), ("enc_seq", "enc_seq"),
+        ("image_size", "image_size"), ("patch_size", "patch_size"),
+        ("num_classes", "num_classes"), ("swin_window", "swin_window"),
     ]:
         v = getattr(ns, attr, None)
         if v is not None:
             overrides[field] = v
+    if getattr(ns, "swin_depths", None):
+        overrides["swin_depths"] = tuple(
+            int(d) for d in str(ns.swin_depths).split(",") if d
+        )
     if getattr(ns, "set_model_config_manually", 0):
         required = ("vocab_size", "hidden_size", "num_layers", "num_heads")
         missing = [f for f in required if f not in overrides]

@@ -15,10 +15,15 @@ from typing import Iterator, Optional
 import numpy as np
 
 
-class RandomTokenDataset:
-    def __init__(self, vocab_size: int, seq_len: int, size: int = 1024, seed: int = 1234):
-        self.vocab_size = vocab_size
-        self.seq_len = seq_len
+class _RandomStreamDataset:
+    """Shared epoch/permutation/resume machinery for the synthetic streams.
+
+    ``start_batch`` resumes mid-stream without materializing the skipped
+    batches: batch contents depend only on (seed, epoch, position), so the
+    offset is pure index arithmetic. Subclasses implement ``_sample(rng, B)``
+    → one (B, sample_len+1) int32 batch."""
+
+    def __init__(self, size: int = 1024, seed: int = 1234):
         self.size = size
         self.seed = seed
 
@@ -28,14 +33,12 @@ class RandomTokenDataset:
     def batches_per_epoch(self, global_batch_size: int) -> int:
         return max(0, (self.size - global_batch_size) // global_batch_size + 1)
 
+    def _sample(self, rng: np.random.RandomState, global_batch_size: int) -> np.ndarray:
+        raise NotImplementedError
+
     def batch_iterator(
         self, global_batch_size: int, epochs: Optional[int] = None, start_batch: int = 0
     ) -> Iterator[np.ndarray]:
-        """Yields (B, S+1) int32 token batches (inputs ‖ next-token labels).
-
-        ``start_batch`` resumes mid-stream without materializing the skipped
-        batches: batch contents depend only on (seed, epoch, position), so the
-        offset is pure index arithmetic."""
         per_epoch = self.batches_per_epoch(global_batch_size)
         if per_epoch == 0:
             raise ValueError(
@@ -51,10 +54,39 @@ class RandomTokenDataset:
             for i in range(start_i, self.size - global_batch_size + 1, global_batch_size):
                 idx = order[i : i + global_batch_size]
                 batch_rng = np.random.RandomState(self.seed * 1000003 + int(idx[0]))
-                yield batch_rng.randint(
-                    0, self.vocab_size, (global_batch_size, self.seq_len + 1), np.int32
-                )
+                yield self._sample(batch_rng, global_batch_size)
             epoch += 1
+
+
+class RandomTokenDataset(_RandomStreamDataset):
+    """(B, S+1) int32 token batches (inputs ‖ next-token labels)."""
+
+    def __init__(self, vocab_size: int, seq_len: int, size: int = 1024, seed: int = 1234):
+        super().__init__(size, seed)
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+
+    def _sample(self, rng, global_batch_size):
+        return rng.randint(
+            0, self.vocab_size, (global_batch_size, self.seq_len + 1), np.int32
+        )
+
+
+class RandomImageDataset(_RandomStreamDataset):
+    """Synthetic image-classification stream for the vision families: each
+    row is (image_size²·channels) uint8 pixel values stored as int32 ‖ one
+    class label — the same (B, sample_len+1) int32 contract the token loaders
+    use, so batching/sharding/resume machinery is shared unchanged."""
+
+    def __init__(self, n_pixels: int, num_classes: int, size: int = 1024, seed: int = 1234):
+        super().__init__(size, seed)
+        self.n_pixels = n_pixels
+        self.num_classes = num_classes
+
+    def _sample(self, rng, global_batch_size):
+        pixels = rng.randint(0, 256, (global_batch_size, self.n_pixels), np.int32)
+        labels = rng.randint(0, self.num_classes, (global_batch_size, 1), np.int32)
+        return np.concatenate([pixels, labels], axis=1)
 
 
 def build_dataloader(cfg, global_batch_size: int, seq_len: Optional[int] = None,
@@ -63,6 +95,11 @@ def build_dataloader(cfg, global_batch_size: int, seq_len: Optional[int] = None,
     """``data_path`` selects the real-corpus path: a ``write_indexed_dataset``
     prefix is loaded memory-mapped and sampled GPT-window style
     (galvatron_tpu.core.data); otherwise the synthetic random-token stream."""
+    if getattr(cfg, "image_size", 0):
+        if data_path:
+            raise ValueError("indexed token corpora do not apply to vision models")
+        ds = RandomImageDataset(cfg.sample_len, cfg.num_classes, size, seed)
+        return ds.batch_iterator(global_batch_size, start_batch=start_batch)
     seq_len = seq_len or cfg.max_seq_len
     if data_path:
         from galvatron_tpu.core.data import GPTWindowDataset, IndexedTokenDataset

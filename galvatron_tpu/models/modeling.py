@@ -73,6 +73,23 @@ class ModelConfig:
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_sinkhorn_iters: int = 8
+    # vision families (reference legacy vit/swin model_type branches,
+    # galvatron/core/parallel.py:64-89, cost_model.py:76,87-106).
+    # image_size > 0 switches the input pipeline from token ids to uint8
+    # pixel rows: one sample = (image_size² · num_channels) pixel values in
+    # 0..255 stored as int32 ‖ one class label — so the whole runtime keeps
+    # its single (B, sample_len+1) int32 batch contract (pipelines, loaders,
+    # checkpoints all unchanged).
+    image_size: int = 0
+    patch_size: int = 16
+    num_channels: int = 3
+    num_classes: int = 1000
+    # Swin: non-empty depths → hierarchical stages; stage s runs depths[s]
+    # windowed-attention layers at width hidden_size·2^s and resolution
+    # (image_size/patch_size)/2^s per side, with a patch-merging projection
+    # between stages. Empty → plain ViT encoder.
+    swin_depths: Tuple[int, ...] = ()
+    swin_window: int = 7
 
     @property
     def kv_heads(self) -> int:
@@ -84,8 +101,19 @@ class ModelConfig:
         return self.enc_layers + self.num_layers
 
     @property
+    def grid(self) -> int:
+        """Vision: patches per image side at stage 0."""
+        return self.image_size // self.patch_size
+
+    @property
+    def n_patches(self) -> int:
+        return self.grid * self.grid
+
+    @property
     def sample_len(self) -> int:
         """Token length of one training sample (before the +1 label shift)."""
+        if self.image_size:
+            return self.image_size * self.image_size * self.num_channels
         return self.enc_seq + self.max_seq_len if self.enc_layers else self.max_seq_len
 
     @property
@@ -201,7 +229,134 @@ def layer_annotations(cfg: ModelConfig, cross: bool = False) -> Params:
     return a
 
 
+# --- vision (ViT / Swin) static geometry -----------------------------------
+
+
+def swin_stage_of(cfg: ModelConfig, i: int) -> Tuple[int, int]:
+    """Layer index → (stage, index within stage) for hierarchical Swin."""
+    for s, d in enumerate(cfg.swin_depths):
+        if i < d:
+            return s, i
+        i -= d
+    raise IndexError(f"layer {i} beyond swin_depths {cfg.swin_depths}")
+
+
+def swin_geometry(cfg: ModelConfig, stage: int) -> Tuple[int, int, int, int]:
+    """Stage → (H, W, C, heads): resolution halves and width/heads double per
+    stage (Swin's hierarchical pyramid)."""
+    side = cfg.grid >> stage
+    return side, side, cfg.hidden_size << stage, cfg.num_heads << stage
+
+
+def swin_window_for(cfg: ModelConfig, stage: int) -> int:
+    """Static per-stage window: ``swin_window`` shrunk to the largest value
+    that divides the stage's side (windows must tile the feature map; the
+    canonical 224/patch-4 presets keep the full 7)."""
+    side = cfg.grid >> stage
+    w = min(cfg.swin_window, side)
+    while side % w:
+        w -= 1
+    return w
+
+
+def vision_layer_cfg(cfg: ModelConfig, i: int) -> ModelConfig:
+    """Per-layer shape config for vision layers: identity for ViT; for Swin
+    the stage-s widening (C·2^s, heads·2^s — head_dim constant) so the same
+    init_layer_params/layer_annotations serve every stage."""
+    if not cfg.swin_depths:
+        return cfg
+    s, _ = swin_stage_of(cfg, i)
+    _, _, c, heads = swin_geometry(cfg, s)
+    return cfg.replace(hidden_size=c, num_heads=heads, num_kv_heads=None)
+
+
+def init_vision_base_params(ks, cfg: ModelConfig) -> Params:
+    """Non-layer vision params (patch-projection embed / final norm / class
+    head) from three keys — the single source both the GSPMD init and the
+    pipeline engines' base init draw from. Swin's final_norm/head sit at
+    c_last = hidden·2^(stages-1)."""
+    patch_dim = cfg.patch_size * cfg.patch_size * cfg.num_channels
+    c_last = cfg.hidden_size << max(0, len(cfg.swin_depths) - 1)
+    base: Params = {
+        "embed": {
+            "proj": _dense_init(ks[0], patch_dim, cfg.hidden_size, cfg.param_dtype),
+            "pos": jax.random.normal(
+                ks[1], (cfg.n_patches, cfg.hidden_size), cfg.param_dtype
+            )
+            * 0.02,
+        },
+        "final_norm": {"scale": jnp.ones((c_last,), cfg.param_dtype)},
+        "head": {"w": _dense_init(ks[2], c_last, cfg.num_classes, cfg.param_dtype)},
+    }
+    if cfg.norm_type == "layernorm":
+        base["final_norm"]["bias"] = jnp.zeros((c_last,), cfg.param_dtype)
+    return base
+
+
+def vision_base_annotations(cfg: ModelConfig) -> Params:
+    a: Params = {
+        "embed": {"proj": ("fsdp", "tp"), "pos": ("fsdp", None)},
+        "final_norm": {"scale": ("fsdp",)},
+        "head": {"w": ("fsdp", "tp")},
+    }
+    if cfg.norm_type == "layernorm":
+        a["final_norm"]["bias"] = ("fsdp",)
+    return a
+
+
+def init_vision_params(key, cfg: ModelConfig) -> Params:
+    """ViT/Swin parameter tree: patch-projection embedding + learned position
+    table + encoder layers (+ Swin patch-merging projections) + pooled
+    classification head. Reference carries vit/swin only as legacy wrapping
+    branches (galvatron/core/parallel.py:64-89); here they are live families."""
+    if cfg.swin_depths and sum(cfg.swin_depths) != cfg.num_layers:
+        raise ValueError(
+            f"swin_depths {cfg.swin_depths} sum to {sum(cfg.swin_depths)} but "
+            f"num_layers is {cfg.num_layers} (per-layer strategies index the "
+            "flattened stage layers; keep them equal)"
+        )
+    if cfg.image_size % cfg.patch_size:
+        raise ValueError(
+            f"patch_size {cfg.patch_size} must divide image_size {cfg.image_size}"
+        )
+    L = cfg.num_layers
+    ks = jax.random.split(key, L + 4)
+    params = init_vision_base_params([ks[0], ks[1], ks[-1]], cfg)
+    params["layers"] = [
+        init_layer_params(ks[i + 2], vision_layer_cfg(cfg, i)) for i in range(L)
+    ]
+    if cfg.swin_depths:
+        n_stages = len(cfg.swin_depths)
+        mks = jax.random.split(ks[-2], max(1, n_stages - 1))
+        params["merges"] = []
+        for s in range(n_stages - 1):
+            c = cfg.hidden_size << s
+            m = {"w": _dense_init(mks[s], 4 * c, 2 * c, cfg.param_dtype),
+                 "norm": {"scale": jnp.ones((4 * c,), cfg.param_dtype)}}
+            if cfg.norm_type == "layernorm":
+                m["norm"]["bias"] = jnp.zeros((4 * c,), cfg.param_dtype)
+            params["merges"].append(m)
+    return params
+
+
+def vision_annotations(cfg: ModelConfig) -> Params:
+    a = vision_base_annotations(cfg)
+    a["layers"] = [
+        layer_annotations(vision_layer_cfg(cfg, i)) for i in range(cfg.num_layers)
+    ]
+    if cfg.swin_depths:
+        a["merges"] = []
+        for s in range(len(cfg.swin_depths) - 1):
+            m = {"w": ("fsdp", None), "norm": {"scale": ("fsdp",)}}
+            if cfg.norm_type == "layernorm":
+                m["norm"]["bias"] = ("fsdp",)
+            a["merges"].append(m)
+    return a
+
+
 def init_model_params(key, cfg: ModelConfig) -> Params:
+    if cfg.image_size:
+        return init_vision_params(key, cfg)
     ks = jax.random.split(key, cfg.total_layers + 3)
     cross = cfg.enc_layers > 0
     params: Params = {
@@ -240,6 +395,8 @@ def model_annotations(cfg: ModelConfig) -> Params:
     """Embedding is vocab-parallel over its TP axes (reference:
     VocabParallelEmbedding, site_package/megatron/core/tensor_parallel/
     layers.py:157; vocab_tp flag galvatron/core/arguments.py:128-130)."""
+    if cfg.image_size:
+        return vision_annotations(cfg)
     cross = cfg.enc_layers > 0
     a: Params = {
         "embed": {"tok": ("tp", "fsdp")},
@@ -500,6 +657,157 @@ def forward_encdec(params, enc_tokens, dec_tokens, cfg: ModelConfig, layer_hook=
     return lm_head(y, params, cfg)
 
 
+# ---------------------------------------------------------------------------
+# Vision forward (ViT / Swin)
+# ---------------------------------------------------------------------------
+
+
+def vision_embed(pixels, params, cfg: ModelConfig):
+    """(B, H·W·C) int32 pixel rows → (B, n_patches, hidden): normalize to
+    [-1, 1], patchify by reshape/transpose, linear-project, add learned
+    positions. The patchify runs as pure data movement + one batched matmul —
+    MXU-shaped, no gather."""
+    b = pixels.shape[0]
+    p_, g, c = cfg.patch_size, cfg.grid, cfg.num_channels
+    x = pixels.astype(cfg.dtype).reshape(b, g, p_, g, p_, c) / 127.5 - 1.0
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, g * g, p_ * p_ * c)
+    x = x @ params["embed"]["proj"].astype(cfg.dtype)
+    return x + params["embed"]["pos"].astype(cfg.dtype)[None]
+
+
+def _swin_attn_mask(h: int, w: int, window: int, shift: int) -> np.ndarray:
+    """Static (num_windows, w², w²) True=may-attend mask for shifted windows:
+    after the cyclic roll, positions wrapped across the image boundary land in
+    the same window but must not attend to each other (Swin's shifted-window
+    mask, computed here at trace time as a numpy constant)."""
+    img = np.zeros((h, w), np.int32)
+    cnt = 0
+    for hs in (slice(0, h - window), slice(h - window, h - shift), slice(h - shift, None)):
+        for ws in (slice(0, w - window), slice(w - window, w - shift), slice(w - shift, None)):
+            img[hs, ws] = cnt
+            cnt += 1
+    wins = (
+        img.reshape(h // window, window, w // window, window)
+        .transpose(0, 2, 1, 3)
+        .reshape(-1, window * window)
+    )
+    return wins[:, :, None] == wins[:, None, :]
+
+
+def swin_attention(x, p, lcfg: ModelConfig, h: int, w: int, window: int, shift: int):
+    """Windowed multi-head self-attention over an (B, h·w, C) feature map:
+    optional cyclic shift, window partition, per-window attention (+ wrap
+    mask), reverse. Window sequences are tiny (w²≈49) so the plain XLA einsum
+    path is the right kernel — the batched GEMMs land on the MXU."""
+    b, _, c = x.shape
+    heads, hd = lcfg.num_heads, c // lcfg.num_heads
+    x4 = x.reshape(b, h, w, c)
+    if shift:
+        x4 = jnp.roll(x4, (-shift, -shift), (1, 2))
+    nh, nw = h // window, w // window
+    ws2 = window * window
+    xw = (
+        x4.reshape(b, nh, window, nw, window, c)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(b * nh * nw, ws2, c)
+    )
+    q = (xw @ p["wq"].astype(x.dtype)).reshape(-1, ws2, heads, hd)
+    k = (xw @ p["wk"].astype(x.dtype)).reshape(-1, ws2, heads, hd)
+    v = (xw @ p["wv"].astype(x.dtype)).reshape(-1, ws2, heads, hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(hd)
+    if shift:
+        mask = jnp.asarray(_swin_attn_mask(h, w, window, shift))  # (nW, ws2, ws2)
+        scores = scores.reshape(b, nh * nw, heads, ws2, ws2)
+        scores = jnp.where(mask[None, :, None], scores, -1e30)
+        scores = scores.reshape(b * nh * nw, heads, ws2, ws2)
+    attn = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(-1, ws2, c)
+    o = o @ p["wo"].astype(x.dtype)
+    o = (
+        o.reshape(b, nh, nw, window, window, c)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(b, h, w, c)
+    )
+    if shift:
+        o = jnp.roll(o, (shift, shift), (1, 2))
+    return o.reshape(b, h * w, c)
+
+
+def swin_layer(x, p, cfg: ModelConfig, i: int, remat_attn: bool = False):
+    """One Swin block: layer index → static (stage, geometry); odd blocks in a
+    stage use the shifted window. Residual + norm + MLP reuse the shared
+    transformer pieces at the stage's width."""
+    stage, j = swin_stage_of(cfg, i)
+    h, w, c, _ = swin_geometry(cfg, stage)
+    lcfg = vision_layer_cfg(cfg, i)
+    window = swin_window_for(cfg, stage)
+    shift = window // 2 if (j % 2 == 1 and window < h) else 0
+
+    def attn(x_):
+        return swin_attention(x_, p["attn"], lcfg, h, w, window, shift)
+
+    if remat_attn:
+        attn = jax.checkpoint(attn)
+    x = x + attn(norm(x, p["attn_norm"], lcfg))
+    x = x + mlp_block(norm(x, p["mlp_norm"], lcfg), p["mlp"], lcfg)
+    return x
+
+
+def patch_merge(x, p, cfg: ModelConfig, stage: int):
+    """Swin downsampling between stages: 2×2 neighborhood concat (4C) →
+    norm → linear to 2C; resolution quarters, width doubles."""
+    h, w, c, _ = swin_geometry(cfg, stage)
+    b = x.shape[0]
+    x = (
+        x.reshape(b, h // 2, 2, w // 2, 2, c)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(b, (h // 2) * (w // 2), 4 * c)
+    )
+    x = norm(x, p["norm"], cfg)
+    return x @ p["w"].astype(x.dtype)
+
+
+def cls_head(y, params, cfg: ModelConfig):
+    """Mean-pooled classification head: (B, L, C) → (B, num_classes)."""
+    pooled = y.mean(axis=1)
+    return pooled @ params["head"]["w"].astype(y.dtype)
+
+
+def forward_vision(params, pixels, cfg: ModelConfig, layer_hook=None):
+    """ViT/Swin forward → class logits. ``layer_hook(i, x, lp)`` carries the
+    per-layer hybrid strategies exactly as in the token models; Swin's
+    patch-merging projections sit between stages as model-level params (like
+    final_norm — replicated/ZeRO, never a per-layer strategy)."""
+    x = vision_embed(pixels, params, cfg)
+    if cfg.swin_depths:
+        i = 0
+        for s, depth in enumerate(cfg.swin_depths):
+            for _ in range(depth):
+                if layer_hook is not None:
+                    x = layer_hook(i, x, params["layers"][i])
+                else:
+                    x = swin_layer(x, params["layers"][i], cfg, i)
+                i += 1
+            if s < len(cfg.swin_depths) - 1:
+                x = patch_merge(x, params["merges"][s], cfg, s)
+    else:
+        for i, lp in enumerate(params["layers"]):
+            if layer_hook is not None:
+                x = layer_hook(i, x, lp)
+            else:
+                x = decoder_layer(x, lp, cfg)  # causal=False → encoder block
+    x = norm(x, params["final_norm"], cfg)
+    return cls_head(x, params, cfg)
+
+
+def cls_loss_sum(params, batch, cfg: ModelConfig, layer_hook=None):
+    """(nll_sum, sample_count) for image classification on the int32 pixel
+    batch contract: row = pixels ‖ label."""
+    pixels, labels = split_batch(batch, cfg)
+    logits = forward_vision(params, pixels, cfg, layer_hook=layer_hook)
+    return cross_entropy_sum(logits, labels)
+
+
 def cross_entropy_sum(logits, labels, ignore_index: int = -100):
     """(nll_sum, valid_token_count) in fp32 — the accumulation-safe form:
     micro-batch sums combine exactly into the global token-mean even when
@@ -540,20 +848,61 @@ def mlm_loss_sum(params, batch, cfg: ModelConfig, layer_hook=None):
     """(nll_sum, masked_token_count) BERT-style masked-LM pieces on the same
     (B, S+1) token batches the CLM path uses. The last vocab id serves as
     [MASK]; only masked positions contribute loss."""
-    tokens = batch[:, :-1]
-    mask = mlm_positions(tokens, cfg)
-    inputs = jnp.where(mask, cfg.vocab_size - 1, tokens)
-    labels = jnp.where(mask, tokens, -100)
+    inputs, labels = split_batch(batch, cfg)
     logits = forward(params, inputs, cfg, layer_hook=layer_hook)
     return cross_entropy_sum(logits, labels)
+
+
+def split_batch(batch, cfg: ModelConfig):
+    """One (B, sample_len+1) int32 batch row → (model inputs, loss labels) per
+    objective. Centralized so the pipeline engines (which re-implement the
+    embed→stages→head seam) agree with the GSPMD path on every objective:
+    'clm' next-token shift, 'mlm' deterministic masking, 'cls' pixels‖label."""
+    if cfg.objective == "cls":
+        return batch[:, :-1], batch[:, -1]
+    if cfg.objective == "mlm":
+        tokens = batch[:, :-1]
+        mask = mlm_positions(tokens, cfg)
+        return jnp.where(mask, cfg.vocab_size - 1, tokens), jnp.where(mask, tokens, -100)
+    return batch[:, :-1], batch[:, 1:]
+
+
+def embed_any(inputs, params, cfg: ModelConfig):
+    """Input embedding for either modality: token table or patch projection."""
+    if cfg.image_size:
+        return vision_embed(inputs, params, cfg)
+    return embed(inputs, params, cfg)
+
+
+def head_loss_sum(y, params, labels, cfg: ModelConfig):
+    """Final-norm'd features (B, S, H) → (nll_sum, count): LM head + token
+    cross entropy, or pooled classification head + class cross entropy."""
+    if cfg.objective == "cls":
+        return cross_entropy_sum(cls_head(y, params, cfg), labels)
+    return cross_entropy_sum(lm_head(y, params, cfg), labels)
+
+
+def loss_tokens_per_sample(cfg: ModelConfig, seq_len: int) -> int:
+    """Static count of loss-carrying positions per sample (fp16 scale seeding;
+    mlm uses the expected masked fraction)."""
+    if cfg.objective == "cls":
+        return 1
+    if cfg.objective == "mlm":
+        return max(1, int(seq_len * cfg.mlm_mask_rate))
+    if cfg.enc_layers > 0:
+        return seq_len - cfg.enc_seq
+    return seq_len
 
 
 def lm_loss_sum(params, batch, cfg: ModelConfig, layer_hook=None):
     """(nll_sum, token_count) loss pieces on a (B, S+1) token batch
     (reference synthetic-data convention: models/llama_hf/dataloader.py:5-30).
-    Dispatches on cfg.objective: 'clm' next-token; 'mlm' masked-LM; enc-dec
-    models (enc_layers > 0) run seq2seq next-token loss on the decoder half
-    of the (B, enc_seq + dec_seq + 1) sample."""
+    Dispatches on cfg.objective: 'clm' next-token; 'mlm' masked-LM; 'cls'
+    image classification (vision families); enc-dec models (enc_layers > 0)
+    run seq2seq next-token loss on the decoder half of the
+    (B, enc_seq + dec_seq + 1) sample."""
+    if cfg.objective == "cls":
+        return cls_loss_sum(params, batch, cfg, layer_hook=layer_hook)
     if cfg.objective == "mlm":
         return mlm_loss_sum(params, batch, cfg, layer_hook=layer_hook)
     if cfg.enc_layers > 0:
@@ -641,6 +990,35 @@ PRESETS: Dict[str, ModelConfig] = {
         ffn_dim=16384, max_seq_len=512, enc_layers=24, enc_seq=512,
         pos_embed="learned", norm_type="rms", act_fn="gelu",
         tie_word_embeddings=True,
+    ),
+    # vision families (reference legacy vit/swin model_type branches,
+    # core/parallel.py:64-89, cost_model.py:76,87-106)
+    "vit-base": ModelConfig(
+        vocab_size=1, hidden_size=768, num_layers=12, num_heads=12,
+        max_seq_len=0, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
+        causal=False, objective="cls", image_size=224, patch_size=16,
+    ),
+    "vit-large": ModelConfig(
+        vocab_size=1, hidden_size=1024, num_layers=24, num_heads=16,
+        max_seq_len=0, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
+        causal=False, objective="cls", image_size=224, patch_size=16,
+    ),
+    "vit-huge": ModelConfig(
+        vocab_size=1, hidden_size=1280, num_layers=32, num_heads=16,
+        max_seq_len=0, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
+        causal=False, objective="cls", image_size=224, patch_size=14,
+    ),
+    "swin-base": ModelConfig(
+        vocab_size=1, hidden_size=128, num_layers=24, num_heads=4,
+        max_seq_len=0, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
+        causal=False, objective="cls", image_size=224, patch_size=4,
+        swin_depths=(2, 2, 18, 2), swin_window=7,
+    ),
+    "swin-large": ModelConfig(
+        vocab_size=1, hidden_size=192, num_layers=24, num_heads=6,
+        max_seq_len=0, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
+        causal=False, objective="cls", image_size=224, patch_size=4,
+        swin_depths=(2, 2, 18, 2), swin_window=7,
     ),
     "baichuan-7b": ModelConfig(
         vocab_size=64000, hidden_size=4096, num_layers=32, num_heads=32,

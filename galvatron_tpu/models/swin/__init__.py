@@ -1,0 +1,20 @@
+"""Swin hierarchical vision family entry — image classification.
+
+The reference carries swin only as a legacy model_type branch
+(galvatron/core/parallel.py:64-89, cost_model.py:87-106); here it is a live
+family: shifted-window attention with trace-time wrap masks, patch-merging
+pyramid (width doubles / resolution quarters per stage —
+modeling.swin_layer/patch_merge), pooled classification head. Stages have
+heterogeneous widths, so Swin runs on the pp=1 GSPMD path with per-layer
+TP/SP/ZeRO/ckpt strategies (the multi-layer-type search case, like enc-dec).
+Sizes swin-base/large.
+"""
+
+DEFAULT_MODEL = "swin-base"
+SIZES = ("swin-base", "swin-large")
+
+
+def main(argv=None):
+    from galvatron_tpu.cli import main as cli_main
+
+    return cli_main(argv, model_default=DEFAULT_MODEL)

@@ -1,0 +1,3 @@
+from galvatron_tpu.models.swin import main
+
+raise SystemExit(main())

@@ -1,0 +1,19 @@
+"""ViT vision-encoder family entry — image classification.
+
+The reference carries vision support only as legacy wrapping branches (vit
+handling in galvatron/core/parallel.py:64-89 and cost_model.py model_type);
+here it is a live family: patch-projection embedding + bidirectional encoder
+blocks over the full hybrid-parallel runtime (per-layer TP/SP/ZeRO/ckpt and
+all pipeline schedules — layers are homogeneous), pooled classification head,
+sizes vit-base/large/huge. Samples are uint8 pixel rows ‖ class label in the
+framework-wide int32 batch contract (modeling.vision_embed).
+"""
+
+DEFAULT_MODEL = "vit-base"
+SIZES = ("vit-base", "vit-large", "vit-huge")
+
+
+def main(argv=None):
+    from galvatron_tpu.cli import main as cli_main
+
+    return cli_main(argv, model_default=DEFAULT_MODEL)

@@ -170,6 +170,10 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
         is_encoder = cfg.enc_layers > 0 and i < cfg.enc_layers
 
         def run(x_, lp_):
+            if cfg.swin_depths:
+                return modeling.swin_layer(
+                    x_, lp_, cfg, i, remat_attn=(s.ckpt == "selective")
+                )
             if is_encoder:
                 return modeling.encoder_layer(
                     x_, lp_, layer_cfg, cos_sin, remat_attn=(s.ckpt == "selective")
@@ -233,6 +237,11 @@ def build_runtime(
             )
         if any(s.cp > 1 for s in hp.layer_strategies):
             raise ValueError("context parallelism is not supported for enc-dec models")
+    if cfg.swin_depths and hp.pp > 1:
+        raise ValueError(
+            "Swin models run at pp=1 (hierarchical stages have heterogeneous "
+            "layer widths; the SPMD stage stacking needs homogeneous pytrees)"
+        )
     seq_len = seq_len or cfg.sample_len
 
     if cfg.dtype != jnp.float32 and hp.mixed_precision == "fp32":
@@ -287,7 +296,7 @@ def build_runtime(
         # cotangent magnitudes match the unchunked mean-loss path — a raw
         # sum-loss seed multiplies O(1) per-token cotangents by the full scale
         # and overflows fp16 immediately at the 2^16 initial scale
-        n_static = (b // chunks) * (batch.shape[1] - 1)
+        n_static = (b // chunks) * modeling.loss_tokens_per_sample(cfg, batch.shape[1] - 1)
 
         def body(acc, mb):
             if scale is None:

@@ -59,6 +59,17 @@ from galvatron_tpu.models.modeling import ModelConfig
 from galvatron_tpu.parallel.mesh import MeshAxes, batch_spec
 from galvatron_tpu.parallel.sharding import constrain, param_spec, sharding_tree
 
+def cpu_sim_compiler_options():
+    """XLA:CPU's all-reduce-promotion pass check-fails (CreateBinary with a
+    copy opcode, hlo_instruction.cc:1585) on the copy-reduction all-reduces
+    GSPMD emits for the sub-f32 pipeline backward — any bf16/fp16 GPipe or
+    interleaved train step aborts the process on the CPU *simulation*. Real
+    TPU backends never run that pass. Disable it per-compile on CPU only."""
+    if jax.default_backend() == "cpu":
+        return {"xla_disable_hlo_passes": "all-reduce-promotion"}
+    return None
+
+
 # ---------------------------------------------------------------------------
 # Stage-stacked parameters
 # ---------------------------------------------------------------------------
@@ -86,12 +97,17 @@ def validate_pipeline_strategies(cfg: ModelConfig, hp: HybridParallelConfig) -> 
     return lps
 
 
-def init_pipeline_params(key, cfg: ModelConfig, hp: HybridParallelConfig):
-    """Param tree for pp>1: embed/final_norm/head as usual (replicated over pp);
-    transformer layers as ``stages[j]`` — position-j layer params stacked over
-    stages, leading dim pp."""
-    lps = validate_pipeline_strategies(cfg, hp)
-    ks = jax.random.split(key, 4)
+def base_model_params(ks, cfg: ModelConfig):
+    """Non-layer params (embed / final_norm / head) shared by the pipeline
+    engines. Vision (ViT) models get the patch-projection embedding + pooled
+    class head; token models the vocab table (+ optional untied LM head)."""
+    if cfg.image_size:
+        if cfg.swin_depths:
+            # Swin's merges are model-level params and its final_norm/head sit
+            # at the widened c_last — the stage-stacked pipeline never supports
+            # it (build_runtime rejects it first)
+            raise ValueError("Swin models have no pipeline parameterization (pp=1 only)")
+        return modeling.init_vision_base_params(ks[:3], cfg)
     base = {
         "embed": {
             "tok": jax.random.normal(ks[0], (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
@@ -109,6 +125,33 @@ def init_pipeline_params(key, cfg: ModelConfig, hp: HybridParallelConfig):
         base["head"] = {
             "w": modeling._dense_init(ks[2], cfg.hidden_size, cfg.vocab_size, cfg.param_dtype)
         }
+    return base
+
+
+def base_model_annots(cfg: ModelConfig):
+    """Logical-axes annotations matching base_model_params."""
+    if cfg.image_size:
+        return modeling.vision_base_annotations(cfg)
+    a = {
+        "embed": {"tok": ("tp", "fsdp")},
+        "final_norm": {"scale": ("fsdp",)},
+    }
+    if cfg.pos_embed == "learned":
+        a["embed"]["pos"] = ("fsdp", None)
+    if cfg.norm_type == "layernorm":
+        a["final_norm"]["bias"] = ("fsdp",)
+    if not cfg.tie_word_embeddings:
+        a["head"] = {"w": ("fsdp", "tp")}
+    return a
+
+
+def init_pipeline_params(key, cfg: ModelConfig, hp: HybridParallelConfig):
+    """Param tree for pp>1: embed/final_norm/head as usual (replicated over pp);
+    transformer layers as ``stages[j]`` — position-j layer params stacked over
+    stages, leading dim pp."""
+    lps = validate_pipeline_strategies(cfg, hp)
+    ks = jax.random.split(key, 4)
+    base = base_model_params(ks, cfg)
     layer_keys = jax.random.split(ks[3], cfg.num_layers)
     # stages[j][leaf] has shape (pp, *leaf_shape); stage s slice is layer s*lps+j
     stages = []
@@ -132,16 +175,7 @@ def pipeline_param_specs(
     )
     is_leaf = lambda x: hasattr(x, "shape")
     specs: Dict[str, Any] = {}
-    model_annots = {
-        "embed": {"tok": ("tp", "fsdp")},
-        "final_norm": {"scale": ("fsdp",)},
-    }
-    if cfg.pos_embed == "learned":
-        model_annots["embed"]["pos"] = ("fsdp", None)
-    if cfg.norm_type == "layernorm":
-        model_annots["final_norm"]["bias"] = ("fsdp",)
-    if not cfg.tie_word_embeddings:
-        model_annots["head"] = {"w": ("fsdp", "tp")}
+    model_annots = base_model_annots(cfg)
     for key in params_shape:
         if key == "stages":
             specs["stages"] = []
@@ -337,16 +371,15 @@ def build_pipeline_runtime(
     layer_params_key = "vstages" if interleaved else "stages"
 
     def loss_fn(params, batch):
-        tokens, labels = batch[:, :-1], batch[:, 1:]
-        x = modeling.embed(tokens, params, cfg)
+        inputs, labels = modeling.split_batch(batch, cfg)
+        x = modeling.embed_any(inputs, params, cfg)
         x = constrain(x, mesh, full_spec)
         x_mbs = x.reshape(chunks, mb, *x.shape[1:])
         ys = pipe_sm(params[layer_params_key], x_mbs)  # (pp, chunks, mb, S, H)
         y = ys[out_stage].reshape(global_batch_size, *x.shape[1:])
         y = constrain(y, mesh, full_spec)
         y = modeling.norm(y, params["final_norm"], cfg)
-        logits = modeling.lm_head(y, params, cfg)
-        s, n = modeling.cross_entropy_sum(logits, labels)
+        s, n = modeling.head_loss_sum(y, params, labels, cfg)
         return s / jnp.maximum(n, 1)
 
     fp16 = hp.mixed_precision == "fp16"
@@ -384,16 +417,19 @@ def build_pipeline_runtime(
     shardings = sharding_tree(mesh, specs)
     batch_sharding = NamedSharding(mesh, P(("pp",) + axes.data_axes, None))
 
+    copts = cpu_sim_compiler_options()
     jit_train = jax.jit(
         train_step,
         in_shardings=(shardings, batch_sharding),
         out_shardings=(shardings, NamedSharding(mesh, P())),
         donate_argnums=(0,),
+        compiler_options=copts,
     )
     jit_eval = jax.jit(
         lambda state, batch: loss_fn(state["params"], batch),
         in_shardings=(shardings, batch_sharding),
         out_shardings=NamedSharding(mesh, P()),
+        compiler_options=copts,
     )
     jit_init = jax.jit(init_state, out_shardings=shardings)
 

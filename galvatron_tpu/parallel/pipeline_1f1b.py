@@ -44,13 +44,18 @@ from galvatron_tpu.core.strategy import HybridParallelConfig
 from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
 from galvatron_tpu.parallel.mesh import MeshAxes
+from galvatron_tpu.parallel.pipeline import cpu_sim_compiler_options
 from galvatron_tpu.parallel.sharding import constrain, sharding_tree
 
 
 def _head_loss(head_sub, y, labels, cfg: ModelConfig):
-    """Final norm + LM head + summed token loss for one micro-batch; returns
-    (nll_sum, aux=token_count)."""
+    """Final norm + output head + summed loss for one micro-batch; returns
+    (nll_sum, aux=count). Dispatches per objective (LM / masked-LM labels are
+    prepared by modeling.split_batch; 'cls' pools and classifies)."""
     y = modeling.norm(y, head_sub["final_norm"], cfg)
+    if cfg.objective == "cls":
+        s, n = modeling.cross_entropy_sum(modeling.cls_head(y, head_sub, cfg), labels)
+        return s, n.astype(jnp.float32)
     if cfg.tie_word_embeddings:
         w = head_sub["embed"]["tok"].astype(y.dtype).T
     else:
@@ -81,7 +86,8 @@ def make_1f1b_train_step(
         raise ValueError(f"global batch {global_batch_size} not divisible by chunks {chunks}")
     mb = global_batch_size // chunks
     n_stash = min(chunks, 2 * (pp - 1) + 1)
-    n_static = (global_batch_size // chunks) * seq_len  # tokens per micro-batch
+    # loss-carrying positions per micro-batch (fp16-safe cotangent seeding)
+    n_static = (global_batch_size // chunks) * modeling.loss_tokens_per_sample(cfg, seq_len)
     T = chunks + 2 * (pp - 1)
     up_perm = [(i, i + 1) for i in range(pp - 1)]
     down_perm = [(i + 1, i) for i in range(pp - 1)]
@@ -207,17 +213,17 @@ def make_1f1b_train_step(
     def train_step(state, batch):
         params = state["params"]
         scale = state["scaler"]["scale"] if fp16 else jnp.ones((), jnp.float32)
-        tokens, labels = batch[:, :-1], batch[:, 1:]
+        inputs, labels = modeling.split_batch(batch, cfg)
         head_sub = {k: params[k] for k in head_keys}
 
         # embedding forward (outside the pipelined section), with vjp capture
         def embed_fn(embed_params):
-            x = modeling.embed(tokens, {"embed": embed_params}, cfg)
+            x = modeling.embed_any(inputs, {"embed": embed_params}, cfg)
             return constrain(x, mesh, full_spec)
 
         x, embed_vjp = jax.vjp(embed_fn, params["embed"])
         x_mbs = x.reshape(chunks, mb, *x.shape[1:])
-        labels_mbs = labels.reshape(chunks, mb, -1)
+        labels_mbs = labels.reshape(chunks, mb, *labels.shape[1:])
 
         loss_s, tok_s, d_stages, d_head_s, dx_embed_s = body_sm(
             params["stages"], head_sub, x_mbs, labels_mbs, scale
@@ -225,7 +231,7 @@ def make_1f1b_train_step(
         loss_sum = loss_s[-1]
         tok = jnp.maximum(tok_s[-1], 1.0)
         d_head = jax.tree.map(lambda a: a[-1], d_head_s)
-        dx_embed = dx_embed_s[0].reshape(global_batch_size, seq_len, cfg.hidden_size)
+        dx_embed = dx_embed_s[0].reshape(global_batch_size, *x.shape[1:])
         (d_embed,) = embed_vjp(dx_embed.astype(x.dtype))
 
         # assemble the full gradient tree (mean over tokens)
@@ -249,14 +255,14 @@ def make_1f1b_train_step(
     def eval_loss(state, batch):
         # forward-only via the same body (backward outputs discarded)
         params = state["params"]
-        tokens, labels = batch[:, :-1], batch[:, 1:]
+        inputs, labels = modeling.split_batch(batch, cfg)
         head_sub = {k: params[k] for k in head_keys}
-        x = constrain(modeling.embed(tokens, params, cfg), mesh, full_spec)
+        x = constrain(modeling.embed_any(inputs, params, cfg), mesh, full_spec)
         loss_s, tok_s, *_ = body_sm(
             params["stages"],
             head_sub,
             x.reshape(chunks, mb, *x.shape[1:]),
-            labels.reshape(chunks, mb, -1),
+            labels.reshape(chunks, mb, *labels.shape[1:]),
             jnp.ones((), jnp.float32),
         )
         return loss_s[-1] / jnp.maximum(tok_s[-1], 1.0)
@@ -283,16 +289,19 @@ def make_1f1b_train_step(
     shardings = sharding_tree(mesh, specs)
     batch_sharding = NamedSharding(mesh, P(("pp",) + axes.data_axes, None))
 
+    copts = cpu_sim_compiler_options()
     jit_train = jax.jit(
         train_step,
         in_shardings=(shardings, batch_sharding),
         out_shardings=(shardings, NamedSharding(mesh, P())),
         donate_argnums=(0,),
+        compiler_options=copts,
     )
     jit_eval = jax.jit(
         eval_loss,
         in_shardings=(shardings, batch_sharding),
         out_shardings=NamedSharding(mesh, P()),
+        compiler_options=copts,
     )
     jit_init = jax.jit(init_state, out_shardings=shardings)
 

@@ -68,26 +68,12 @@ def init_interleaved_params(key, cfg: ModelConfig, hp: HybridParallelConfig):
     """Param tree: embed/final_norm/head as in the plain pipeline;
     ``vstages[q]`` = position-q layer params stacked (pp, vpp, ...) — entry
     [s, j] belongs to layer ``(s + j·pp)·lpvs + q``."""
+    from galvatron_tpu.parallel.pipeline import base_model_params
+
     lpvs = validate_interleaved_strategies(cfg, hp)
     pp, vpp = hp.pp, hp.vpp
     ks = jax.random.split(key, 4)
-    base = {
-        "embed": {
-            "tok": jax.random.normal(ks[0], (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
-            * 0.02
-        },
-        "final_norm": {"scale": jnp.ones((cfg.hidden_size,), cfg.param_dtype)},
-    }
-    if cfg.pos_embed == "learned":
-        base["embed"]["pos"] = (
-            jax.random.normal(ks[1], (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype) * 0.02
-        )
-    if cfg.norm_type == "layernorm":
-        base["final_norm"]["bias"] = jnp.zeros((cfg.hidden_size,), cfg.param_dtype)
-    if not cfg.tie_word_embeddings:
-        base["head"] = {
-            "w": modeling._dense_init(ks[2], cfg.hidden_size, cfg.vocab_size, cfg.param_dtype)
-        }
+    base = base_model_params(ks, cfg)
     layer_keys = jax.random.split(ks[3], cfg.num_layers)
     vstages = []
     for q in range(lpvs):

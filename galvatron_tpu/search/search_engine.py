@@ -137,6 +137,13 @@ class SearchEngine:
         world = space.world_size
         if world % pp or self.L % pp:
             return None
+        if pp > 1 and len(self.costs.layer_types) > 1:
+            # heterogeneous layer types (Swin pyramid, enc-dec): the runtime's
+            # SPMD stage stacking needs homogeneous layer pytrees, so these
+            # models run at pp=1 (hybrid.build_runtime rejects pp>1) — and the
+            # per-stage-position costing below would mis-cost them anyway
+            # (stage memory is NOT identical across stages)
+            return None
         if global_bsz % chunks:
             return None
         if vpp > 1:

@@ -42,7 +42,19 @@ def layer_param_count(cfg: ModelConfig) -> int:
 
 
 def other_param_count(cfg: ModelConfig) -> int:
-    """Embedding + final norm + LM head."""
+    """Embedding + final norm + output head (+ Swin patch merges)."""
+    if cfg.image_size:
+        from galvatron_tpu.models.modeling import swin_geometry
+
+        patch_dim = cfg.patch_size * cfg.patch_size * cfg.num_channels
+        n = patch_dim * cfg.hidden_size + cfg.n_patches * cfg.hidden_size
+        c_last = cfg.hidden_size << max(0, len(cfg.swin_depths) - 1)
+        n += c_last * cfg.num_classes
+        n += c_last if cfg.norm_type == "rms" else 2 * c_last
+        for s in range(len(cfg.swin_depths) - 1):
+            _, _, c, _ = swin_geometry(cfg, s)
+            n += 4 * c * 2 * c + (4 * c if cfg.norm_type == "rms" else 8 * c)
+        return n
     n = cfg.vocab_size * cfg.hidden_size  # token embedding
     if cfg.pos_embed == "learned":
         n += cfg.max_seq_len * cfg.hidden_size
@@ -53,6 +65,13 @@ def other_param_count(cfg: ModelConfig) -> int:
 
 
 def total_param_count(cfg: ModelConfig) -> int:
+    if cfg.swin_depths:
+        from galvatron_tpu.models.modeling import vision_layer_cfg
+
+        layers = sum(
+            layer_param_count(vision_layer_cfg(cfg, i)) for i in range(cfg.num_layers)
+        )
+        return layers + other_param_count(cfg)
     return cfg.num_layers * layer_param_count(cfg) + other_param_count(cfg)
 
 
@@ -119,6 +138,8 @@ def analytic_model_costs(
     assumed MFU; activation table from layer_activation_mb_per_sample."""
     from galvatron_tpu.search.cost_model import ProfiledLayerType, ProfiledModelCosts
 
+    if cfg.image_size:
+        return _analytic_vision_costs(cfg, peak_tflops, mfu, mixed_precision)
     S = seq_len or cfg.max_seq_len
     b = _BYTES[mixed_precision]
     p_layer = layer_param_count(cfg)
@@ -146,6 +167,70 @@ def analytic_model_costs(
                 boundary_activation_mb_per_sample=S * cfg.hidden_size * b / 1e6,
             )
         },
+        other_param_mb=other_p * 4 / 1e6,
+        other_act_mb_per_sample=other_act,
+        other_fwd_ms_per_sample=other_flops / (peak_tflops * 1e12 * mfu) * 1e3,
+    )
+
+
+def _analytic_vision_costs(
+    cfg: ModelConfig, peak_tflops: float, mfu: float, mixed_precision: str
+):
+    """Vision variant of analytic_model_costs: ViT = one uniform layer type at
+    seq = n_patches; Swin = one layer type per layer (the stage pyramid makes
+    widths/resolutions layer-dependent — the multi-layer-type DP case,
+    reference: _build_dp_and_run_multi_layer_type,
+    galvatron/core/dynamic_programming.py:304-455)."""
+    from galvatron_tpu.models.modeling import swin_geometry, swin_stage_of, vision_layer_cfg
+    from galvatron_tpu.search.cost_model import ProfiledLayerType, ProfiledModelCosts
+
+    b = _BYTES[mixed_precision]
+
+    def layer_type_for(i: int) -> ProfiledLayerType:
+        lcfg = vision_layer_cfg(cfg, i)
+        if cfg.swin_depths:
+            from galvatron_tpu.models.modeling import swin_window_for
+
+            stage, _ = swin_stage_of(cfg, i)
+            h_side, w_side, _, heads = swin_geometry(cfg, stage)
+            S = h_side * w_side
+            win = swin_window_for(cfg, stage)
+            ctx = win * win  # each token attends its window
+        else:
+            S = cfg.n_patches
+            heads, ctx = cfg.num_heads, cfg.n_patches
+        p_layer = layer_param_count(lcfg)
+        flops = 2.0 * p_layer * S + 2.0 * 2.0 * heads * lcfg.head_dim * S * ctx
+        fwd_ms = flops / (peak_tflops * 1e12 * mfu) * 1e3
+        act = {}
+        for tp in (1, 2, 4, 8):
+            if lcfg.hidden_size % tp:
+                continue
+            base = layer_activation_mb_per_sample(
+                lcfg.replace(attn_impl="flash"), LayerStrategy(tp=tp), S, mixed_precision
+            )
+            # replace the flash-LSE term with the windowed fp32 probs
+            act[tp] = base + 4.0 * (heads / tp) * S * (ctx - 1) / 1e6
+        return ProfiledLayerType(
+            fwd_ms_per_sample=fwd_ms,
+            parameter_mb=p_layer * 4 / 1e6,
+            activation_mb_per_sample=act,
+            boundary_activation_mb_per_sample=S * lcfg.hidden_size * b / 1e6,
+        )
+
+    if cfg.swin_depths:
+        layer_types = {i: layer_type_for(i) for i in range(cfg.num_layers)}
+    else:
+        layer_types = {0: layer_type_for(0)}
+    other_p = other_param_count(cfg)
+    patch_dim = cfg.patch_size * cfg.patch_size * cfg.num_channels
+    other_flops = 2.0 * patch_dim * cfg.hidden_size * cfg.n_patches
+    c_last = cfg.hidden_size << max(0, len(cfg.swin_depths) - 1)
+    other_flops += 2.0 * c_last * cfg.num_classes
+    # patch embedding output dominates "other" activation
+    other_act = cfg.n_patches * cfg.hidden_size * b / 1e6
+    return ProfiledModelCosts(
+        layer_types=layer_types,
         other_param_mb=other_p * 4 / 1e6,
         other_act_mb_per_sample=other_act,
         other_fwd_ms_per_sample=other_flops / (peak_tflops * 1e12 * mfu) * 1e3,

@@ -130,3 +130,24 @@ def test_pipeline_rejects_cross_stage_heterogeneity():
     hp = HybridParallelConfig(pp=2, layer_strategies=strategies, chunks=2, mixed_precision="fp32")
     with pytest.raises(ValueError, match="share one strategy"):
         build_runtime(CFG, hp, adam=ADAM, global_batch_size=8, seq_len=32)
+
+
+def test_gpipe_bf16_trains():
+    """bf16 pipeline backward regression: XLA:CPU's all-reduce-promotion pass
+    aborts on sub-f32 pipeline backwards (copy-reduction all-reduce,
+    hlo_instruction.cc:1585); cpu_sim_compiler_options disables it per-compile
+    so mixed-precision pipelines are testable on the CPU sim."""
+    import jax.numpy as jnp_
+
+    cfg = CFG.replace(dtype=jnp_.bfloat16)
+    hp = HybridParallelConfig.uniform(
+        4, pp=2, tp=2, dp_type="zero3", chunks=2, mixed_precision="bf16", vocab_tp=2
+    )
+    rt = build_runtime(cfg, hp, adam=ADAM, global_batch_size=8, seq_len=32)
+    state = rt.init_state(jax.random.key(0))
+    b = make_batch()
+    losses = []
+    for _ in range(3):
+        state, loss = rt.train_step(state, b)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]

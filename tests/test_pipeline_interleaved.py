@@ -127,3 +127,21 @@ def test_interleaved_cli_roundtrip(tmp_path):
     hp.save(p)
     hp2 = HybridParallelConfig.load(p)
     assert hp2.vpp == 2 and hp2.pp == 2
+
+
+def test_interleaved_bf16_trains():
+    """bf16 interleaved regression (same XLA:CPU pass workaround as
+    test_gpipe_bf16_trains)."""
+    cfg = CFG.replace(dtype=jnp.bfloat16)
+    hp = HybridParallelConfig.uniform(
+        4, pp=2, vpp=2, tp=2, sp=True, dp_type="zero3", chunks=2,
+        mixed_precision="bf16", vocab_tp=2,
+    )
+    rt = build_runtime(cfg, hp, adam=ADAM, global_batch_size=8, seq_len=32)
+    state = rt.init_state(jax.random.key(0))
+    b = make_batch()
+    losses = []
+    for _ in range(3):
+        state, loss = rt.train_step(state, b)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]

@@ -1,0 +1,274 @@
+"""Vision families (ViT / Swin) through the hybrid-parallel runtime.
+
+The reference carries vit/swin only as legacy model_type branches
+(galvatron/core/parallel.py:64-89, cost_model.py:76,87-106); here they are
+live families on the framework-wide int32 pixel-batch contract. Tests mirror
+the `--check_loss` methodology (SURVEY §4): hybrid strategies must reproduce
+the single-device fp32 loss trajectory.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from galvatron_tpu.core.optim import AdamConfig, adamw_update, init_opt_state
+from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+from galvatron_tpu.models import modeling
+from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.parallel.hybrid import build_runtime
+
+VIT_CFG = ModelConfig(
+    vocab_size=1, hidden_size=64, num_layers=4, num_heads=4, max_seq_len=0,
+    pos_embed="learned", norm_type="layernorm", act_fn="gelu", causal=False,
+    objective="cls", image_size=16, patch_size=4, num_classes=16,
+    dtype=jnp.float32,
+)
+SWIN_CFG = ModelConfig(
+    vocab_size=1, hidden_size=16, num_layers=4, num_heads=2, max_seq_len=0,
+    pos_embed="learned", norm_type="layernorm", act_fn="gelu", causal=False,
+    objective="cls", image_size=16, patch_size=2, num_classes=16,
+    swin_depths=(2, 2), swin_window=4, dtype=jnp.float32,
+)
+ADAM = AdamConfig(lr=1e-3, grad_clip=1.0)
+STEPS = 3
+
+
+def make_batches(cfg, seed=0, n=STEPS, batch=8):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        pixels = rng.randint(0, 256, (batch, cfg.sample_len), np.int32)
+        labels = rng.randint(0, cfg.num_classes, (batch, 1), np.int32)
+        out.append(jnp.asarray(np.concatenate([pixels, labels], 1)))
+    return out
+
+
+def reference_losses(cfg, batches):
+    params = modeling.init_model_params(jax.random.key(0), cfg)
+    opt = init_opt_state(params)
+    losses = []
+    step = jax.jit(jax.value_and_grad(lambda p, b: modeling.lm_loss(p, b, cfg)))
+    for b in batches:
+        loss, grads = step(params, b)
+        params, opt = adamw_update(params, grads, opt, ADAM)
+        losses.append(float(loss))
+    return losses
+
+
+def run_hybrid(cfg, hp, batches):
+    rt = build_runtime(cfg, hp, adam=ADAM, global_batch_size=8)
+    state = rt.init_state(jax.random.key(0))
+    losses = []
+    for b in batches:
+        state, loss = rt.train_step(state, b)
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.fixture(scope="module")
+def vit_ref():
+    batches = make_batches(VIT_CFG)
+    return batches, reference_losses(VIT_CFG, batches)
+
+
+VIT_STRATEGIES = {
+    "tp2_sp": HybridParallelConfig.uniform(
+        4, tp=2, sp=True, mixed_precision="fp32", vocab_tp=2
+    ),
+    "zero3_ckpt": HybridParallelConfig.uniform(
+        4, tp=1, dp_type="zero3", ckpt=True, mixed_precision="fp32",
+        embed_dp_type="zero3",
+    ),
+    "accum2": HybridParallelConfig.uniform(4, tp=1, mixed_precision="fp32", chunks=2),
+}
+
+
+@pytest.mark.parametrize("name", sorted(VIT_STRATEGIES))
+def test_vit_loss_parity(vit_ref, name):
+    batches, ref = vit_ref
+    got = run_hybrid(VIT_CFG, VIT_STRATEGIES[name], batches)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def _unstack_pipe_params(pipe_params, cfg, pp):
+    """stage-stacked → flat pp=1 param tree (test_pipeline methodology)."""
+    lps = cfg.num_layers // pp
+    layers = []
+    for s in range(pp):
+        for j in range(lps):
+            layers.append(jax.tree.map(lambda a: np.asarray(a)[s], pipe_params["stages"][j]))
+    flat = {k: jax.tree.map(np.asarray, v) for k, v in pipe_params.items() if k != "stages"}
+    flat["layers"] = layers
+    return flat
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "pipedream_flush"])
+def test_vit_pipeline_parity(vit_ref, schedule):
+    """ViT layers are homogeneous → every pipeline schedule applies. Compare
+    each step's loss against a single-device AdamW loop started from the
+    identical (unstacked) params."""
+    batches, _ = vit_ref
+    pp = 2
+    hp = HybridParallelConfig.uniform(
+        4, pp=pp, tp=2, chunks=2, mixed_precision="fp32", vocab_tp=2,
+        pipeline_type=schedule,
+    )
+    rt = build_runtime(VIT_CFG, hp, adam=ADAM, global_batch_size=8)
+    state = rt.init_state(jax.random.key(0))
+    flat = jax.tree.map(jnp.asarray, _unstack_pipe_params(state["params"], VIT_CFG, pp))
+    opt = init_opt_state(flat)
+    step = jax.jit(jax.value_and_grad(lambda p, b: modeling.lm_loss(p, b, VIT_CFG)))
+    pipe_losses, ref_losses = [], []
+    for b in batches:
+        state, loss = rt.train_step(state, b)
+        pipe_losses.append(float(loss))
+        ref_loss, grads = step(flat, b)
+        flat, opt = adamw_update(flat, grads, opt, ADAM)
+        ref_losses.append(float(ref_loss))
+    np.testing.assert_allclose(pipe_losses, ref_losses, rtol=5e-5, atol=5e-5)
+
+
+def test_vit_interleaved_trains(vit_ref):
+    batches, _ = vit_ref
+    hp = HybridParallelConfig.uniform(
+        4, pp=2, vpp=2, chunks=2, mixed_precision="fp32", pipeline_type="gpipe"
+    )
+    got = run_hybrid(VIT_CFG, hp, batches * 2)
+    assert np.isfinite(got).all() and got[-1] < got[0]
+
+
+@pytest.fixture(scope="module")
+def swin_ref():
+    batches = make_batches(SWIN_CFG, seed=7)
+    return batches, reference_losses(SWIN_CFG, batches)
+
+
+SWIN_STRATEGIES = {
+    "tp2": HybridParallelConfig.uniform(4, tp=2, mixed_precision="fp32"),
+    # per-stage heterogeneity: narrow stage 0 data-parallel, wide stage 1
+    # tensor-parallel + sequence-sharded + rematerialized
+    "hetero": HybridParallelConfig(
+        pp=1,
+        layer_strategies=[
+            LayerStrategy(tp=1, dp_type="zero3"),
+            LayerStrategy(tp=1, dp_type="zero3"),
+            LayerStrategy(tp=2, sp=True, ckpt="full"),
+            LayerStrategy(tp=2, sp=True, ckpt="full"),
+        ],
+        mixed_precision="fp32",
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(SWIN_STRATEGIES))
+def test_swin_loss_parity(swin_ref, name):
+    batches, ref = swin_ref
+    got = run_hybrid(SWIN_CFG, SWIN_STRATEGIES[name], batches)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_swin_rejects_pipeline():
+    hp = HybridParallelConfig.uniform(4, pp=2, chunks=2, mixed_precision="fp32")
+    with pytest.raises(ValueError, match="pp=1"):
+        build_runtime(SWIN_CFG, hp, adam=ADAM, global_batch_size=8)
+
+
+def test_swin_shift_mask_blocks_wrapped_pairs():
+    """After the cyclic roll, a window containing wrapped image regions must
+    not let those regions attend to each other; unwrapped windows attend
+    fully."""
+    m = modeling._swin_attn_mask(8, 8, 4, 2)
+    assert m.shape == (4, 16, 16)
+    assert m[0].all()  # top-left window: no wrap
+    assert not m[1].all() and not m[2].all() and not m[3].all()
+    assert (m == m.transpose(0, 2, 1)).all()  # may-attend is symmetric
+    assert all(m[i].diagonal().all() for i in range(4))  # self-attention kept
+    # bottom-right window mixes 4 regions → exactly 4 distinct row patterns
+    assert len({r.tobytes() for r in m[3]}) == 4
+
+
+def test_swin_geometry_pyramid():
+    h0, w0, c0, n0 = modeling.swin_geometry(SWIN_CFG, 0)
+    h1, w1, c1, n1 = modeling.swin_geometry(SWIN_CFG, 1)
+    assert (h0, w0, c0, n0) == (8, 8, 16, 2)
+    assert (h1, w1, c1, n1) == (4, 4, 32, 4)
+    # stage-1 layers see the merged (quartered, doubled-width) map
+    p = modeling.init_model_params(jax.random.key(0), SWIN_CFG)
+    assert p["layers"][2]["attn"]["wq"].shape == (32, 32)
+    assert p["merges"][0]["w"].shape == (64, 32)
+
+
+def test_vision_dataloader_contract():
+    from galvatron_tpu.core.dataloader import build_dataloader
+
+    it = build_dataloader(VIT_CFG, 8, seed=3)
+    b = next(it)
+    assert b.shape == (8, VIT_CFG.sample_len + 1) and b.dtype == np.int32
+    assert b[:, :-1].min() >= 0 and b[:, :-1].max() <= 255
+    assert (b[:, -1] < VIT_CFG.num_classes).all() and (b[:, -1] >= 0).all()
+    # deterministic stream (resume contract)
+    b2 = next(build_dataloader(VIT_CFG, 8, seed=3))
+    np.testing.assert_array_equal(b, b2)
+
+
+def test_analytic_costs_vision():
+    """Analytic (unprofiled) cost model covers the vision families: ViT one
+    uniform layer type; Swin one type per layer with the stage pyramid's
+    shrinking seq / widening hidden reflected in the costs."""
+    from galvatron_tpu.search.theoretical import analytic_model_costs, total_param_count
+
+    vit = analytic_model_costs(modeling.PRESETS["vit-base"], mixed_precision="bf16")
+    assert set(vit.layer_types) == {0}
+    assert vit.layer_types[0].fwd_ms_per_sample > 0
+    assert 1 in vit.layer_types[0].activation_mb_per_sample
+
+    swin_cfg = modeling.PRESETS["swin-base"]
+    swin = analytic_model_costs(swin_cfg, mixed_precision="bf16")
+    assert set(swin.layer_types) == set(range(swin_cfg.num_layers))
+    # deeper stages: fewer tokens but wider layers → more params per layer
+    assert (
+        swin.layer_types[23].parameter_mb > swin.layer_types[0].parameter_mb
+    )
+    assert (
+        swin.layer_types[0].boundary_activation_mb_per_sample
+        > swin.layer_types[23].boundary_activation_mb_per_sample
+    )
+    # param totals match the real init (exactness contract of theoretical.py)
+    p = jax.eval_shape(lambda k: modeling.init_model_params(k, swin_cfg), jax.random.key(0))
+    n_real = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(p))
+    assert total_param_count(swin_cfg) == n_real
+
+
+def test_search_engine_swin_multi_layer_type():
+    """The DP search runs per-layer over Swin's heterogeneous layer types and
+    returns a feasible pp=1 strategy."""
+    from galvatron_tpu.search.cost_model import ProfiledHardware
+    from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+    from galvatron_tpu.search.theoretical import analytic_model_costs
+
+    cfg = SWIN_CFG
+    costs = analytic_model_costs(cfg, mixed_precision="bf16")
+    # default pp sweep: the engine must gate heterogeneous layer types to
+    # pp=1 itself (the runtime rejects Swin at pp>1 — a pp>1 "win" here would
+    # break the search→train workflow)
+    eng = SearchEngine(
+        costs, ProfiledHardware(), num_layers=cfg.num_layers,
+        space=SearchSpace(world_size=8, max_tp=2),
+        memory_budget_mb=4096.0,
+    )
+    res = eng.search([8], max_chunks=1)
+    assert res is not None and res.config.pp == 1
+    assert len(res.config.layer_strategies) == cfg.num_layers
+
+
+def test_vit_preset_shapes():
+    cfg = modeling.PRESETS["vit-base"]
+    assert cfg.n_patches == 196 and cfg.sample_len == 224 * 224 * 3
+    p = jax.eval_shape(lambda k: modeling.init_model_params(k, cfg), jax.random.key(0))
+    assert p["embed"]["proj"].shape == (16 * 16 * 3, 768)
+    assert p["head"]["w"].shape == (768, 1000)
+    swin = modeling.PRESETS["swin-base"]
+    assert swin.num_layers == sum(swin.swin_depths)
+    ps = jax.eval_shape(lambda k: modeling.init_model_params(k, swin), jax.random.key(0))
+    assert ps["head"]["w"].shape == (128 * 8, 1000)  # C·2^3 after 3 merges
