@@ -59,9 +59,7 @@ def _layer_with_cache(x, p, cfg: ModelConfig, k_cache, v_cache, offset, cos_sin,
     hd = cfg.head_dim
     xa = modeling.norm(x, p["attn_norm"], cfg)
     pa = p["attn"]
-    q = (xa @ pa["wq"].astype(xa.dtype)).reshape(b, s, cfg.num_heads, hd)
-    k = (xa @ pa["wk"].astype(xa.dtype)).reshape(b, s, cfg.kv_heads, hd)
-    v = (xa @ pa["wv"].astype(xa.dtype)).reshape(b, s, cfg.kv_heads, hd)
+    q, k, v = modeling.split_qkv(xa @ pa["wqkv"].astype(xa.dtype), cfg)
     if cfg.pos_embed == "rope":
         cos, sin = cos_sin
         q = modeling.apply_rope(q, cos, sin)

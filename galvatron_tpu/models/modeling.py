@@ -144,17 +144,36 @@ def _dense_init(key, in_dim, out_dim, dtype):
     return jax.random.uniform(key, (in_dim, out_dim), dtype, -scale, scale)
 
 
+def qkv_dims(cfg: ModelConfig) -> Tuple[int, int]:
+    """(kv groups, per-group width) of the fused QKV projection: columns are
+    interleaved by kv-head group — group g holds its n/kv query heads then its
+    k head then its v head (Megatron's fused-QKV ColumnParallel layout with
+    GQA head-group splitting, reference: galvatron/core/tensor_parallel/
+    transformer.py:679-708) — so TP shards at kv-group boundaries never split
+    a q|k|v slice."""
+    group = (cfg.num_heads // cfg.kv_heads + 2) * cfg.head_dim
+    return cfg.kv_heads, group
+
+
+def split_qkv(qkv, cfg: ModelConfig):
+    """(…, kv·group) fused projection → q (…, n, hd), k/v (…, kv, hd)."""
+    kv, group = qkv_dims(cfg)
+    npg = cfg.num_heads // cfg.kv_heads  # query heads per kv group
+    r = qkv.reshape(*qkv.shape[:-1], kv, npg + 2, cfg.head_dim)
+    q = r[..., :npg, :].reshape(*qkv.shape[:-1], cfg.num_heads, cfg.head_dim)
+    return q, r[..., npg, :], r[..., npg + 1, :]
+
+
 def init_layer_params(key, cfg: ModelConfig, cross: bool = False) -> Params:
     h, hd = cfg.hidden_size, cfg.head_dim
     q_out = cfg.num_heads * hd
     kv_out = cfg.kv_heads * hd
+    kv, group = qkv_dims(cfg)
     ks = jax.random.split(key, 8)
     p: Params = {
         "attn_norm": {"scale": jnp.ones((h,), cfg.param_dtype)},
         "attn": {
-            "wq": _dense_init(ks[0], h, q_out, cfg.param_dtype),
-            "wk": _dense_init(ks[1], h, kv_out, cfg.param_dtype),
-            "wv": _dense_init(ks[2], h, kv_out, cfg.param_dtype),
+            "wqkv": _dense_init(ks[0], h, kv * group, cfg.param_dtype),
             "wo": _dense_init(ks[3], q_out, h, cfg.param_dtype),
         },
         "mlp_norm": {"scale": jnp.ones((h,), cfg.param_dtype)},
@@ -164,8 +183,7 @@ def init_layer_params(key, cfg: ModelConfig, cross: bool = False) -> Params:
         p["cross_norm"] = {"scale": jnp.ones((h,), cfg.param_dtype)}
         p["cross"] = {
             "wq": _dense_init(ck[0], h, q_out, cfg.param_dtype),
-            "wk": _dense_init(ck[1], h, kv_out, cfg.param_dtype),
-            "wv": _dense_init(ck[2], h, kv_out, cfg.param_dtype),
+            "wkv": _dense_init(ck[1], h, 2 * kv_out, cfg.param_dtype),
             "wo": _dense_init(ck[3], q_out, h, cfg.param_dtype),
         }
         if cfg.norm_type == "layernorm":
@@ -175,9 +193,11 @@ def init_layer_params(key, cfg: ModelConfig, cross: bool = False) -> Params:
 
         p["mlp"] = moe.init_moe_params(ks[4], cfg)
     elif cfg.act_fn == "swiglu":
+        # fused gate pair [w1 | w3] (Megatron dense_h_to_4h with swiglu,
+        # reference ParallelMLP transformer.py:78-159): one wide GEMM; the F
+        # boundary aligns with every power-of-two TP shard
         p["mlp"] = {
-            "w1": _dense_init(ks[4], h, cfg.ffn, cfg.param_dtype),
-            "w3": _dense_init(ks[5], h, cfg.ffn, cfg.param_dtype),
+            "w13": _dense_init(ks[4], h, 2 * cfg.ffn, cfg.param_dtype),
             "w2": _dense_init(ks[6], cfg.ffn, h, cfg.param_dtype),
         }
     else:
@@ -198,9 +218,7 @@ def layer_annotations(cfg: ModelConfig, cross: bool = False) -> Params:
     a: Params = {
         "attn_norm": {"scale": ("fsdp",)},
         "attn": {
-            "wq": ("fsdp", "tp"),
-            "wk": ("fsdp", "tp"),
-            "wv": ("fsdp", "tp"),
+            "wqkv": ("fsdp", "tp"),
             "wo": ("tp", "fsdp"),
         },
         "mlp_norm": {"scale": ("fsdp",)},
@@ -209,8 +227,7 @@ def layer_annotations(cfg: ModelConfig, cross: bool = False) -> Params:
         a["cross_norm"] = {"scale": ("fsdp",)}
         a["cross"] = {
             "wq": ("fsdp", "tp"),
-            "wk": ("fsdp", "tp"),
-            "wv": ("fsdp", "tp"),
+            "wkv": ("fsdp", "tp"),
             "wo": ("tp", "fsdp"),
         }
         if cfg.norm_type == "layernorm":
@@ -220,7 +237,7 @@ def layer_annotations(cfg: ModelConfig, cross: bool = False) -> Params:
 
         a["mlp"] = moe.moe_annotations(cfg)
     elif cfg.act_fn == "swiglu":
-        a["mlp"] = {"w1": ("fsdp", "tp"), "w3": ("fsdp", "tp"), "w2": ("tp", "fsdp")}
+        a["mlp"] = {"w13": ("fsdp", "tp"), "w2": ("tp", "fsdp")}
     else:
         a["mlp"] = {"w1": ("fsdp", "tp"), "w2": ("tp", "fsdp")}
     if cfg.norm_type == "layernorm":
@@ -508,14 +525,20 @@ def attention_xla(q, k, v, cfg: ModelConfig, bias=None, q_offset=0):
     return jnp.einsum("bnqk,bknh->bqnh", probs, v)
 
 
-def attention(q, k, v, cfg: ModelConfig, bias=None):
+def attention(q, k, v, cfg: ModelConfig, bias=None, rope=None):
+    """``rope``: optional (cos, sin) tables. On the flash path they are fused
+    into the Pallas kernels (no HBM round-trip of roped q/k); otherwise
+    apply_rope runs here before the einsum path."""
     if cfg.attn_impl == "flash" and bias is None:
         from galvatron_tpu.ops.flash_attention import flash_attention
 
         nh = q.shape[2]
         k = _repeat_kv(k, nh // k.shape[2])
         v = _repeat_kv(v, nh // v.shape[2])
-        return flash_attention(q, k, v, causal=cfg.causal)
+        return flash_attention(q, k, v, causal=cfg.causal, rope=rope)
+    if rope is not None:
+        q = apply_rope(q, *rope)
+        k = apply_rope(k, *rope)
     return attention_xla(q, k, v, cfg, bias=bias)
 
 
@@ -525,13 +548,10 @@ def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: boo
     (reference: galvatron/core/tensor_parallel/transformer.py:597,615-636)."""
     b, s, h = x.shape
     hd = cfg.head_dim
-    q = (x @ p["wq"].astype(x.dtype)).reshape(b, s, cfg.num_heads, hd)
-    k = (x @ p["wk"].astype(x.dtype)).reshape(b, s, cfg.kv_heads, hd)
-    v = (x @ p["wv"].astype(x.dtype)).reshape(b, s, cfg.kv_heads, hd)
-    if cfg.pos_embed == "rope":
-        cos, sin = cos_sin
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+    # one fused qkv GEMM (~2 ms/layer-batch over three narrow matmuls on the
+    # v5e 7B-shape bench); layout per qkv_dims
+    q, k, v = split_qkv(x @ p["wqkv"].astype(x.dtype), cfg)
+    rope = cos_sin if cfg.pos_embed == "rope" else None
     bias = None
     if cfg.pos_embed == "alibi":
         pos = jnp.arange(s)
@@ -539,7 +559,7 @@ def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: boo
         bias = (alibi[:, None, None] * rel[None]).astype(jnp.float32)[None]  # (1,n,q,k)
 
     def core(q_, k_, v_, bias_):
-        return attention(q_, k_, v_, cfg, bias=bias_)
+        return attention(q_, k_, v_, cfg, bias=bias_, rope=rope)
 
     if remat_attn:
         core = jax.checkpoint(core)
@@ -557,9 +577,11 @@ def mlp_block(x, p, cfg: ModelConfig, train: bool = True):
 
         return moe.moe_block(x, p, cfg, train=train)
     if cfg.act_fn == "swiglu":
-        return (
-            jax.nn.silu(x @ p["w1"].astype(x.dtype)) * (x @ p["w3"].astype(x.dtype))
-        ) @ p["w2"].astype(x.dtype)
+        # fused [w1 | w3] gate GEMM (~3.5 ms/layer-batch over two narrow
+        # matmuls on the v5e 7B-shape bench)
+        f = p["w13"].shape[-1] // 2
+        g = x @ p["w13"].astype(x.dtype)
+        return (jax.nn.silu(g[..., :f]) * g[..., f:]) @ p["w2"].astype(x.dtype)
     return jax.nn.gelu(x @ p["w1"].astype(x.dtype), approximate=True) @ p["w2"].astype(x.dtype)
 
 
@@ -570,10 +592,12 @@ def cross_attn_block(x, enc_out, p, cfg: ModelConfig):
     positions; no rotary — positions live in the respective streams."""
     b, s, h = x.shape
     hd = cfg.head_dim
+    kv_out = cfg.kv_heads * hd
     se = enc_out.shape[1]
     q = (x @ p["wq"].astype(x.dtype)).reshape(b, s, cfg.num_heads, hd)
-    k = (enc_out.astype(x.dtype) @ p["wk"].astype(x.dtype)).reshape(b, se, cfg.kv_heads, hd)
-    v = (enc_out.astype(x.dtype) @ p["wv"].astype(x.dtype)).reshape(b, se, cfg.kv_heads, hd)
+    kvp = enc_out.astype(x.dtype) @ p["wkv"].astype(x.dtype)  # fused [k | v] GEMM
+    k = kvp[..., :kv_out].reshape(b, se, cfg.kv_heads, hd)
+    v = kvp[..., kv_out:].reshape(b, se, cfg.kv_heads, hd)
     o = attention_xla(q, k, v, cfg.replace(causal=False))
     return o.reshape(b, s, cfg.num_heads * hd) @ p["wo"].astype(x.dtype)
 
@@ -711,9 +735,7 @@ def swin_attention(x, p, lcfg: ModelConfig, h: int, w: int, window: int, shift: 
         .transpose(0, 1, 3, 2, 4, 5)
         .reshape(b * nh * nw, ws2, c)
     )
-    q = (xw @ p["wq"].astype(x.dtype)).reshape(-1, ws2, heads, hd)
-    k = (xw @ p["wk"].astype(x.dtype)).reshape(-1, ws2, heads, hd)
-    v = (xw @ p["wv"].astype(x.dtype)).reshape(-1, ws2, heads, hd)
+    q, k, v = split_qkv(xw @ p["wqkv"].astype(x.dtype), lcfg)  # fused projection
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(hd)
     if shift:
         mask = jnp.asarray(_swin_attn_mask(h, w, window, shift))  # (nW, ws2, ws2)

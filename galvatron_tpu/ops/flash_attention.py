@@ -34,12 +34,50 @@ def _use_interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
+def _rope_rows(x, c, s):
+    """Rotate-half RoPE on one (rows, d) block; c/s are (rows, d/2) fp32.
+    Returns fp32 (cast back to the MXU dtype at the dot)."""
+    xf = x.astype(jnp.float32)
+    d2 = xf.shape[-1] // 2
+    x1, x2 = xf[:, :d2], xf[:, d2:]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def _rope_rows_t(y, c, s):
+    """Transpose (inverse) rotation — maps gradients w.r.t. roped vectors back
+    to gradients w.r.t. the raw q/k rows."""
+    d2 = y.shape[-1] // 2
+    y1, y2 = y[:, :d2], y[:, d2:]
+    return jnp.concatenate([y1 * c + y2 * s, y2 * c - y1 * s], axis=-1)
+
+
+def _rope_io(rope, block_q: int, block_k: int, d: int, qk_order: str):
+    """(extra in_specs, extra inputs) for the fused-rope kernels: cos/sin row
+    blocks for the q rows then the k rows. ``qk_order`` is 'ij' when the grid
+    is (..., q_block, k_block) and 'ji' when it is (..., k_block, q_block)."""
+    if rope is None:
+        return [], []
+    cos, sin = rope
+    if qk_order == "ij":
+        qrow = pl.BlockSpec((block_q, d // 2), lambda b_, h_, i, j: (i, 0))
+        krow = pl.BlockSpec((block_k, d // 2), lambda b_, h_, i, j: (j, 0))
+    else:
+        qrow = pl.BlockSpec((block_q, d // 2), lambda b_, h_, j, i: (i, 0))
+        krow = pl.BlockSpec((block_k, d // 2), lambda b_, h_, j, i: (j, 0))
+    return [qrow, qrow, krow, krow], [cos, sin, cos, sin]
+
+
 # ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, sm_scale, causal, block_q, block_k, num_k_blocks):
+def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks, rope):
+    if rope:
+        q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref = refs[:7]
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs[7:]
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     i = pl.program_id(2)
     j = pl.program_id(3)
 
@@ -61,9 +99,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
 
     @pl.when(contributes)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        # keep q/k/v in their storage dtype (bf16): fp32 MXU matmul runs at a
+        # fraction of the bf16 rate; accumulation stays fp32 via
+        # preferred_element_type, softmax math stays fp32. RoPE (when fused)
+        # rotates the VMEM-resident blocks — the roped q/k never round-trip
+        # through HBM.
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        if rope:
+            q = _rope_rows(q, cq_ref[...], sq_ref[...]).astype(q_ref.dtype)
+            k = _rope_rows(k, ck_ref[...], sk_ref[...]).astype(k_ref.dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # (block_q, block_k)
@@ -77,7 +123,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
         alpha = jnp.exp(m_old - m_new)
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = alpha * acc_scr[:] + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -91,7 +137,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
         )
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, rope, sm_scale, causal, block_q, block_k, interpret):
     b, h, s, d = q.shape
     nq, nk = s // block_q, s // block_k
     grid = (b, h, nq, nk)
@@ -102,15 +148,19 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         block_q=block_q,
         block_k=block_k,
         num_k_blocks=nk,
+        rope=rope is not None,
     )
+    rope_specs, rope_inputs = _rope_io(rope, block_q, block_k, d, "ij")
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+        pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+    ] + rope_specs
+    inputs = [q, k, v] + rope_inputs
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
             # trailing unit dim keeps the block 2D-tileable on real TPUs
@@ -129,7 +179,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return out, lse
 
 
@@ -138,7 +188,13 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal, block_q, block_k, num_q_blocks):
+def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks, rope):
+    if rope:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         cq_ref, sq_ref, ck_ref, sk_ref, dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
     j = pl.program_id(2)  # k block
     i = pl.program_id(3)  # q block (innermost)
 
@@ -153,10 +209,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
 
     @pl.when(contributes)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 MXU inputs, fp32 accumulate/softmax (see _fwd_kernel note)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        if rope:
+            q = _rope_rows(q, cq_ref[...], sq_ref[...]).astype(q_ref.dtype)
+            k = _rope_rows(k, ck_ref[...], sk_ref[...]).astype(k_ref.dtype)
         lse = lse_ref[0, 0].astype(jnp.float32)  # (block_q, 1)
         delta = delta_ref[0, 0].astype(jnp.float32)  # (block_q, 1)
         s = jax.lax.dot_general(
@@ -168,23 +228,34 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)  # softmax probs
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta) * sm_scale
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(i == num_q_blocks - 1)
     def _finalize():
-        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dk = dk_scr[:]
+        if rope:
+            # dk was accumulated w.r.t. the ROPED k — counter-rotate back
+            dk = _rope_rows_t(dk, ck_ref[...], sk_ref[...])
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *, sm_scale, causal, block_q, block_k, num_k_blocks):
+def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks, rope):
+    if rope:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         cq_ref, sq_ref, ck_ref, sk_ref, dq_ref, dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr) = refs
     i = pl.program_id(2)  # q block
     j = pl.program_id(3)  # k block (innermost)
 
@@ -201,10 +272,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
 
     @pl.when(contributes)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 MXU inputs, fp32 accumulate/softmax (see _fwd_kernel note)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        if rope:
+            q = _rope_rows(q, cq_ref[...], sq_ref[...]).astype(q_ref.dtype)
+            k = _rope_rows(k, ck_ref[...], sk_ref[...]).astype(k_ref.dtype)
         lse = lse_ref[0, 0].astype(jnp.float32)  # (block_q, 1)
         delta = delta_ref[0, 0].astype(jnp.float32)  # (block_q, 1)
         s = jax.lax.dot_general(
@@ -219,15 +294,21 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta) * sm_scale
-        dq_scr[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+        dq_scr[:] += jax.lax.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+        )
 
     @pl.when(j == last_j)
     def _finalize():
-        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+        dq = dq_scr[:]
+        if rope:
+            # dq was accumulated w.r.t. the ROPED q — counter-rotate back
+            dq = _rope_rows_t(dq, cq_ref[...], sq_ref[...])
+        dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 def _flash_bwd(res, do_bhsd, sm_scale, causal, block_q, block_k, interpret):
-    q, k, v, out, lse = res
+    q, k, v, out, lse, rope = res
     b, h, s, d = q.shape
     nq, nk = s // block_q, s // block_k
     delta = jnp.sum(
@@ -237,14 +318,18 @@ def _flash_bwd(res, do_bhsd, sm_scale, causal, block_q, block_k, interpret):
     qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, j, i: (b_, h_, i, 0))
     kspec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0))
     rowspec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, j, i: (b_, h_, i, 0))
+    rope_specs_ji, rope_inputs = _rope_io(rope, block_q, block_k, d, "ji")
+    dkv_in_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec] + rope_specs_ji
+    dkv_inputs = [q, k, v, do_bhsd, lse, delta] + rope_inputs
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel,
             sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, num_q_blocks=nq,
+            rope=rope is not None,
         ),
         grid=(b, h, nk, nq),
-        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
@@ -261,19 +346,23 @@ def _flash_bwd(res, do_bhsd, sm_scale, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q, k, v, do_bhsd, lse, delta)
+    )(*dkv_inputs)
 
     qspec2 = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
     kspec2 = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0))
     rowspec2 = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+    rope_specs_ij, rope_inputs_ij = _rope_io(rope, block_q, block_k, d, "ij")
+    dq_in_specs = [qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2] + rope_specs_ij
+    dq_inputs = [q, k, v, do_bhsd, lse, delta] + rope_inputs_ij
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel,
             sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, num_k_blocks=nk,
+            rope=rope is not None,
         ),
         grid=(b, h, nq, nk),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
@@ -281,7 +370,7 @@ def _flash_bwd(res, do_bhsd, sm_scale, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q, k, v, do_bhsd, lse, delta)
+    )(*dq_inputs)
     return dq, dk, dv
 
 
@@ -290,20 +379,22 @@ def _flash_bwd(res, do_bhsd, sm_scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, _use_interpret())
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, rope, sm_scale, causal, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, rope, sm_scale, causal, block_q, block_k, _use_interpret())
     return out
 
 
-def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, _use_interpret())
-    return out, (q, k, v, out, lse)
+def _flash_fwd_rule(q, k, v, rope, sm_scale, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, rope, sm_scale, causal, block_q, block_k, _use_interpret())
+    return out, (q, k, v, out, lse, rope)
 
 
 def _flash_bwd_rule(sm_scale, causal, block_q, block_k, res, do):
     dq, dk, dv = _flash_bwd(res, do, sm_scale, causal, block_q, block_k, _use_interpret())
-    return dq, dk, dv
+    rope = res[5]
+    drope = None if rope is None else jax.tree.map(jnp.zeros_like, rope)
+    return dq, dk, dv, drope
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -317,15 +408,23 @@ def flash_attention(
     sm_scale: Optional[float] = None,
     block_q: int = 1024,
     block_k: int = 1024,
+    rope=None,
 ):
     """q, k, v: (batch, seq, heads, head_dim); returns same layout.
 
     GQA callers repeat kv heads first (modeling._repeat_kv). Tiles of
     (block_q, block_k); shapes that don't tile fall back to the einsum path.
-    Defaults tuned on v5e (b8 x s2048 x h32 x d128): 1024/1024 runs the
-    forward at 18.5 ms and fwd+bwd at 29.6 ms vs 21.3/34.2 at 512/512 (XLA
-    attention: 45 ms forward); 2048/512 is marginally faster forward-only but
-    fails to compile the backward.
+
+    ``rope``: optional (cos, sin) tables, each (seq, head_dim/2) fp32 — the
+    rotate-half rotary embedding is applied to q/k blocks INSIDE the kernels
+    (forward and both backward passes, with the transpose rotation mapping
+    dq/dk back to raw coordinates). Fusing it removes the HBM round-trip of
+    materialized roped q/k that a separate apply_rope costs (~0.27 ms/layer/
+    sample on the v5e LLaMA-7B-shape bench).
+
+    Defaults tuned on v5e (b8 x s2048 x h32 x d128) with bf16 MXU inputs:
+    1024/1024 is fastest end-to-end; fp32 operands would run the MXU at a
+    fraction of the bf16 rate (softmax/accumulation stay fp32).
     """
     b, s, n, d = q.shape
     if sm_scale is None:
@@ -335,10 +434,16 @@ def flash_attention(
     if s % block_q or s % block_k:
         from galvatron_tpu.models import modeling
 
-        cfg = modeling.ModelConfig(num_heads=n, hidden_size=n * d, attn_impl="xla")
+        if rope is not None:
+            q = modeling.apply_rope(q, *rope)
+            k = modeling.apply_rope(k, *rope)
+        # honor the caller's mask and scale (attention_xla divides by sqrt(d),
+        # so pre-scale q to express an arbitrary sm_scale)
+        q = q * jnp.asarray(sm_scale * np.sqrt(d), q.dtype)
+        cfg = modeling.ModelConfig(num_heads=n, hidden_size=n * d, attn_impl="xla", causal=causal)
         return modeling.attention_xla(q, k, v, cfg)
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    out = _flash(qt, kt, vt, sm_scale, causal, block_q, block_k)
+    out = _flash(qt, kt, vt, rope, sm_scale, causal, block_q, block_k)
     return jnp.transpose(out, (0, 2, 1, 3))

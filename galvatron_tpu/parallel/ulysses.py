@@ -80,9 +80,7 @@ def ulysses_decoder_layer(x, p, cfg: ModelConfig, mesh, cp_axes, cos_sin):
     def attn(xn):
         b, s, h = xn.shape
         hd = cfg.head_dim
-        q = (xn @ p["attn"]["wq"].astype(xn.dtype)).reshape(b, s, cfg.num_heads, hd)
-        k = (xn @ p["attn"]["wk"].astype(xn.dtype)).reshape(b, s, cfg.kv_heads, hd)
-        v = (xn @ p["attn"]["wv"].astype(xn.dtype)).reshape(b, s, cfg.kv_heads, hd)
+        q, k, v = modeling.split_qkv(xn @ p["attn"]["wqkv"].astype(xn.dtype), cfg)
         if cfg.pos_embed == "rope":
             cos, sin = cos_sin
             q = modeling.apply_rope(q, cos, sin)

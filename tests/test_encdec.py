@@ -72,8 +72,8 @@ def test_encdec_parity_tp2_and_heterogeneous():
     np.testing.assert_allclose(
         float(r1.eval_loss(s1, b)), float(r2.eval_loss(s2, b)), rtol=2e-5
     )
-    # decoder layer 1 (strategy index 3) is tp=4 on wq
-    spec = s2["params"]["layers"][1]["attn"]["wq"].sharding.spec
+    # decoder layer 1 (strategy index 3) is tp=4 on wqkv
+    spec = s2["params"]["layers"][1]["attn"]["wqkv"].sharding.spec
     assert spec[1] is not None and len(spec[1]) == 2  # two binary axes = tp4
 
 

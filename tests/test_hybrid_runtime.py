@@ -146,15 +146,15 @@ def test_param_shardings_applied():
     rt = build_runtime(CFG, hp, adam=ADAM, global_batch_size=8, seq_len=32)
     state = rt.init_state(jax.random.key(0))
     # layer 0: zero3 → wq sharded over all data axes on dim 0
-    wq0 = state["params"]["layers"][0]["attn"]["wq"]
+    wq0 = state["params"]["layers"][0]["attn"]["wqkv"]
     assert wq0.sharding.spec[0] == ("x0", "x1", "x2")
     # layer 2: tp4 → wq sharded over 2 tp axes on dim 1
-    wq2 = state["params"]["layers"][2]["attn"]["wq"]
+    wq2 = state["params"]["layers"][2]["attn"]["wqkv"]
     assert wq2.sharding.spec[1] == ("x1", "x2")
     # layer 3: zero2 → param replicated, opt state sharded
-    wq3 = state["params"]["layers"][3]["attn"]["wq"]
+    wq3 = state["params"]["layers"][3]["attn"]["wqkv"]
     assert wq3.sharding.spec[0] is None
-    mu3 = state["opt"]["mu"]["layers"][3]["attn"]["wq"]
+    mu3 = state["opt"]["mu"]["layers"][3]["attn"]["wqkv"]
     assert mu3.sharding.spec[0] is not None
 
 

@@ -58,6 +58,61 @@ def test_flash_fallback_on_untileable_shape():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+def _rope_tables(s, d):
+    cfg = ModelConfig(num_heads=2, hidden_size=2 * d, max_seq_len=s)
+    return modeling.rope_tables(cfg, s)
+
+
+def test_flash_fused_rope_matches_external_rope():
+    """RoPE fused into the kernels (q/k rotated in VMEM) must equal the
+    materialized apply_rope → attention path, forward and gradients (the
+    backward counter-rotates dq/dk back to raw coordinates)."""
+    q, k, v = rand_qkv(jax.random.key(4), s=128, d=32)
+    cos, sin = _rope_tables(128, 32)
+
+    def f_fused(q, k, v):
+        return (
+            flash_attention(q, k, v, causal=True, block_q=32, block_k=64, rope=(cos, sin)) ** 2
+        ).sum()
+
+    def f_ref(q, k, v):
+        qr = modeling.apply_rope(q, cos, sin)
+        kr = modeling.apply_rope(k, cos, sin)
+        return (ref_attention(qr, kr, v) ** 2).sum()
+
+    np.testing.assert_allclose(
+        float(f_fused(q, k, v)), float(f_ref(q, k, v)), rtol=2e-5
+    )
+    g_fused = jax.grad(f_fused, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fused, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4)
+
+
+def test_flash_fallback_preserves_causal_and_scale():
+    """The untileable-shape fallback must honor causal=False (encoder models)
+    and a caller-supplied sm_scale — regression: it used to rebuild a default
+    (causal=True, 1/sqrt(d)) config, silently causally masking encoders."""
+    q, k, v = rand_qkv(jax.random.key(6), s=48, d=32)  # 48 % 32 != 0
+    out = flash_attention(q, k, v, causal=False, sm_scale=0.25, block_q=32, block_k=32)
+    cfg = ModelConfig(num_heads=2, hidden_size=64, causal=False)
+    ref = modeling.attention_xla(q * (0.25 * np.sqrt(32)), k, v, cfg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    # and the causal mask really is off: last query attends to the last key
+    out_causal = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    assert not np.allclose(np.asarray(out), np.asarray(out_causal), atol=1e-3)
+
+
+def test_flash_fused_rope_fallback_applies_rope():
+    """The untileable-shape fallback must still apply the rope it was asked
+    to fuse."""
+    q, k, v = rand_qkv(jax.random.key(5), s=48, d=32)
+    cos, sin = _rope_tables(48, 32)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32, rope=(cos, sin))
+    ref = ref_attention(modeling.apply_rope(q, cos, sin), modeling.apply_rope(k, cos, sin), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
 def test_ring_attention_matches_reference():
     from galvatron_tpu.parallel.mesh import build_mesh
     from galvatron_tpu.parallel.ring import ring_attention

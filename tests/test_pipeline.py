@@ -106,7 +106,7 @@ def test_pipeline_stage_param_placement():
     )
     rt = build_runtime(CFG, hp, adam=ADAM, global_batch_size=8, seq_len=32)
     state = rt.init_state(jax.random.key(0))
-    wq = state["params"]["stages"][0]["attn"]["wq"]
+    wq = state["params"]["stages"][0]["attn"]["wqkv"]
     assert wq.shape[0] == 2  # stacked over stages
     assert wq.sharding.spec[0] == "pp"
     assert wq.sharding.spec[2] in ("x1", ("x1",))  # tp on out dim
