@@ -79,6 +79,9 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             allow_zero3=not ns.disable_sdp,
             allow_strided=not ns.disable_tp_consec,
             allow_cp=bool(ns.enable_cp),
+            allow_ep=bool(ns.enable_ep),
+            max_ep=ns.max_ep_deg,
+            moe_experts=cfg.moe_experts,
             max_vpp=ns.max_vpp_deg,
         )
         if ns.search_space == "dp":

@@ -41,6 +41,9 @@ def _add_model_args(p: argparse.ArgumentParser):
     g.add_argument("--swin_window", type=int, default=None)
     g.add_argument("--swin_depths", type=str, default=None,
                    help="comma list, e.g. 2,2,18,2 (must sum to --num_layers)")
+    g.add_argument("--moe_experts", type=int, default=None,
+                   help="switch-MoE expert count (0/None = dense MLP)")
+    g.add_argument("--moe_capacity_factor", type=float, default=None)
 
 
 def _add_training_args(p: argparse.ArgumentParser):
@@ -126,6 +129,9 @@ def _add_search_args(p: argparse.ArgumentParser):
     g.add_argument("--disable_sp", type=int, default=0)
     g.add_argument("--disable_tp_consec", type=int, default=0)
     g.add_argument("--enable_cp", type=int, default=0)
+    g.add_argument("--enable_ep", type=int, default=0,
+                   help="search expert parallelism (MoE models)")
+    g.add_argument("--max_ep_deg", type=int, default=8)
     g.add_argument("--max_tp_deg", type=int, default=8)
     g.add_argument("--max_vpp_deg", type=int, default=1,
                    help="search interleaved virtual-stage degrees up to this "
@@ -218,6 +224,8 @@ def model_config_from_args(ns: argparse.Namespace):
         ("enc_layers", "enc_layers"), ("enc_seq", "enc_seq"),
         ("image_size", "image_size"), ("patch_size", "patch_size"),
         ("num_classes", "num_classes"), ("swin_window", "swin_window"),
+        ("moe_experts", "moe_experts"),
+        ("moe_capacity_factor", "moe_capacity_factor"),
     ]:
         v = getattr(ns, attr, None)
         if v is not None:

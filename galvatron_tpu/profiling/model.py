@@ -121,7 +121,15 @@ def profile_model(
         act_mb = act_bytes * 2 / 1e6
 
     boundary_mb = seq * cfg.hidden_size * 2 / 1e6  # one bf16 (S, H) tensor
-    p_mb = layer_param_count(cfg) * 4 / 1e6
+    p_layer = layer_param_count(cfg)
+    p_mb = p_layer * 4 / 1e6
+    # MoE: expert-stack param fraction + dispatch/combine a2a volume — the
+    # analytic structural facts the measured profile cannot see (search/
+    # theoretical.py uses the same derivation)
+    moe_frac, moe_a2a = 0.0, 0.0
+    if cfg.moe_experts > 0:
+        moe_frac = (cfg.moe_experts * 3 * cfg.hidden_size * cfg.ffn) / p_layer
+        moe_a2a = 2.0 * seq * cfg.hidden_size * 2 / 1e6  # bf16, each way
     costs = ProfiledModelCosts(
         layer_types={
             0: ProfiledLayerType(
@@ -129,6 +137,8 @@ def profile_model(
                 parameter_mb=float(p_mb),
                 activation_mb_per_sample={t: float(act_mb / t) for t in (1, 2, 4, 8)},
                 boundary_activation_mb_per_sample=float(boundary_mb),
+                moe_expert_param_fraction=float(moe_frac),
+                moe_a2a_mb_per_sample=float(moe_a2a),
             )
         },
         other_param_mb=float(other_param_count(cfg) * 4 / 1e6),

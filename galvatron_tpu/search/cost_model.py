@@ -50,6 +50,13 @@ class ProfiledLayerType:
     parameter_mb: float
     activation_mb_per_sample: Dict[int, float]
     boundary_activation_mb_per_sample: float
+    # MoE (switch) layers: fraction of parameter_mb (and, as a proxy, of
+    # compute) that lives in the expert stack — shardable by the ep strategy
+    # dim — and the token dispatch+combine all-to-all volume per sample.
+    # 0 → dense layer; ep has no effect. The reference carries SwitchMLP but
+    # never searches EP (SURVEY §2.3 ⚠) — this closes that gap.
+    moe_expert_param_fraction: float = 0.0
+    moe_a2a_mb_per_sample: float = 0.0
 
     def act_mb(self, tp: int, sp: bool, cp: int = 1) -> float:
         base = self.activation_mb_per_sample.get(tp)
@@ -143,13 +150,22 @@ def layer_memory_cost(
     """Per-chip memory for one layer under strategy ``s``
     (reference: MemoryCostModel, galvatron/core/cost_model.py:4-122)."""
     dp = world // (pp * s.tp * s.cp)
-    p_mb = lt.parameter_mb / s.tp  # fp32 MB after TP sharding
+    # fp32 MB after TP sharding; the expert fraction additionally shards by
+    # ep, and its ZeRO sharding spreads only over the dp/ep extent left (the
+    # runtime strips the ep axes from the fsdp axes — parallel/sharding.py)
+    frac = lt.moe_expert_param_fraction
+    ep = max(1, s.ep)
+    dense_mb = lt.parameter_mb * (1.0 - frac) / s.tp
+    exp_mb = lt.parameter_mb * frac / (s.tp * ep)
+    dp_exp = max(1, dp // ep)
+    p_mb = dense_mb + exp_mb
     # fp32 master + grad + two Adam moments = 4x; bf16 adds a half-weight cast
     cast = 0.5 * p_mb if mixed_precision in ("bf16", "fp16") else 0.0
     if s.dp_type == "zero3":
-        states = 4.0 * p_mb / dp + cast  # cast buffer = gathered working copy
+        # cast buffer = gathered working copy
+        states = 4.0 * (dense_mb / dp + exp_mb / dp_exp) + cast
     elif s.dp_type == "zero2":
-        states = 2.0 * p_mb + 2.0 * p_mb / dp + cast
+        states = 2.0 * p_mb + 2.0 * (dense_mb / dp + exp_mb / dp_exp) + cast
     else:
         states = 4.0 * p_mb + cast
     local_bsz = global_bsz / dp / max(1, s.cp)
@@ -215,7 +231,13 @@ def layer_time_cost(
     coefficient."""
     dp = world // (pp * s.tp * s.cp)
     local_bsz = global_bsz / dp / max(1, s.cp)
-    fwd = lt.fwd_ms_per_sample * local_bsz / s.tp
+    # expert compute (≈ the expert param fraction of layer FLOPs) divides by
+    # ep on top of tp; the dense remainder divides by tp only
+    frac = lt.moe_expert_param_fraction
+    per_sample = lt.fwd_ms_per_sample * (
+        (1.0 - frac) / s.tp + frac / (s.tp * max(1, s.ep))
+    )
+    fwd = per_sample * local_bsz
     # fwd + 2×bwd; full remat adds one fwd replay, selective replays only the
     # attention core (~1/3 of layer FLOPs at reference shapes)
     compute = fwd * (4.0 if s.ckpt == "full" else 3.33 if s.ckpt == "selective" else 3.0)
@@ -236,15 +258,27 @@ def layer_time_cost(
         cp_bw = hw.bw(s.cp, True)
         cp_ms = 2.0 * _allgather_ms(act_msg / s.cp * 2.0, s.cp, cp_bw) * s.cp
 
+    # EP: moe_a2a_mb_per_sample already covers dispatch + combine; the
+    # backward replays both, so total = 2× that volume in all-to-alls
+    # (an all-to-all moves (ep-1)/ep of the routed volume)
+    ep_ms = 0.0
+    if s.ep > 1 and lt.moe_a2a_mb_per_sample > 0:
+        a2a_msg = lt.moe_a2a_mb_per_sample * local_bsz * comm_bytes_factor
+        ep_ms = 2.0 * _allgather_ms(a2a_msg, s.ep, hw.bw(s.ep, True))
+
     # DP: grad allreduce (once per iteration); ZeRO-3 adds fwd+bwd param
-    # all-gathers; ZeRO-2 reduce-scatter+all-gather ≈ allreduce volume
-    grad_msg = lt.parameter_mb / s.tp * comm_bytes_factor * 2.0  # fp32 grads
+    # all-gathers; ZeRO-2 reduce-scatter+all-gather ≈ allreduce volume.
+    # Expert grads reduce only over the dp/ep extent that replicates them.
+    dense_mb = lt.parameter_mb * (1.0 - frac) / s.tp
+    exp_mb = lt.parameter_mb * frac / (s.tp * max(1, s.ep))
+    dp_exp = max(1, dp // max(1, s.ep))
     dp_consec = not s.tp_consec if s.tp > 1 else True
     dp_bw = hw.bw(dp, dp_consec)
-    dp_ms = _allreduce_ms(grad_msg, dp, dp_bw)
+    dp_ms = _allreduce_ms(dense_mb * comm_bytes_factor * 2.0, dp, dp_bw)
+    dp_ms += _allreduce_ms(exp_mb * comm_bytes_factor * 2.0, dp_exp, dp_bw)
     if s.dp_type == "zero3":
-        param_msg = lt.parameter_mb / s.tp * comm_bytes_factor
-        dp_ms += 2.0 * _allgather_ms(param_msg, dp, dp_bw)
+        dp_ms += 2.0 * _allgather_ms(dense_mb * comm_bytes_factor, dp, dp_bw)
+        dp_ms += 2.0 * _allgather_ms(exp_mb * comm_bytes_factor, dp_exp, dp_bw)
 
     # overlap model: DP traffic overlaps compute at a slowdown coefficient
     # (reference bct_dp_overlap, cost_model.py:230-246)
@@ -254,7 +288,7 @@ def layer_time_cost(
         overlapped = hw.overlap_coe * compute
     else:
         overlapped = hw.overlap_coe * compute + (dp_ms - compute)
-    return overlapped + tp_ms + cp_ms
+    return overlapped + tp_ms + cp_ms + ep_ms
 
 
 def pipeline_time_cost(

@@ -48,6 +48,14 @@ class SearchSpace:
     allow_zero3: bool = True
     allow_strided: bool = True
     allow_cp: bool = False
+    # expert parallelism as a searched dimension (MoE models; the reference
+    # carries SwitchMLP but never searches EP — SURVEY §2.3 ⚠). ep candidates
+    # ∈ powers of two up to the dp extent (and max_ep) that divide
+    # moe_experts — the runtime cannot shard E experts over a larger or
+    # non-dividing ep and would silently replicate them instead.
+    allow_ep: bool = False
+    max_ep: Optional[int] = None
+    moe_experts: int = 0  # the model's expert count (0 = dense → no ep)
     pp_choices: Optional[List[int]] = None
     pipeline_types: Tuple[str, ...] = ("gpipe", "pipedream_flush")
     # interleaved virtual stages: search vpp ∈ powers of two up to max_vpp
@@ -81,12 +89,26 @@ def generate_layer_strategies(space: SearchSpace, pp: int) -> List[LayerStrategy
         cp_opts = [1]
         if space.allow_cp and dp > 1:
             cp_opts += [c for c in _pow2s(dp) if c > 1]
-        for consec, sp, dpt, cp in itertools.product(consec_opts, sp_opts, dp_types, cp_opts):
+        ep_opts = [1]
+        if space.allow_ep and dp > 1 and space.moe_experts > 0:
+            ep_opts += [
+                e for e in _pow2s(dp)
+                if e > 1
+                and (space.max_ep is None or e <= space.max_ep)
+                and space.moe_experts % e == 0
+            ]
+        for consec, sp, dpt, cp, ep in itertools.product(
+            consec_opts, sp_opts, dp_types, cp_opts, ep_opts
+        ):
             if cp > 1 and sp:
+                continue
+            if cp > 1 and ep > 1:  # they share mesh axes (strategy.validate)
                 continue
             for ckpt in [False, True] if space.allow_ckpt else [False]:
                 out.append(
-                    LayerStrategy(tp=tp, tp_consec=consec, dp_type=dpt, ckpt=ckpt, sp=sp, cp=cp)
+                    LayerStrategy(
+                        tp=tp, tp_consec=consec, dp_type=dpt, ckpt=ckpt, sp=sp, cp=cp, ep=ep
+                    )
                 )
     return out
 

@@ -158,6 +158,14 @@ def analytic_model_costs(
     # logits dominate "other" activation
     other_act = S * cfg.vocab_size * b / 1e6
     other_flops = 2.0 * cfg.hidden_size * cfg.vocab_size * S
+    # MoE: expert-stack fraction of the layer (shardable by ep) and the token
+    # dispatch+combine all-to-all volume — one (S, h) activation each way
+    frac = 0.0
+    a2a = 0.0
+    if cfg.moe_experts > 0:
+        exp_params = cfg.moe_experts * 3 * cfg.hidden_size * cfg.ffn
+        frac = exp_params / p_layer
+        a2a = 2.0 * S * cfg.hidden_size * b / 1e6
     return ProfiledModelCosts(
         layer_types={
             0: ProfiledLayerType(
@@ -165,6 +173,8 @@ def analytic_model_costs(
                 parameter_mb=p_layer * 4 / 1e6,
                 activation_mb_per_sample=act,
                 boundary_activation_mb_per_sample=S * cfg.hidden_size * b / 1e6,
+                moe_expert_param_fraction=frac,
+                moe_a2a_mb_per_sample=a2a,
             )
         },
         other_param_mb=other_p * 4 / 1e6,

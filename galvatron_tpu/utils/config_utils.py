@@ -63,6 +63,8 @@ def save_profiled_model(costs: ProfiledModelCosts, time_path=None, mem_path=None
                     str(k): v for k, v in lt.activation_mb_per_sample.items()
                 },
                 "boundary_activation_mb_per_sample": lt.boundary_activation_mb_per_sample,
+                "moe_expert_param_fraction": lt.moe_expert_param_fraction,
+                "moe_a2a_mb_per_sample": lt.moe_a2a_mb_per_sample,
             }
         mem["other"] = {
             "param_mb": costs.other_param_mb,
@@ -87,6 +89,8 @@ def load_profiled_model(time_path: str, mem_path: str) -> ProfiledModelCosts:
                 int(k): float(v) for k, v in m["activation_mb_per_sample"].items()
             },
             boundary_activation_mb_per_sample=float(m["boundary_activation_mb_per_sample"]),
+            moe_expert_param_fraction=float(m.get("moe_expert_param_fraction", 0.0)),
+            moe_a2a_mb_per_sample=float(m.get("moe_a2a_mb_per_sample", 0.0)),
         )
     other = mem.get("other", {})
     other_ms = times.get("other", other.get("fwd_ms_per_sample", 0.0))

@@ -89,6 +89,87 @@ def test_moe_model_forward():
     assert annots["layers"][0]["mlp"]["w1"] == ("ep", "fsdp", "tp")
 
 
+def test_ep_searchable_dimension():
+    """EP is a searched dimension for MoE models (the reference carries
+    SwitchMLP but never searches EP — SURVEY §2.3): the strategy space emits
+    ep variants, the cost model rewards expert sharding, and the searched
+    config trains through the hybrid runtime."""
+    from galvatron_tpu.core.optim import AdamConfig
+    from galvatron_tpu.parallel.hybrid import build_runtime
+    from galvatron_tpu.search.cost_model import (
+        ProfiledHardware,
+        layer_memory_cost,
+        layer_time_cost,
+    )
+    from galvatron_tpu.search.search_engine import (
+        SearchEngine,
+        SearchSpace,
+        generate_layer_strategies,
+    )
+    from galvatron_tpu.search.theoretical import analytic_model_costs
+
+    cfg = small_moe_cfg()
+    space = SearchSpace(
+        world_size=8, max_tp=2, allow_ep=True, moe_experts=cfg.moe_experts,
+        pp_choices=[1],
+    )
+    cands = generate_layer_strategies(space, pp=1)
+    eps = {s.ep for s in cands}
+    assert {1, 2, 4}.issubset(eps)
+    # ep must divide the expert count — ep=8 over 4 experts would silently
+    # replicate in the runtime, so the search must never propose it
+    assert 8 not in eps
+    assert all(not (s.cp > 1 and s.ep > 1) for s in cands)
+    # dense model (moe_experts=0): no ep candidates even with allow_ep
+    dense = generate_layer_strategies(
+        SearchSpace(world_size=8, max_tp=2, allow_ep=True, pp_choices=[1]), pp=1
+    )
+    assert {s.ep for s in dense} == {1}
+
+    costs = analytic_model_costs(cfg, mixed_precision="bf16")
+    lt = costs.layer_types[0]
+    assert 0.5 < lt.moe_expert_param_fraction < 1.0
+    assert lt.moe_a2a_mb_per_sample > 0
+    # expert sharding must cut model-state memory and compute time
+    m1 = layer_memory_cost(lt, LayerStrategy(tp=1), 8, 1, 8)
+    m4 = layer_memory_cost(lt, LayerStrategy(tp=1, ep=4), 8, 1, 8)
+    assert m4.states_mb < m1.states_mb
+    hw = ProfiledHardware(allreduce_bw={"4_1": 1000.0, "8_1": 1000.0}, overlap_coe=1.0)
+    t1 = layer_time_cost(lt, LayerStrategy(tp=1), hw, 8, 1, 8)
+    t4 = layer_time_cost(lt, LayerStrategy(tp=1, ep=4), hw, 8, 1, 8)
+    assert t4 < t1  # fast interconnect: expert-compute split dominates a2a
+
+    eng = SearchEngine(
+        costs, hw, num_layers=cfg.num_layers, space=space, memory_budget_mb=4096.0
+    )
+    res = eng.search([8], max_chunks=1)
+    assert res is not None
+    rt = build_runtime(
+        cfg, res.config, adam=AdamConfig(lr=1e-3), global_batch_size=8, seq_len=16
+    )
+    state = rt.init_state(jax.random.key(0))
+    batch = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 17)), jnp.int32
+    )
+    state, loss = rt.train_step(state, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_moe_profile_roundtrip_keeps_ep_fields(tmp_path):
+    """The profiled-JSON path (the CLI default) must carry the MoE fields —
+    otherwise --enable_ep silently costs every ep identically."""
+    from galvatron_tpu.search.theoretical import analytic_model_costs
+    from galvatron_tpu.utils.config_utils import load_profiled_model, save_profiled_model
+
+    costs = analytic_model_costs(small_moe_cfg(), mixed_precision="bf16")
+    tp, mp = str(tmp_path / "time.json"), str(tmp_path / "mem.json")
+    save_profiled_model(costs, time_path=tp, mem_path=mp)
+    loaded = load_profiled_model(tp, mp)
+    lt0, lt1 = costs.layer_types[0], loaded.layer_types[0]
+    assert lt1.moe_expert_param_fraction == pytest.approx(lt0.moe_expert_param_fraction)
+    assert lt1.moe_a2a_mb_per_sample == pytest.approx(lt0.moe_a2a_mb_per_sample)
+
+
 def test_moe_expert_parallel_train_step():
     """One hybrid train step with experts sharded over EP axes on the 8-dev
     CPU mesh: tp=2 × ep=2 (× dp=2 left over)."""
