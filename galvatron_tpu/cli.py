@@ -135,6 +135,12 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
     if mode == "profile":
         ns = initialize_galvatron("profile", rest, model_default)
         cfg = model_config_from_args(ns)
+        # same attention auto-resolution as the trainer: profile the kernel
+        # the training run will actually use (flash on accelerators — the xla
+        # path materializes (heads, S, S) fp32 probs and OOMs at real shapes)
+        from galvatron_tpu.core.arguments import resolve_attn_impl
+
+        cfg = resolve_attn_impl(cfg, ns)
         from galvatron_tpu.profiling.model import profile_model
 
         prefix = ns.output_prefix or f"profile_{ns.model_size}"

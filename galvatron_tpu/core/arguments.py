@@ -246,6 +246,20 @@ def model_config_from_args(ns: argparse.Namespace):
     return cfg
 
 
+def resolve_attn_impl(cfg, ns: argparse.Namespace):
+    """Apply --attn_impl to the model config; 'auto' = flash on accelerators,
+    the model's own default on CPU. One rule shared by the trainer and the
+    profiler so the profiled kernel is always the kernel training uses."""
+    import jax
+
+    impl = getattr(ns, "attn_impl", "auto")
+    if impl != "auto":
+        return cfg.replace(attn_impl=impl)
+    if jax.default_backend() != "cpu":
+        return cfg.replace(attn_impl="flash")
+    return cfg
+
+
 def hybrid_config_from_args(ns: argparse.Namespace, num_layers: int, world: int):
     """GLOBAL-flags → uniform strategy, or JSON file → per-layer strategies
     (reference: the two config modes of get_hybrid_parallel_configs_api,

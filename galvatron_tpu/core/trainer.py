@@ -36,10 +36,9 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
         # (site_package/megatron/initialize.py _initialize_distributed)
         jax.distributed.initialize()
     cfg = model_config_from_args(ns)
-    if ns.attn_impl != "auto":
-        cfg = cfg.replace(attn_impl=ns.attn_impl)
-    elif jax.default_backend() != "cpu":
-        cfg = cfg.replace(attn_impl="flash")
+    from galvatron_tpu.core.arguments import resolve_attn_impl
+
+    cfg = resolve_attn_impl(cfg, ns)
     world = len(jax.devices())
     hp = hybrid_config_from_args(ns, cfg.total_layers, world)
     lr_schedule = None

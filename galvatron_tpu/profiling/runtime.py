@@ -75,8 +75,8 @@ class RuntimeProfiler:
         if predicted_ms is not None and np.isfinite(tp["iter_ms"]):
             fidelity = predicted_ms / tp["iter_ms"]
             lines.append(
-                f"cost-model fidelity: predicted {predicted_ms:.2f} ms / measured "
-                f"{tp['iter_ms']:.2f} ms = {fidelity:.3f}"
+                f"cost-model fidelity: predicted {predicted_ms:.4g} ms / measured "
+                f"{tp['iter_ms']:.4g} ms = {fidelity:.3f}"
             )
         mem = self.memory_stats()
         if mem:
