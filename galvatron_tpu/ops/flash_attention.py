@@ -28,6 +28,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+LOG2E = 1.4426950408889634  # log2(e)
+LN2 = 0.6931471805599453  # 1/log2(e)
 
 
 def _use_interpret() -> bool:
@@ -67,6 +69,20 @@ def _rope_io(rope, block_q: int, block_k: int, d: int, qk_order: str):
     return [qrow, qrow, krow, krow], [cos, sin, cos, sin]
 
 
+def _dispatch_causal(causal, contributes, fully_below, accum):
+    """Run ``accum(masked)`` under the right predicate. Causal blocks fully
+    below the diagonal skip the mask arithmetic (it is a no-op there — and
+    iota/where on every score element is a sizeable share of a VPU-bound
+    kernel); diagonal-straddling blocks apply it; non-causal blocks always
+    run unmasked. ``fully_below`` implies ``contributes``, so the two
+    branches are disjoint and exhaustive over contributing blocks."""
+    if not causal:
+        accum(False)
+        return
+    pl.when(fully_below)(lambda: accum(False))
+    pl.when(contributes & jnp.logical_not(fully_below))(lambda: accum(True))
+
+
 # ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
@@ -93,17 +109,21 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks, rope):
     if causal:
         last_j = jnp.minimum(((i + 1) * block_q - 1) // block_k, num_k_blocks - 1)
         contributes = ((i + 1) * block_q - 1) >= j * block_k
+        # every row >= every col: min row i*bq, max col (j+1)*bk - 1
+        fully_below = (i * block_q) >= ((j + 1) * block_k - 1)
     else:
         last_j = num_k_blocks - 1
-        contributes = jnp.bool_(True)
+        contributes = fully_below = None
 
-    @pl.when(contributes)
-    def _compute():
+    def _accum(masked):
         # keep q/k/v in their storage dtype (bf16): fp32 MXU matmul runs at a
         # fraction of the bf16 rate; accumulation stays fp32 via
         # preferred_element_type, softmax math stays fp32. RoPE (when fused)
         # rotates the VMEM-resident blocks — the roped q/k never round-trip
-        # through HBM.
+        # through HBM. The softmax scale folds the exp→exp2 base change into
+        # its (single, fp32, post-matmul) multiply: the running max lives in
+        # base-2 units and exp2 replaces exp. Scaling q instead would save
+        # that multiply but requantizes q to bf16, doubling the output error.
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
@@ -112,15 +132,15 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks, rope):
             k = _rope_rows(k, ck_ref[...], sk_ref[...]).astype(k_ref.dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale  # (block_q, block_k)
-        if causal:
+        ) * (sm_scale * LOG2E)  # (block_q, block_k), base-2 logits
+        if masked:
             rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
         m_old = m_scr[:, :1]  # (block_q, 1), lanes replicated
         m_new = jnp.maximum(m_old, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_old - m_new)
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m_old - m_new)
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = alpha * acc_scr[:] + jax.lax.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32
@@ -128,13 +148,17 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks, rope):
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
+    _dispatch_causal(causal, contributes, fully_below, _accum)
+
     @pl.when(j == last_j)
     def _finalize():
         l = l_scr[:, :1]
         o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_scr[:, :1] + jnp.log(jnp.maximum(l, 1e-30))).astype(
-            jnp.float32
-        )
+        # running max is in base-2 units; emit the natural-log LSE the
+        # backward (and ring-attention combining) expects
+        lse_ref[0, 0] = (
+            m_scr[:, :1] * LN2 + jnp.log(jnp.maximum(l, 1e-30))
+        ).astype(jnp.float32)
 
 
 def _flash_fwd(q, k, v, rope, sm_scale, causal, block_q, block_k, interpret):
@@ -203,13 +227,17 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks, rop
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    contributes = (
-        ((i + 1) * block_q - 1) >= j * block_k if causal else jnp.bool_(True)
-    )
+    if causal:
+        contributes = ((i + 1) * block_q - 1) >= j * block_k
+        fully_below = (i * block_q) >= ((j + 1) * block_k - 1)
+    else:
+        contributes = fully_below = None
 
-    @pl.when(contributes)
-    def _compute():
-        # bf16 MXU inputs, fp32 accumulate/softmax (see _fwd_kernel note)
+    def _accum(masked):
+        # bf16 MXU inputs, fp32 accumulate/softmax, base-2 logits with the
+        # base change folded into the fp32 post-matmul scale (see _fwd_kernel
+        # note). ds omits the sm_scale factor; the dk finalize multiplies it
+        # back in once per k block.
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
@@ -217,16 +245,16 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks, rop
         if rope:
             q = _rope_rows(q, cq_ref[...], sq_ref[...]).astype(q_ref.dtype)
             k = _rope_rows(k, ck_ref[...], sk_ref[...]).astype(k_ref.dtype)
-        lse = lse_ref[0, 0].astype(jnp.float32)  # (block_q, 1)
+        lse2 = lse_ref[0, 0].astype(jnp.float32) * LOG2E  # (block_q, 1), base-2
         delta = delta_ref[0, 0].astype(jnp.float32)  # (block_q, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale
-        if causal:
+        ) * (sm_scale * LOG2E)
+        if masked:
             rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)  # softmax probs
+        p = jnp.exp2(s - lse2)  # softmax probs
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -234,15 +262,17 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks, rop
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * sm_scale
+        ds = p * (dp - delta)  # natural-units dL/ds except the sm_scale factor
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
+    _dispatch_causal(causal, contributes, fully_below, _accum)
+
     @pl.when(i == num_q_blocks - 1)
     def _finalize():
-        dk = dk_scr[:]
+        dk = dk_scr[:] * sm_scale  # ds omitted sm_scale in the accumulation
         if rope:
             # dk was accumulated w.r.t. the ROPED k — counter-rotate back
             dk = _rope_rows_t(dk, ck_ref[...], sk_ref[...])
@@ -266,13 +296,16 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks, rope
     if causal:
         last_j = jnp.minimum(((i + 1) * block_q - 1) // block_k, num_k_blocks - 1)
         contributes = ((i + 1) * block_q - 1) >= j * block_k
+        fully_below = (i * block_q) >= ((j + 1) * block_k - 1)
     else:
         last_j = num_k_blocks - 1
-        contributes = jnp.bool_(True)
+        contributes = fully_below = None
 
-    @pl.when(contributes)
-    def _compute():
-        # bf16 MXU inputs, fp32 accumulate/softmax (see _fwd_kernel note)
+    def _accum(masked):
+        # bf16 MXU inputs, fp32 accumulate/softmax, base-2 logits with the
+        # base change folded into the fp32 post-matmul scale (see _fwd_kernel
+        # note). ds omits the sm_scale factor; the finalize multiplies it
+        # back in once per q block.
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
@@ -280,27 +313,29 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks, rope
         if rope:
             q = _rope_rows(q, cq_ref[...], sq_ref[...]).astype(q_ref.dtype)
             k = _rope_rows(k, ck_ref[...], sk_ref[...]).astype(k_ref.dtype)
-        lse = lse_ref[0, 0].astype(jnp.float32)  # (block_q, 1)
+        lse2 = lse_ref[0, 0].astype(jnp.float32) * LOG2E  # (block_q, 1), base-2
         delta = delta_ref[0, 0].astype(jnp.float32)  # (block_q, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale
-        if causal:
+        ) * (sm_scale * LOG2E)
+        if masked:
             rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        p = jnp.exp2(s - lse2)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * sm_scale
+        ds = p * (dp - delta)
         dq_scr[:] += jax.lax.dot(
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32
         )
 
+    _dispatch_causal(causal, contributes, fully_below, _accum)
+
     @pl.when(j == last_j)
     def _finalize():
-        dq = dq_scr[:]
+        dq = dq_scr[:] * sm_scale  # ds omitted sm_scale in the accumulation
         if rope:
             # dq was accumulated w.r.t. the ROPED q — counter-rotate back
             dq = _rope_rows_t(dq, cq_ref[...], sq_ref[...])
