@@ -40,7 +40,12 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
 
     if mode == "search":
         ns = initialize_galvatron("search", rest, model_default)
-        cfg = model_config_from_args(ns)
+        from galvatron_tpu.core.arguments import resolve_execution_config
+
+        # profile the exact execution config the training run will use
+        # (kernel + dtype) — otherwise predicted-vs-measured fidelity is
+        # broken by construction
+        cfg = resolve_execution_config(model_config_from_args(ns), ns)
         from galvatron_tpu.profiling.model import profile_model
         from galvatron_tpu.search.cost_model import ProfiledHardware
         from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
@@ -103,7 +108,7 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         eng = SearchEngine(
             costs, hw, num_layers=cfg.total_layers, space=sspace,
             memory_budget_mb=ns.memory_constraint_gb * 1024.0,
-            mixed_precision="bf16",
+            mixed_precision=ns.mixed_precision,
         )
         if ns.check_cost_model:
             bsz = ns.settle_bsz if ns.settle_bsz > 0 else ns.min_bsz
@@ -135,12 +140,13 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
     if mode == "profile":
         ns = initialize_galvatron("profile", rest, model_default)
         cfg = model_config_from_args(ns)
-        # same attention auto-resolution as the trainer: profile the kernel
-        # the training run will actually use (flash on accelerators — the xla
-        # path materializes (heads, S, S) fp32 probs and OOMs at real shapes)
-        from galvatron_tpu.core.arguments import resolve_attn_impl
+        # same attention + dtype resolution as the trainer: profile the
+        # program the training run will actually use (flash on accelerators —
+        # the xla path materializes (heads, S, S) fp32 probs and OOMs at real
+        # shapes; fp32 compute would overstate bf16 layer times ~2x)
+        from galvatron_tpu.core.arguments import resolve_execution_config
 
-        cfg = resolve_attn_impl(cfg, ns)
+        cfg = resolve_execution_config(cfg, ns)
         from galvatron_tpu.profiling.model import profile_model
 
         prefix = ns.output_prefix or f"profile_{ns.model_size}"

@@ -146,6 +146,12 @@ def _add_search_args(p: argparse.ArgumentParser):
     g.add_argument("--memory_profile_path", type=str, default=None)
     g.add_argument("--hardware_profile_path", type=str, default=None)
     g.add_argument("--output_config_path", type=str, default=None)
+    # execution config for the in-process profile + cost model: must match
+    # what the training run will use (resolve_execution_config)
+    g.add_argument("--mixed_precision", type=str, default="bf16",
+                   choices=["fp32", "fp16", "bf16"])
+    g.add_argument("--attn_impl", type=str, default="auto",
+                   choices=["auto", "flash", "xla"])
 
 
 def _add_profile_args(p: argparse.ArgumentParser):
@@ -157,6 +163,8 @@ def _add_profile_args(p: argparse.ArgumentParser):
     g.add_argument("--layernum_min", type=int, default=2)
     g.add_argument("--layernum_max", type=int, default=4)
     g.add_argument("--output_prefix", type=str, default=None)
+    # (--mixed_precision / --attn_impl come from the training group, which the
+    # profile parser includes — build_parser)
 
 
 def _add_generate_args(p: argparse.ArgumentParser):
@@ -257,6 +265,22 @@ def resolve_attn_impl(cfg, ns: argparse.Namespace):
         return cfg.replace(attn_impl=impl)
     if jax.default_backend() != "cpu":
         return cfg.replace(attn_impl="flash")
+    return cfg
+
+
+def resolve_execution_config(cfg, ns: argparse.Namespace):
+    """Attention kernel + compute dtype from the flags — the single rule the
+    trainer, the model profiler, and the search engine's in-process profiling
+    all share, so the profiled program is the program training runs (the
+    reference guarantees this by profiling through train_dist.py itself,
+    core/profiler.py:194-240)."""
+    import jax.numpy as jnp
+
+    cfg = resolve_attn_impl(cfg, ns)
+    mp = getattr(ns, "mixed_precision", None)
+    if mp:
+        dt = {"bf16": jnp.bfloat16, "fp16": jnp.float16, "fp32": jnp.float32}[mp]
+        cfg = cfg.replace(dtype=dt)
     return cfg
 
 

@@ -98,7 +98,12 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
     from galvatron_tpu.core.signals import GracefulExitHandler
     from galvatron_tpu.utils.metrics import MetricsLogger
 
-    prof = RuntimeProfiler(warmup_iters=1)
+    # per-iter host syncs (float(loss) every step) serialize dispatch with
+    # device compute; only sync each iteration when the user asked for
+    # per-iter observables (loss curves, per-iter metrics). Otherwise let
+    # dispatch run free and time a window (TPU-idiomatic async training).
+    sync_each = bool(ns.check_loss or getattr(ns, "metrics_path", None))
+    prof = RuntimeProfiler(warmup_iters=1, windowed=not sync_each)
     losses = []
     # consumed-samples bookkeeping: under rampup, replay the schedule from
     # step 0 so a resumed run sees exactly the sizes (and per-size stream
@@ -139,8 +144,12 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
             batch = rt.shard_batch(next(loader))
             prof.begin_iter()
             state, loss = rt.train_step(state, batch)
-            prof.end_iter(loss if (ns.profile or ns.check_loss) else None)
-            if ns.check_loss or ns.profile:
+            # always hand end_iter the loss: per-iter mode syncs each step
+            # (sync_each implies that's wanted); windowed mode syncs ONCE, to
+            # close the warmup — without it the window would open while
+            # warmup compute is still in flight and overstate avg iter time
+            prof.end_iter(loss)
+            if sync_each:
                 losses.append(float(loss))
                 if verbose:
                     print(f"iter {it}: loss {float(loss):.4f}")
@@ -153,6 +162,7 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
                 save_checkpoint(ns.save, state, it + 1)
                 if verbose:
                     print(f"saved step {it + 1} → {ns.save}")
+    prof.finish(loss if iters_run else None)
     # checkpoint on exit — normal completion or signal (the reference's
     # dist_signal_handler checkpoint-then-exit pattern, there unused)
     if ns.save:

@@ -161,7 +161,10 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks, rope):
         ).astype(jnp.float32)
 
 
-def _flash_fwd(q, k, v, rope, sm_scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, rope, sm_scale, causal, block_q, block_k, interpret,
+               out_dtype=None):
+    """``out_dtype`` overrides the output dtype (ring attention asks for fp32
+    so per-hop block outputs are not requantized before the lse recombine)."""
     b, h, s, d = q.shape
     nq, nk = s // block_q, s // block_k
     grid = (b, h, nq, nk)
@@ -191,7 +194,7 @@ def _flash_fwd(q, k, v, rope, sm_scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -344,11 +347,24 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks, rope
 
 def _flash_bwd(res, do_bhsd, sm_scale, causal, block_q, block_k, interpret):
     q, k, v, out, lse, rope = res
-    b, h, s, d = q.shape
-    nq, nk = s // block_q, s // block_k
     delta = jnp.sum(
         do_bhsd.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
     )  # (b, h, s, 1)
+    return _flash_bwd_parts(
+        q, k, v, do_bhsd, lse, delta, rope, sm_scale, causal, block_q, block_k,
+        interpret,
+    )
+
+
+def _flash_bwd_parts(
+    q, k, v, do_bhsd, lse, delta, rope, sm_scale, causal, block_q, block_k, interpret
+):
+    """dq/dk/dv kernels given the (possibly GLOBAL, e.g. ring-combined) LSE
+    and delta = sum(do*out) — the flash decomposition makes per-k-block
+    gradient contributions independent once those per-row statistics are
+    fixed, which is what lets ring attention run these kernels per ring hop."""
+    b, h, s, d = q.shape
+    nq, nk = s // block_q, s // block_k
 
     qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, j, i: (b_, h_, i, 0))
     kspec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0))

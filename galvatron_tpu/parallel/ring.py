@@ -10,6 +10,19 @@ activation memory per device, exact causal attention.
 Schedule: step 0 attends to the local (diagonal) K/V block, so the running
 max starts finite; later steps mask by global position (blocks entirely in
 the future contribute exp(-inf - m) = 0, never NaN).
+
+Two per-hop compute paths:
+
+- **Pallas flash blocks** (``_ring_flash``, the default when the local
+  sequence tiles): each hop runs the flash-attention forward kernel on the
+  resident K/V block (causal on the diagonal hop, unmasked on past hops,
+  skipped on future hops) and folds the block's normalized output into a
+  running (max, sum, acc) via its log-sum-exp. The backward is a second ring
+  pass over the flash dq/dkv kernels with the GLOBAL lse/delta — the flash
+  decomposition makes per-block gradient contributions independent once the
+  per-row statistics are fixed; dk/dv accumulators rotate with their K/V
+  block and arrive home after cp hops.
+- **einsum fallback** (``_ring_attn_local``) for shapes that don't tile.
 """
 
 from __future__ import annotations
@@ -24,6 +37,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.ops.flash_attention import (
+    _flash_bwd_parts,
+    _flash_fwd,
+    _use_interpret,
+)
 
 NEG_INF = -1e30
 
@@ -38,9 +56,8 @@ def _ring_attn_local(q, k, v, axis_name: str, cp: int, sm_scale: float):
     q32 = q.astype(jnp.float32)
     rows = idx * s_local + jnp.arange(s_local)  # global q positions
 
-    def step(carry, step_idx):
-        k_cur, v_cur, m, l, acc = carry
-        owner = (idx - step_idx) % cp  # whose kv block we currently hold
+    def accum(carry, k_cur, v_cur, owner):
+        m, l, acc = carry
         cols = owner * s_local + jnp.arange(s_local)
         scores = (
             jnp.einsum("bqnh,bknh->bnqk", q32, k_cur.astype(jnp.float32)) * sm_scale
@@ -54,32 +71,208 @@ def _ring_attn_local(q, k, v, axis_name: str, cp: int, sm_scale: float):
         acc_new = alpha[..., None] * acc + jnp.einsum(
             "bnqk,bknh->bnqh", p, v_cur.astype(jnp.float32)
         )
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+        return m_new, l_new, acc_new
 
     b, _, n, d = q.shape
     m0 = jnp.full((b, n, s_local), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, n, s_local), jnp.float32)
     acc0 = jnp.zeros((b, n, s_local, d), jnp.float32)
-    (k, v, m, l, acc), _ = jax.lax.scan(step, (k, v, m0, l0, acc0), jnp.arange(cp))
+    # hop 0: the local (diagonal) block — no rotation needed; scan steps
+    # permute first, then compute, so no hop rotates K/V just to discard it
+    carry0 = accum((m0, l0, acc0), k, v, idx)
+
+    def step(carry, step_idx):
+        k_cur, v_cur, mla = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        owner = (idx - step_idx) % cp  # whose kv block we now hold
+        return (k_cur, v_cur, accum(mla, k_cur, v_cur, owner)), None
+
+    (_, _, (m, l, acc)), _ = jax.lax.scan(step, (k, v, carry0), jnp.arange(1, cp))
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # (B, S/cp, n, d)
+
+
+# ---------------------------------------------------------------------------
+# Flash-block ring (Pallas kernels per hop, custom VJP)
+# ---------------------------------------------------------------------------
+
+
+def _ring_block(is_past, q, k_cur, v_cur, sm_scale, block_q, block_k, interpret):
+    """(fp32 out, lse) of q against a non-diagonal resident K/V block:
+    unmasked when the block is in the past, nothing (lse = -inf) when it is
+    in the future. The diagonal (locally causal) hop runs outside the scan."""
+
+    def past(q, k_, v_):
+        return _flash_fwd(
+            q, k_, v_, None, sm_scale, False, block_q, block_k, interpret,
+            out_dtype=jnp.float32,
+        )
+
+    def future(q, k_, v_):
+        b, h, s, _ = q.shape
+        return (
+            jnp.zeros(q.shape, jnp.float32),
+            jnp.full((b, h, s, 1), NEG_INF, jnp.float32),
+        )
+
+    return jax.lax.cond(is_past, past, future, q, k_cur, v_cur)
+
+
+def _lse_combine(m, l, acc, o_b, lse_b):
+    """Fold a block's normalized output into the running (max, sum, acc):
+    o_b's unnormalized row sum is exp(lse_b), so blocks combine by lse like
+    partial softmaxes."""
+    m_new = jnp.maximum(m, lse_b)
+    alpha = jnp.exp(m - m_new)
+    w_b = jnp.exp(lse_b - m_new)
+    return m_new, l * alpha + w_b, acc * alpha + o_b * w_b
+
+
+def _ring_flash_fwd(q, k, v, axis_name, cp, sm_scale, block_q, block_k, interpret):
+    """q/k/v local (B, n, S/cp, d). Returns (out, global lse).
+
+    Hop 0 (the diagonal, locally causal block) runs before the scan; each
+    scan step permutes K/V first and then computes, so no hop rotates K/V
+    only to discard the result."""
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+    b, h, s, d = q.shape
+
+    o0, lse0 = _flash_fwd(
+        q, k, v, None, sm_scale, True, block_q, block_k, interpret,
+        out_dtype=jnp.float32,
+    )
+    m0 = jnp.full((b, h, s, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, s, d), jnp.float32)
+    m0, l0, acc0 = _lse_combine(m0, l0, acc0, o0, lse0)
+
+    def step(carry, step_idx):
+        k_cur, v_cur, m, l, acc = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        owner = (idx - step_idx) % cp
+        o_b, lse_b = _ring_block(
+            owner < idx, q, k_cur, v_cur, sm_scale, block_q, block_k, interpret
+        )
+        m, l, acc = _lse_combine(m, l, acc, o_b, lse_b)
+        return (k_cur, v_cur, m, l, acc), None
+
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(1, cp)
+    )
+    out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, cp, sm_scale, block_q, block_k, interpret):
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, cp, sm_scale, block_q, block_k, interpret)
+    return out
+
+
+def _ring_flash_fwd_rule(q, k, v, axis_name, cp, sm_scale, block_q, block_k, interpret):
+    out, lse = _ring_flash_fwd(q, k, v, axis_name, cp, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd_rule(axis_name, cp, sm_scale, block_q, block_k, interpret, res, do):
+    """Second ring pass over the flash dq/dkv kernels with the GLOBAL
+    lse/delta. Hop 0 (diagonal) runs before the scan; scan steps permute
+    first, then compute. dk/dv accumulators ride the ring with their K/V
+    block — cp-1 hops inside the scan plus one final hop lands them home."""
+    q, k, v, out, lse = res
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    def block_grads(is_past, k_cur, v_cur):
+        def past(k_, v_):
+            return _flash_bwd_parts(
+                q, k_, v_, do, lse, delta, None, sm_scale, False, block_q, block_k,
+                interpret,
+            )
+
+        def future(k_, v_):
+            return jnp.zeros_like(q), jnp.zeros_like(k_), jnp.zeros_like(v_)
+
+        return jax.lax.cond(is_past, past, future, k_cur, v_cur)
+
+    dq0, dk0, dv0 = _flash_bwd_parts(
+        q, k, v, do, lse, delta, None, sm_scale, True, block_q, block_k, interpret
+    )
+
+    def step(carry, step_idx):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+        owner = (idx - step_idx) % cp
+        dq_b, dk_b, dv_b = block_grads(owner < idx, k_cur, v_cur)
+        dq = dq + dq_b.astype(jnp.float32)
+        dk_cur = dk_cur + dk_b.astype(jnp.float32)
+        dv_cur = dv_cur + dv_b.astype(jnp.float32)
+        return (k_cur, v_cur, dk_cur, dv_cur, dq), None
+
+    (_, _, dk, dv, dq), _ = jax.lax.scan(
+        step,
+        (k, v, dk0.astype(jnp.float32), dv0.astype(jnp.float32), dq0.astype(jnp.float32)),
+        jnp.arange(1, cp),
+    )
+    dk = jax.lax.ppermute(dk, axis_name, perm)
+    dv = jax.lax.ppermute(dv, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
+
+
+def _ring_flash_local(q, k, v, axis_name: str, cp: int, sm_scale: float, block: int):
+    """shard_map body for the flash path. q/k/v local (B, S/cp, n, d)."""
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    out = _ring_flash(qt, kt, vt, axis_name, cp, sm_scale, block, block, _use_interpret())
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def _flash_block_size(s_local: int) -> int:
+    """Largest power-of-two tile <= 1024 dividing the local sequence; 0 if the
+    shape doesn't tile (callers fall back to the einsum ring)."""
+    for block in (1024, 512, 256, 128, 64, 32, 16, 8):
+        if s_local % block == 0:
+            return block
+    return 0
 
 
 def ring_attention(
     q, k, v, mesh: Mesh, cp_axes: Sequence[str], sm_scale: float | None = None
 ):
-    """q/k/v: (B, S, n, d) global arrays; sequence ring-sharded over cp_axes."""
+    """q/k/v: (B, S, n, d) global arrays; sequence ring-sharded over cp_axes.
+
+    Uses the Pallas flash kernels per ring hop when the local sequence
+    tiles; otherwise the einsum online-softmax fallback."""
     cp = int(np.prod([mesh.shape[a] for a in cp_axes]))
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
     axis = tuple(cp_axes)
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(
-        functools.partial(
+    block = _flash_block_size(q.shape[1] // cp)
+    if block:
+        local = functools.partial(
+            _ring_flash_local, axis_name=axis, cp=cp, sm_scale=sm_scale, block=block
+        )
+    else:
+        local = functools.partial(
             _ring_attn_local, axis_name=axis, cp=cp, sm_scale=sm_scale
-        ),
+        )
+    fn = jax.shard_map(
+        local,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -51,25 +51,44 @@ def other_param_count(cfg: ModelConfig) -> int:
 
 
 def _iter_time_ms(cfg: ModelConfig, bsz: int, seq: int, iters: int = 4) -> float:
-    """Wall time per training iteration of the plain single-device model
-    (the reference's train.py measurement path)."""
-    from galvatron_tpu.core.optim import adamw_update, init_opt_state
+    """Wall time per training iteration, measured through the hybrid runtime's
+    own train_step on ONE device with the trivial strategy (tp=1, ddp,
+    chunks=1). The reference profiles through its real trainer the same way
+    (train_dist.py --profile, core/profiler.py:194-240); measuring a separate
+    plain-model loop instead was ~10% slower than what training actually runs
+    (no buffer donation, different loss plumbing), which skewed the cost
+    model's basis and with it predicted-vs-measured fidelity."""
+    from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+    from galvatron_tpu.parallel.hybrid import build_runtime
+    from galvatron_tpu.parallel.mesh import build_mesh
 
-    params = modeling.init_model_params(jax.random.key(0), cfg)
-    opt = init_opt_state(params)
-    adam = AdamConfig(lr=1e-4)
-
-    @jax.jit
-    def step(params, opt, batch):
-        loss, grads = jax.value_and_grad(lambda p: modeling.lm_loss(p, batch, cfg))(params)
-        return adamw_update(params, grads, opt, adam), loss
-
-    batch = jnp.zeros((bsz, seq + 1), jnp.int32)
-    (params, opt), loss = step(params, opt, batch)  # compile
+    mesh, axes = build_mesh(pp=1, devices=jax.devices()[:1])
+    mp = {jnp.bfloat16: "bf16", jnp.float16: "fp16"}.get(cfg.dtype, "fp32")
+    hp = HybridParallelConfig(
+        pp=1,
+        layer_strategies=[LayerStrategy()] * cfg.num_layers,
+        chunks=1,
+        vocab_tp=1,
+        mixed_precision=mp,
+    )
+    if cfg.objective == "cls":
+        rt = build_runtime(
+            cfg, hp, mesh=mesh, axes=axes, adam=AdamConfig(lr=1e-4),
+            global_batch_size=bsz,
+        )
+        batch = jnp.zeros((bsz, cfg.sample_len + 1), jnp.int32)
+    else:
+        rt = build_runtime(
+            cfg, hp, mesh=mesh, axes=axes, adam=AdamConfig(lr=1e-4),
+            global_batch_size=bsz, seq_len=seq,
+        )
+        batch = jnp.zeros((bsz, seq + 1), jnp.int32)
+    state = rt.init_state(jax.random.key(0))
+    state, loss = rt.train_step(state, batch)  # compile
     _ = float(loss)
     t0 = time.perf_counter()
     for _ in range(iters):
-        (params, opt), loss = step(params, opt, batch)
+        state, loss = rt.train_step(state, batch)
     _ = float(loss)  # host sync
     return (time.perf_counter() - t0) / iters * 1000.0
 

@@ -23,22 +23,56 @@ import numpy as np
 
 @dataclass
 class RuntimeProfiler:
+    """Two timing modes:
+
+    - per-iter (``windowed=False``): host-syncs every iteration (pass the
+      loss to ``end_iter``). Exact per-iter times, but the sync serializes
+      host dispatch with device compute — measured time includes the host
+      round-trip, which on remote-dispatch setups dwarfs real step time.
+    - windowed (``windowed=True``, the trainer's default when nothing else
+      forces a per-iter sync): dispatch runs free; one sync closes the
+      warmup, one closes the window (``finish``), avg = window/iters. This
+      measures what async training actually sustains.
+    """
+
     warmup_iters: int = 2
+    windowed: bool = False
     iter_times_ms: List[float] = field(default_factory=list)
     _t0: Optional[float] = None
     _iter: int = 0
+    _window_t0: Optional[float] = None
+    _window_iters: int = 0
 
     def begin_iter(self):
         self._t0 = time.perf_counter()
 
     def end_iter(self, sync_value=None):
-        """Pass a device scalar (e.g. the loss) to force completion."""
+        """Per-iter mode: pass a device scalar (e.g. the loss) to force
+        completion. Windowed mode: syncs only to close the warmup."""
+        self._iter += 1
+        if self.windowed:
+            if self._iter == self.warmup_iters:
+                if sync_value is not None:
+                    _ = float(sync_value)
+                self._window_t0 = time.perf_counter()
+            elif self._iter > self.warmup_iters:
+                self._window_iters += 1
+            return
         if sync_value is not None:
             _ = float(sync_value)
         dt = (time.perf_counter() - self._t0) * 1000.0
-        self._iter += 1
         if self._iter > self.warmup_iters:
             self.iter_times_ms.append(dt)
+
+    def finish(self, sync_value=None):
+        """Close the measurement window (windowed mode; no-op otherwise)."""
+        if not self.windowed or self._window_t0 is None or self._window_iters == 0:
+            return
+        if sync_value is not None:
+            _ = float(sync_value)
+        avg = (time.perf_counter() - self._window_t0) * 1000.0 / self._window_iters
+        self.iter_times_ms = [avg] * self._window_iters
+        self._window_t0 = None
 
     @property
     def avg_iter_ms(self) -> float:

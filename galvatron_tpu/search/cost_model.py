@@ -252,7 +252,11 @@ def layer_time_cost(
         tp_ms *= 1.5  # full recompute replays the forward collectives
     # (selective recompute replays no TP collectives: the attention core sits
     # between the column- and row-parallel linears)
-    # CP: ring passes K/V once around per step — volume ≈ 2·(seq-sharded kv)
+    # CP: the ring rotates K/V cp-1 hops per pass (the diagonal hop is
+    # local — parallel/ring.py computes it before the scan); fwd rotates
+    # K+V, bwd rotates K+V and the homing dk/dv — ≈ 2 ring passes of
+    # 2·(seq-sharded kv) volume. _allgather_ms already carries the
+    # (cp-1)/cp hop factor, so ×cp yields 2 × (cp-1) hops × per-hop bytes.
     cp_ms = 0.0
     if s.cp > 1:
         cp_bw = hw.bw(s.cp, True)

@@ -170,6 +170,51 @@ def test_cp_layer_in_hybrid_runtime():
     np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-4)
 
 
+def test_ring_flash_block_size_selection():
+    """Ring hops run the Pallas flash kernels whenever the local sequence
+    tiles to a power of two; otherwise the einsum online-softmax fallback."""
+    from galvatron_tpu.parallel.ring import _flash_block_size
+
+    assert _flash_block_size(2048) == 1024
+    assert _flash_block_size(96) == 32
+    assert _flash_block_size(16) == 16
+    assert _flash_block_size(12) == 0  # falls back to einsum ring
+    assert _flash_block_size(7) == 0
+
+
+def test_ring_attention_einsum_fallback_matches_reference():
+    """Non-tiling local sequence (24/2 = 12) takes the einsum ring and still
+    matches the single-device reference."""
+    from galvatron_tpu.parallel.mesh import build_mesh
+    from galvatron_tpu.parallel.ring import ring_attention
+
+    mesh, axes = build_mesh(pp=1)
+    q, k, v = rand_qkv(jax.random.key(7), s=24)
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh, ("x2",)))(q, k, v)
+    ref = ref_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_flash_larger_ring_grad():
+    """cp=8 (every CPU-sim device) through the flash-block ring, fwd + grad."""
+    from galvatron_tpu.parallel.mesh import build_mesh
+    from galvatron_tpu.parallel.ring import ring_attention
+
+    mesh, axes = build_mesh(pp=1)
+    q, k, v = rand_qkv(jax.random.key(8), b=1, s=128)
+    cp_axes = ("x0", "x1", "x2")  # ring of 8; local seq 16
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh, cp_axes))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref_attention(q, k, v)), rtol=2e-5, atol=2e-5
+    )
+    g_ring = jax.jit(
+        jax.grad(lambda q, k, v: (ring_attention(q, k, v, mesh, cp_axes) ** 2).sum(), (0, 1, 2))
+    )(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: (ref_attention(q, k, v) ** 2).sum(), (0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4)
+
+
 def test_ulysses_attention_matches_reference():
     from galvatron_tpu.parallel.mesh import build_mesh
     from galvatron_tpu.parallel.ulysses import ulysses_attention
