@@ -75,6 +75,10 @@ def _add_training_args(p: argparse.ArgumentParser):
                    "bf16 is the TPU-native choice")
     g.add_argument("--check_loss", type=int, default=0)
     g.add_argument("--profile", type=int, default=0, help="print per-iter time/memory")
+    g.add_argument("--trace_dir", type=str, default=None,
+                   help="capture a jax.profiler trace of the measured "
+                   "iterations to this directory (XLA op/kernel timeline; "
+                   "the torch.profiler/CUDA-events counterpart, SURVEY §5)")
     # hybrid-parallel GLOBAL flags (used when no galvatron_config_path)
     g.add_argument("--pp_deg", type=int, default=1)
     g.add_argument("--vpp_deg", type=int, default=1,

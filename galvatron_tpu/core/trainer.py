@@ -104,6 +104,12 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
     # dispatch run free and time a window (TPU-idiomatic async training).
     sync_each = bool(ns.check_loss or getattr(ns, "metrics_path", None))
     prof = RuntimeProfiler(warmup_iters=1, windowed=not sync_each)
+    # jax.profiler trace of the training loop (op/kernel timeline viewable in
+    # TensorBoard/Perfetto) — the tracing counterpart of the reference's
+    # torch.profiler + CUDA-event instrumentation (SURVEY §5)
+    trace_dir = getattr(ns, "trace_dir", None)
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
     losses = []
     # consumed-samples bookkeeping: under rampup, replay the schedule from
     # step 0 so a resumed run sees exactly the sizes (and per-size stream
@@ -163,6 +169,10 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
                 if verbose:
                     print(f"saved step {it + 1} → {ns.save}")
     prof.finish(loss if iters_run else None)
+    if trace_dir:
+        jax.profiler.stop_trace()
+        if verbose:
+            print(f"jax.profiler trace → {trace_dir}")
     # checkpoint on exit — normal completion or signal (the reference's
     # dist_signal_handler checkpoint-then-exit pattern, there unused)
     if ns.save:
