@@ -50,7 +50,13 @@ def restore_checkpoint(ckpt_dir: str, abstract_state: Any, step: Optional[int] =
     """Restores into the shardings carried by ``abstract_state`` (a pytree of
     jax.ShapeDtypeStruct with .sharding — e.g. from eval_shape + the runtime's
     state_shardings). Cross-strategy resume falls out: Orbax reshards on
-    load."""
+    load.
+
+    Layout note: the blocked fused-QKV change (models/modeling.py:qkv_dims)
+    made MHA ``wqkv`` leaves rank-3; a checkpoint written by the earlier
+    interleaved-only code no longer restores, and a silent reshape would
+    scramble q/k/v (the interleave is per head-group, not per slot). Such a
+    restore fails with an explicit migration error instead."""
     ocp = _ocp()
     if step is None:
         step = latest_step(ckpt_dir)
@@ -58,7 +64,28 @@ def restore_checkpoint(ckpt_dir: str, abstract_state: Any, step: Optional[int] =
             raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
     ckptr = ocp.StandardCheckpointer()
-    return ckptr.restore(path, abstract_state)
+    try:
+        return ckptr.restore(path, abstract_state)
+    except Exception as e:
+        if _has_legacy_qkv_mismatch(abstract_state, str(e)):
+            raise ValueError(
+                "checkpoint predates the blocked fused-QKV weight layout "
+                "(wqkv is now (h, 3, n*head_dim) for non-GQA models): "
+                "re-export it by loading with the producing revision and "
+                "re-saving, e.g. transpose each wqkv from (h, n, 3, head_dim) "
+                "column order to (h, 3, n*head_dim)"
+            ) from e
+        raise
+
+
+def _has_legacy_qkv_mismatch(abstract_state: Any, err: str) -> bool:
+    flat, _ = jax.tree_util.tree_flatten_with_path(abstract_state)
+    has_blocked = any(
+        any(getattr(k, "key", None) == "wqkv" for k in kp)
+        and hasattr(leaf, "shape") and len(leaf.shape) >= 3
+        for kp, leaf in flat
+    )
+    return has_blocked and ("shape" in err.lower() or "rank" in err.lower())
 
 
 def abstract_state_of(runtime, init_key=None) -> Any:

@@ -106,10 +106,10 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
     prof = RuntimeProfiler(warmup_iters=1, windowed=not sync_each)
     # jax.profiler trace of the training loop (op/kernel timeline viewable in
     # TensorBoard/Perfetto) — the tracing counterpart of the reference's
-    # torch.profiler + CUDA-event instrumentation (SURVEY §5)
+    # torch.profiler + CUDA-event instrumentation (SURVEY §5). Started after
+    # the warmup iteration so compile/warmup spans don't drown the timeline.
     trace_dir = getattr(ns, "trace_dir", None)
-    if trace_dir:
-        jax.profiler.start_trace(trace_dir)
+    trace_started = False
     losses = []
     # consumed-samples bookkeeping: under rampup, replay the schedule from
     # step 0 so a resumed run sees exactly the sizes (and per-size stream
@@ -127,52 +127,62 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
     cur_bs = ns.global_train_batch_size
     metrics = MetricsLogger(getattr(ns, "metrics_path", None))
     iters_run = 0
-    with GracefulExitHandler() as exit_handler:
-        for it in range(start_step, ns.train_iters):
-            if exit_handler.signaled is not None:
-                if verbose:
-                    print(f"signal {exit_handler.signaled} received; stopping at iter {it}")
-                break
-            if rampup is not None:
-                bs = rampup(consumed)
-                if bs != cur_bs or it == start_step:
-                    cur_bs = bs
-                    loader = build_dataloader(
-                        cfg, bs, seq, seed=ns.seed + bs,
-                        start_batch=batches_at_size.get(bs, 0),
-                        data_path=getattr(ns, "data_path", None),
+    try:
+        with GracefulExitHandler() as exit_handler:
+            for it in range(start_step, ns.train_iters):
+                if exit_handler.signaled is not None:
+                    if verbose:
+                        print(f"signal {exit_handler.signaled} received; stopping at iter {it}")
+                    break
+                # start after the warmup/compile iteration so the timeline
+                # shows steady-state steps, not one giant compile span
+                if trace_dir and not trace_started and iters_run >= 1:
+                    jax.profiler.start_trace(trace_dir)
+                    trace_started = True
+                if rampup is not None:
+                    bs = rampup(consumed)
+                    if bs != cur_bs or it == start_step:
+                        cur_bs = bs
+                        loader = build_dataloader(
+                            cfg, bs, seq, seed=ns.seed + bs,
+                            start_batch=batches_at_size.get(bs, 0),
+                            data_path=getattr(ns, "data_path", None),
+                        )
+                    batches_at_size[bs] = batches_at_size.get(bs, 0) + 1
+                    consumed += bs
+                else:
+                    consumed += cur_bs
+                iters_run += 1
+                batch = rt.shard_batch(next(loader))
+                prof.begin_iter()
+                state, loss = rt.train_step(state, batch)
+                # always hand end_iter the loss: per-iter mode syncs each
+                # step (sync_each implies that's wanted); windowed mode syncs
+                # ONCE, to close the warmup — without it the window would
+                # open while warmup compute is still in flight and overstate
+                # avg iter time
+                prof.end_iter(loss)
+                if sync_each:
+                    losses.append(float(loss))
+                    if verbose:
+                        print(f"iter {it}: loss {float(loss):.4f}")
+                if metrics.path:
+                    metrics.log(
+                        "train_iter", step=it, loss=float(loss), batch_size=cur_bs,
+                        iter_ms=(prof.iter_times_ms[-1] if prof.iter_times_ms else None),
                     )
-                batches_at_size[bs] = batches_at_size.get(bs, 0) + 1
-                consumed += bs
-            else:
-                consumed += cur_bs
-            iters_run += 1
-            batch = rt.shard_batch(next(loader))
-            prof.begin_iter()
-            state, loss = rt.train_step(state, batch)
-            # always hand end_iter the loss: per-iter mode syncs each step
-            # (sync_each implies that's wanted); windowed mode syncs ONCE, to
-            # close the warmup — without it the window would open while
-            # warmup compute is still in flight and overstate avg iter time
-            prof.end_iter(loss)
-            if sync_each:
-                losses.append(float(loss))
-                if verbose:
-                    print(f"iter {it}: loss {float(loss):.4f}")
-            if metrics.path:
-                metrics.log(
-                    "train_iter", step=it, loss=float(loss), batch_size=cur_bs,
-                    iter_ms=(prof.iter_times_ms[-1] if prof.iter_times_ms else None),
-                )
-            if ns.save and ns.save_interval and (it + 1) % ns.save_interval == 0:
-                save_checkpoint(ns.save, state, it + 1)
-                if verbose:
-                    print(f"saved step {it + 1} → {ns.save}")
-    prof.finish(loss if iters_run else None)
-    if trace_dir:
-        jax.profiler.stop_trace()
-        if verbose:
-            print(f"jax.profiler trace → {trace_dir}")
+                if ns.save and ns.save_interval and (it + 1) % ns.save_interval == 0:
+                    save_checkpoint(ns.save, state, it + 1)
+                    if verbose:
+                        print(f"saved step {it + 1} → {ns.save}")
+        prof.finish(loss if iters_run else None)
+    finally:
+        # always close the trace — an exception mid-loop must not lose the
+        # captured data or wedge the process-wide profiler state
+        if trace_started:
+            jax.profiler.stop_trace()
+            if verbose:
+                print(f"jax.profiler trace → {trace_dir}")
     # checkpoint on exit — normal completion or signal (the reference's
     # dist_signal_handler checkpoint-then-exit pattern, there unused)
     if ns.save:

@@ -59,7 +59,7 @@ def _layer_with_cache(x, p, cfg: ModelConfig, k_cache, v_cache, offset, cos_sin,
     hd = cfg.head_dim
     xa = modeling.norm(x, p["attn_norm"], cfg)
     pa = p["attn"]
-    q, k, v = modeling.split_qkv(xa @ pa["wqkv"].astype(xa.dtype), cfg)
+    q, k, v = modeling.project_qkv_heads(xa, pa["wqkv"], cfg)
     if cfg.pos_embed == "rope":
         cos, sin = cos_sin
         q = modeling.apply_rope(q, cos, sin)

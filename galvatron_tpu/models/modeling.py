@@ -121,6 +121,12 @@ class ModelConfig:
         return self.hidden_size // self.num_heads
 
     @property
+    def qkv_blocked(self) -> bool:
+        """Fused-QKV weight layout: blocked (h, 3, n·hd) without GQA —
+        contiguous q/k/v extraction — vs GQA-interleaved (see qkv_dims)."""
+        return self.kv_heads == self.num_heads
+
+    @property
     def ffn(self) -> int:
         if self.ffn_dim is not None:
             return self.ffn_dim
@@ -145,18 +151,45 @@ def _dense_init(key, in_dim, out_dim, dtype):
 
 
 def qkv_dims(cfg: ModelConfig) -> Tuple[int, int]:
-    """(kv groups, per-group width) of the fused QKV projection: columns are
-    interleaved by kv-head group — group g holds its n/kv query heads then its
-    k head then its v head (Megatron's fused-QKV ColumnParallel layout with
-    GQA head-group splitting, reference: galvatron/core/tensor_parallel/
-    transformer.py:679-708) — so TP shards at kv-group boundaries never split
-    a q|k|v slice."""
+    """(kv groups, per-group width) of the fused QKV projection in the GQA
+    (interleaved) layout: columns are interleaved by kv-head group — group g
+    holds its n/kv query heads then its k head then its v head (Megatron's
+    fused-QKV ColumnParallel layout with GQA head-group splitting, reference:
+    galvatron/core/tensor_parallel/transformer.py:679-708) — so TP shards at
+    kv-group boundaries never split a q|k|v slice.
+
+    Without GQA (kv_heads == num_heads, ``cfg.qkv_blocked``) the weight is
+    instead stored 3D as (h, 3, n·hd) — one slot each for Q/K/V, TP sharding
+    the head dim of every slot. The blocked layout makes the q/k/v extraction
+    a contiguous slice; the interleaved layout's per-head strided gather
+    costs ~2 ms/layer-batch at the 7B shape on v5e."""
     group = (cfg.num_heads // cfg.kv_heads + 2) * cfg.head_dim
     return cfg.kv_heads, group
 
 
+def qkv_project(x, w, cfg: ModelConfig):
+    """Fused QKV GEMM in the stored layout's natural shape: blocked weights
+    (h, 3, n·hd) contract via einsum to (…, 3, n·hd); interleaved weights
+    (h, kv·group) via a plain matmul."""
+    if cfg.qkv_blocked:
+        return jnp.einsum("...h,hcd->...cd", x, w.astype(x.dtype))
+    return x @ w.astype(x.dtype)
+
+
+def project_qkv_heads(x, w, cfg: ModelConfig):
+    """Fused projection straight to per-head q/k/v — the only supported way
+    to consume a wqkv weight (qkv_project and split_qkv are layout-dependent
+    halves that must always be paired)."""
+    return split_qkv(qkv_project(x, w, cfg), cfg)
+
+
 def split_qkv(qkv, cfg: ModelConfig):
-    """(…, kv·group) fused projection → q (…, n, hd), k/v (…, kv, hd)."""
+    """Fused projection → q (…, n, hd), k/v (…, kv, hd). Accepts the blocked
+    (…, 3, n·hd) or interleaved (…, kv·group) projection output."""
+    if cfg.qkv_blocked:
+        lead = qkv.shape[:-2]
+        r = qkv.reshape(*lead, 3, cfg.num_heads, cfg.head_dim)
+        return r[..., 0, :, :], r[..., 1, :, :], r[..., 2, :, :]
     kv, group = qkv_dims(cfg)
     npg = cfg.num_heads // cfg.kv_heads  # query heads per kv group
     r = qkv.reshape(*qkv.shape[:-1], kv, npg + 2, cfg.head_dim)
@@ -170,10 +203,13 @@ def init_layer_params(key, cfg: ModelConfig, cross: bool = False) -> Params:
     kv_out = cfg.kv_heads * hd
     kv, group = qkv_dims(cfg)
     ks = jax.random.split(key, 8)
+    wqkv = _dense_init(ks[0], h, kv * group, cfg.param_dtype)
+    if cfg.qkv_blocked:
+        wqkv = wqkv.reshape(h, 3, q_out)
     p: Params = {
         "attn_norm": {"scale": jnp.ones((h,), cfg.param_dtype)},
         "attn": {
-            "wqkv": _dense_init(ks[0], h, kv * group, cfg.param_dtype),
+            "wqkv": wqkv,
             "wo": _dense_init(ks[3], q_out, h, cfg.param_dtype),
         },
         "mlp_norm": {"scale": jnp.ones((h,), cfg.param_dtype)},
@@ -218,7 +254,8 @@ def layer_annotations(cfg: ModelConfig, cross: bool = False) -> Params:
     a: Params = {
         "attn_norm": {"scale": ("fsdp",)},
         "attn": {
-            "wqkv": ("fsdp", "tp"),
+            # blocked layout: TP shards the head dim of each q/k/v slot
+            "wqkv": ("fsdp", None, "tp") if cfg.qkv_blocked else ("fsdp", "tp"),
             "wo": ("tp", "fsdp"),
         },
         "mlp_norm": {"scale": ("fsdp",)},
@@ -549,8 +586,8 @@ def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: boo
     b, s, h = x.shape
     hd = cfg.head_dim
     # one fused qkv GEMM (~2 ms/layer-batch over three narrow matmuls on the
-    # v5e 7B-shape bench); layout per qkv_dims
-    q, k, v = split_qkv(x @ p["wqkv"].astype(x.dtype), cfg)
+    # v5e 7B-shape bench); layout per qkv_dims/qkv_project
+    q, k, v = project_qkv_heads(x, p["wqkv"], cfg)
     rope = cos_sin if cfg.pos_embed == "rope" else None
     bias = None
     if cfg.pos_embed == "alibi":
@@ -735,7 +772,7 @@ def swin_attention(x, p, lcfg: ModelConfig, h: int, w: int, window: int, shift: 
         .transpose(0, 1, 3, 2, 4, 5)
         .reshape(b * nh * nw, ws2, c)
     )
-    q, k, v = split_qkv(xw @ p["wqkv"].astype(x.dtype), lcfg)  # fused projection
+    q, k, v = project_qkv_heads(xw, p["wqkv"], lcfg)  # fused projection
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(hd)
     if shift:
         mask = jnp.asarray(_swin_attn_mask(h, w, window, shift))  # (nW, ws2, ws2)

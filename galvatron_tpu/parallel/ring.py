@@ -289,7 +289,7 @@ def ring_decoder_layer(x, p, cfg: ModelConfig, mesh, cp_axes, cos_sin):
     def attn(xn):
         b, s, h = xn.shape
         hd = cfg.head_dim
-        q, k, v = modeling.split_qkv(xn @ p["attn"]["wqkv"].astype(xn.dtype), cfg)
+        q, k, v = modeling.project_qkv_heads(xn, p["attn"]["wqkv"], cfg)
         if cfg.pos_embed == "rope":
             cos, sin = cos_sin
             q = modeling.apply_rope(q, cos, sin)

@@ -72,9 +72,10 @@ def test_encdec_parity_tp2_and_heterogeneous():
     np.testing.assert_allclose(
         float(r1.eval_loss(s1, b)), float(r2.eval_loss(s2, b)), rtol=2e-5
     )
-    # decoder layer 1 (strategy index 3) is tp=4 on wqkv
+    # decoder layer 1 (strategy index 3) is tp=4 on wqkv (blocked layout:
+    # (h, 3, n*hd), tp shards the head dim of each slot)
     spec = s2["params"]["layers"][1]["attn"]["wqkv"].sharding.spec
-    assert spec[1] is not None and len(spec[1]) == 2  # two binary axes = tp4
+    assert spec[2] is not None and len(spec[2]) == 2  # two binary axes = tp4
 
 
 def test_encdec_rejects_pp_and_cp():

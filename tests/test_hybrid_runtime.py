@@ -148,9 +148,9 @@ def test_param_shardings_applied():
     # layer 0: zero3 → wq sharded over all data axes on dim 0
     wq0 = state["params"]["layers"][0]["attn"]["wqkv"]
     assert wq0.sharding.spec[0] == ("x0", "x1", "x2")
-    # layer 2: tp4 → wq sharded over 2 tp axes on dim 1
+    # layer 2: tp4 → wq sharded over 2 tp axes on the per-slot head dim
     wq2 = state["params"]["layers"][2]["attn"]["wqkv"]
-    assert wq2.sharding.spec[1] == ("x1", "x2")
+    assert wq2.sharding.spec[2] == ("x1", "x2")
     # layer 3: zero2 → param replicated, opt state sharded
     wq3 = state["params"]["layers"][3]["attn"]["wqkv"]
     assert wq3.sharding.spec[0] is None

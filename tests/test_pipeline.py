@@ -109,7 +109,7 @@ def test_pipeline_stage_param_placement():
     wq = state["params"]["stages"][0]["attn"]["wqkv"]
     assert wq.shape[0] == 2  # stacked over stages
     assert wq.sharding.spec[0] == "pp"
-    assert wq.sharding.spec[2] in ("x1", ("x1",))  # tp on out dim
+    assert wq.sharding.spec[3] in ("x1", ("x1",))  # tp on the per-slot head dim
     assert wq.sharding.spec[1] in ("x0", ("x0",))  # zero3 on in dim
 
 

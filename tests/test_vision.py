@@ -195,7 +195,7 @@ def test_swin_geometry_pyramid():
     assert (h1, w1, c1, n1) == (4, 4, 32, 4)
     # stage-1 layers see the merged (quartered, doubled-width) map
     p = modeling.init_model_params(jax.random.key(0), SWIN_CFG)
-    assert p["layers"][2]["attn"]["wqkv"].shape == (32, 96)  # fused q|k|v at C=32
+    assert p["layers"][2]["attn"]["wqkv"].shape == (32, 3, 32)  # blocked q|k|v at C=32
     assert p["merges"][0]["w"].shape == (64, 32)
 
 
