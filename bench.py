@@ -25,7 +25,10 @@ import numpy as np
 REF_MS_PER_LAYER_PER_SAMPLE = 4.64
 
 
-def measure(cfg, bsz, seq, iters=6):
+def measure(cfg, bsz, seq, iters=6, reps=3):
+    """Best-of-``reps`` timing windows (min is the standard noise-robust
+    estimator for a fixed workload; run-to-run spread through the remote
+    dispatch path is ±0.2 ms/layer/sample otherwise)."""
     from galvatron_tpu.models import modeling
 
     params = modeling.init_model_params(jax.random.key(0), cfg)
@@ -41,11 +44,14 @@ def measure(cfg, bsz, seq, iters=6):
 
     out = fwd(params, tokens)
     _ = float(out)  # compile + sync
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fwd(params, tokens)
-    _ = float(out)
-    return (time.perf_counter() - t0) / iters * 1000.0
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fwd(params, tokens)
+        _ = float(out)
+        best = min(best, (time.perf_counter() - t0) / iters * 1000.0)
+    return best
 
 
 def main():
