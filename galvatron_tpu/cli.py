@@ -180,11 +180,22 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         from galvatron_tpu.models.tokenizer import build_tokenizer
 
         ns = initialize_galvatron(mode, rest, model_default)
-        cfg = model_config_from_args(ns)
         tok = build_tokenizer(ns.tokenizer)
-        if tok.vocab_size > cfg.vocab_size:
-            cfg = cfg.replace(vocab_size=tok.vocab_size)
-        params = _load_or_init_params(ns, cfg)
+        if getattr(ns, "load_hf", None):
+            from galvatron_tpu.models.convert import load_hf_llama
+
+            params, cfg = load_hf_llama(ns.load_hf)
+            if tok.vocab_size > cfg.vocab_size:
+                raise ValueError(
+                    f"tokenizer vocab {tok.vocab_size} exceeds the pretrained "
+                    f"embedding {cfg.vocab_size} — ids past the table would "
+                    "silently clamp; use the checkpoint's own tokenizer"
+                )
+        else:
+            cfg = model_config_from_args(ns)
+            if tok.vocab_size > cfg.vocab_size:
+                cfg = cfg.replace(vocab_size=tok.vocab_size)
+            params = _load_or_init_params(ns, cfg)
         if mode == "generate":
             from galvatron_tpu.models import generation
 

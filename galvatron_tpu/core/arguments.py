@@ -113,6 +113,10 @@ def _add_training_args(p: argparse.ArgumentParser):
                    help="JSONL structured metrics sink (per-iter loss/time)")
     g.add_argument("--save", type=str, default=None, help="checkpoint directory")
     g.add_argument("--load", type=str, default=None, help="resume directory")
+    g.add_argument("--load_hf", type=str, default=None,
+                   help="initialize weights from a local HuggingFace "
+                   "LLaMA-architecture checkpoint directory (models/convert.py; "
+                   "overrides the model shape from the HF config)")
     g.add_argument("--save_interval", type=int, default=0)
 
 
@@ -175,6 +179,8 @@ def _add_generate_args(p: argparse.ArgumentParser):
     """(reference: megatron text-generation flags + text_generation_server.py)"""
     g = p.add_argument_group("generate")
     g.add_argument("--load", type=str, default=None, help="checkpoint directory (trainer state)")
+    g.add_argument("--load_hf", type=str, default=None,
+                   help="local HuggingFace LLaMA-architecture checkpoint directory")
     g.add_argument("--tokenizer", type=str, default="byte",
                    help="'byte' or a local transformers tokenizer path")
     g.add_argument("--prompt", type=str, action="append", default=None)

@@ -35,7 +35,20 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
         # the reference's torch.distributed.init_process_group role
         # (site_package/megatron/initialize.py _initialize_distributed)
         jax.distributed.initialize()
-    cfg = model_config_from_args(ns)
+    hf_params = None
+    if getattr(ns, "load_hf", None):
+        # pretrained HF weights: the model shape comes from the HF config
+        # (the reference builds its model FROM the HF checkpoint the same
+        # way — models/llama_hf/train_dist.py)
+        from galvatron_tpu.models.convert import load_hf_llama
+
+        hf_params, cfg = load_hf_llama(ns.load_hf)
+        # weight-bearing dims come from the HF config; the training sequence
+        # length is still the user's call (shorter contexts train fine)
+        if getattr(ns, "seq_length", None):
+            cfg = cfg.replace(max_seq_len=ns.seq_length)
+    else:
+        cfg = model_config_from_args(ns)
     from galvatron_tpu.core.arguments import resolve_attn_impl
 
     cfg = resolve_attn_impl(cfg, ns)
@@ -86,6 +99,10 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
         start_step = int(np.asarray(state["step"]))
         if verbose:
             print(f"resumed from {ns.load} at step {start_step}")
+    elif hf_params is not None:
+        state = rt.init_state_from(hf_params)
+        if verbose:
+            print(f"initialized from HF checkpoint {ns.load_hf}")
     else:
         state = rt.init_state(jax.random.key(ns.seed))
 

@@ -127,6 +127,10 @@ class HybridParallelRuntime:
     init_state: Callable  # (key) -> state
     state_shardings: Any
     batch_sharding: Any = None  # NamedSharding of the token batch
+    # (flat model param tree) -> fresh state carrying those weights — the
+    # pretrained-weight entry point (e.g. models/convert.py HF import). The
+    # pipeline runtime restacks transformer layers per stage first.
+    init_state_from: Callable = None
 
     def shard_batch(self, batch_np):
         """Global on-device batch from a (host-replicated) numpy batch.
@@ -336,6 +340,16 @@ def build_runtime(
             state["scaler"] = init_scaler_state(scaler_cfg)
         return state
 
+    def state_from(params):
+        state = {
+            "params": params,
+            "opt": init_opt_state(params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+        if fp16:
+            state["scaler"] = init_scaler_state(scaler_cfg)
+        return state
+
     # shardings
     state_shape = jax.eval_shape(init_state, jax.random.key(0))
     specs = state_specs(state_shape, cfg, hp, axes)
@@ -354,9 +368,11 @@ def build_runtime(
         out_shardings=NamedSharding(mesh, P()),
     )
     jit_init = jax.jit(init_state, out_shardings=shardings)
+    jit_state_from = jax.jit(state_from, out_shardings=shardings)
 
     return HybridParallelRuntime(
         cfg=cfg, hp=hp, mesh=mesh, axes=axes, adam=adam,
         train_step=jit_train, eval_loss=jit_eval, init_state=jit_init,
         state_shardings=shardings, batch_sharding=batch_sharding,
+        init_state_from=jit_state_from,
     )

@@ -402,6 +402,41 @@ def build_pipeline_runtime(
             state["scaler"] = init_scaler_state(scaler_cfg)
         return state
 
+    def state_from(flat_params):
+        # flat model tree (modeling.init_model_params layout) → stage-stacked:
+        # stages[j][leaf] = stack over stage s of layer s*lps+j; interleaved
+        # vstages[q][leaf] = (pp, vpp) stack with [s, j] = layer
+        # (s + j*pp)*lpvs + q (init_interleaved_params layout)
+        layers = flat_params["layers"]
+        params = {k: v for k, v in flat_params.items() if k != "layers"}
+        if interleaved:
+            lpvs = cfg.num_layers // (hp.pp * hp.vpp)
+            params["vstages"] = [
+                jax.tree.map(
+                    lambda *per_s: jnp.stack(per_s),
+                    *[
+                        jax.tree.map(
+                            lambda *per_j: jnp.stack(per_j),
+                            *[layers[(s + j * hp.pp) * lpvs + q] for j in range(hp.vpp)],
+                        )
+                        for s in range(hp.pp)
+                    ],
+                )
+                for q in range(lpvs)
+            ]
+        else:
+            lps = cfg.num_layers // hp.pp
+            params["stages"] = [
+                jax.tree.map(
+                    lambda *ls: jnp.stack(ls), *[layers[s * lps + j] for s in range(hp.pp)]
+                )
+                for j in range(lps)
+            ]
+        state = {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
+        if fp16:
+            state["scaler"] = init_scaler_state(scaler_cfg)
+        return state
+
     state_shape = jax.eval_shape(init_state, jax.random.key(0))
     specs = {
         "params": param_specs_fn(state_shape["params"], cfg, hp, axes),
@@ -432,9 +467,11 @@ def build_pipeline_runtime(
         compiler_options=copts,
     )
     jit_init = jax.jit(init_state, out_shardings=shardings)
+    jit_state_from = jax.jit(state_from, out_shardings=shardings)
 
     return HybridParallelRuntime(
         cfg=cfg, hp=hp, mesh=mesh, axes=axes, adam=adam,
         train_step=jit_train, eval_loss=jit_eval, init_state=jit_init,
         state_shardings=shardings, batch_sharding=batch_sharding,
+        init_state_from=jit_state_from,
     )

@@ -274,6 +274,22 @@ def make_1f1b_train_step(
             state["scaler"] = init_scaler_state(scaler_cfg)
         return state
 
+    def state_from(flat_params):
+        # flat model tree → stage-stacked (same layout as init_pipeline_params)
+        lps = cfg.num_layers // hp.pp
+        layers = flat_params["layers"]
+        params = {k: v for k, v in flat_params.items() if k != "layers"}
+        params["stages"] = [
+            jax.tree.map(
+                lambda *ls: jnp.stack(ls), *[layers[s * lps + j] for s in range(hp.pp)]
+            )
+            for j in range(lps)
+        ]
+        state = {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
+        if fp16:
+            state["scaler"] = init_scaler_state(scaler_cfg)
+        return state
+
     state_shape = jax.eval_shape(init_state, jax.random.key(0))
     specs = {
         "params": pipeline_param_specs(state_shape["params"], cfg, hp, axes),
@@ -304,9 +320,11 @@ def make_1f1b_train_step(
         compiler_options=copts,
     )
     jit_init = jax.jit(init_state, out_shardings=shardings)
+    jit_state_from = jax.jit(state_from, out_shardings=shardings)
 
     return HybridParallelRuntime(
         cfg=cfg, hp=hp, mesh=mesh, axes=axes, adam=adam,
         train_step=jit_train, eval_loss=jit_eval, init_state=jit_init,
         state_shardings=shardings, batch_sharding=batch_sharding,
+        init_state_from=jit_state_from,
     )
