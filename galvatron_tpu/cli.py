@@ -182,6 +182,12 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         ns = initialize_galvatron(mode, rest, model_default)
         tok = build_tokenizer(ns.tokenizer)
         if getattr(ns, "load_hf", None):
+            if getattr(ns, "load", None):
+                raise ValueError(
+                    "--load and --load_hf are mutually exclusive here: pick "
+                    "the fine-tuned trainer checkpoint (--load) or the raw "
+                    "pretrained HF weights (--load_hf)"
+                )
             from galvatron_tpu.models.convert import load_hf_llama
 
             params, cfg = load_hf_llama(ns.load_hf)

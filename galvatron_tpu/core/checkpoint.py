@@ -67,25 +67,46 @@ def restore_checkpoint(ckpt_dir: str, abstract_state: Any, step: Optional[int] =
     try:
         return ckptr.restore(path, abstract_state)
     except Exception as e:
-        if _has_legacy_qkv_mismatch(abstract_state, str(e)):
-            raise ValueError(
-                "checkpoint predates the blocked fused-QKV weight layout "
-                "(wqkv is now (h, 3, n*head_dim) for non-GQA models): "
-                "re-export it by loading with the producing revision and "
-                "re-saving, e.g. transpose each wqkv from (h, n, 3, head_dim) "
-                "column order to (h, 3, n*head_dim)"
-            ) from e
+        msg = _legacy_layout_message(abstract_state, str(e))
+        if msg:
+            raise ValueError(msg) from e
         raise
 
 
-def _has_legacy_qkv_mismatch(abstract_state: Any, err: str) -> bool:
+def _legacy_layout_message(abstract_state: Any, err: str) -> Optional[str]:
+    """Actionable message when a restore failure looks like one of the known
+    parameter-layout changes rather than a corrupt checkpoint."""
     flat, _ = jax.tree_util.tree_flatten_with_path(abstract_state)
-    has_blocked = any(
-        any(getattr(k, "key", None) == "wqkv" for k in kp)
-        and hasattr(leaf, "shape") and len(leaf.shape) >= 3
-        for kp, leaf in flat
-    )
-    return has_blocked and ("shape" in err.lower() or "rank" in err.lower())
+
+    def has(pred):
+        return any(pred(kp, leaf) for kp, leaf in flat)
+
+    low = err.lower()
+    if ("shape" in low or "rank" in low) and has(
+        lambda kp, leaf: any(getattr(k, "key", None) == "wqkv" for k in kp)
+        and hasattr(leaf, "shape")
+        and len(leaf.shape) >= 3
+    ):
+        return (
+            "checkpoint predates the blocked fused-QKV weight layout "
+            "(wqkv is now (h, 3, n*head_dim) for non-GQA models): "
+            "re-export it by loading with the producing revision and "
+            "re-saving, e.g. transpose each wqkv from (h, n, 3, head_dim) "
+            "column order to (h, 3, n*head_dim)"
+        )
+    bias_keys = {"wqkv_b", "wo_b", "w1_b", "w2_b", "w13_b"}
+    if has(
+        lambda kp, leaf: any(getattr(k, "key", None) in bias_keys for k in kp)
+    ):
+        return (
+            "restore failed and the target model carries projection biases "
+            "(use_bias — on by default for the gpt/bert presets since the "
+            "GPT-2-faithful bias change): a checkpoint saved before that "
+            "change has no *_b leaves. Re-export it with the producing "
+            "revision, or add zero biases to the saved tree. Original "
+            f"error: {err[:500]}"
+        )
+    return None
 
 
 def abstract_state_of(runtime, init_key=None) -> Any:

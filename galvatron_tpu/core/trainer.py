@@ -44,8 +44,17 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
 
         hf_params, cfg = load_hf_llama(ns.load_hf)
         # weight-bearing dims come from the HF config; the training sequence
-        # length is still the user's call (shorter contexts train fine)
-        if getattr(ns, "seq_length", None):
+        # length is still the user's call (shorter contexts train fine). The
+        # learned-pos table must follow the override, or the imported state
+        # would disagree with the runtime's nominal shapes and break resume.
+        if getattr(ns, "seq_length", None) and ns.seq_length != cfg.max_seq_len:
+            if "pos" in hf_params.get("embed", {}):
+                if ns.seq_length > cfg.max_seq_len:
+                    raise ValueError(
+                        f"--seq_length {ns.seq_length} exceeds the checkpoint's "
+                        f"learned-position table ({cfg.max_seq_len})"
+                    )
+                hf_params["embed"]["pos"] = hf_params["embed"]["pos"][: ns.seq_length]
             cfg = cfg.replace(max_seq_len=ns.seq_length)
     else:
         cfg = model_config_from_args(ns)

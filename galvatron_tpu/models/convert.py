@@ -18,7 +18,6 @@ from __future__ import annotations
 
 from typing import Any, Dict, Mapping
 
-import jax.numpy as jnp
 import numpy as np
 
 from galvatron_tpu.models.modeling import ModelConfig, Params
@@ -141,18 +140,105 @@ def from_hf_llama(model_or_state_dict: Any, cfg: ModelConfig) -> Params:
     return params
 
 
-def load_hf_llama(path_or_model: Any) -> tuple:
-    """(params, cfg) from a local HF checkpoint directory or an in-memory
-    HF model. Only the LLaMA architecture family is supported (the fused
-    layouts here have no bias slots — GPT-2-style checkpoints carry biases)."""
+def config_from_hf_gpt2(hf_config) -> ModelConfig:
+    """ModelConfig from a ``transformers.GPT2Config``-shaped object (the
+    reference's gpt_hf family wraps exactly this model —
+    models/gpt_hf/GPTModel_hybrid_parallel.py)."""
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(
+            f"unsupported GPT-2 activation {act!r} (the MLP here uses the "
+            "tanh-approximate gelu, i.e. HF's gelu_new)"
+        )
+    if getattr(hf_config, "scale_attn_by_inverse_layer_idx", False):
+        raise ValueError("scale_attn_by_inverse_layer_idx is not implemented")
+    return ModelConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.n_embd,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        ffn_dim=hf_config.n_inner or 4 * hf_config.n_embd,
+        max_seq_len=hf_config.n_positions,
+        pos_embed="learned",
+        norm_type="layernorm",
+        act_fn="gelu",
+        use_bias=True,
+        tie_word_embeddings=True,
+        norm_eps=float(getattr(hf_config, "layer_norm_epsilon", 1e-5)),
+    )
+
+
+def from_hf_gpt2(model_or_state_dict: Any, cfg: ModelConfig) -> Params:
+    """HF ``GPT2LMHeadModel`` (or its state dict) → parameter pytree. GPT-2's
+    Conv1D weights are already input-major (h_in, h_out) and its fused
+    ``c_attn`` is already in the blocked [Q | K | V] column order, so the
+    mapping is reshape-only."""
+    sd: Mapping[str, Any] = (
+        model_or_state_dict
+        if isinstance(model_or_state_dict, Mapping)
+        else model_or_state_dict.state_dict()
+    )
+    dt = cfg.param_dtype
+    h, nd = cfg.hidden_size, cfg.num_heads * cfg.head_dim
+
+    def get(name: str) -> np.ndarray:
+        if name not in sd:
+            raise KeyError(f"HF state dict is missing '{name}'")
+        return _np(sd[name])
+
+    params: Params = {
+        "embed": {
+            "tok": get("transformer.wte.weight").astype(dt),
+            "pos": get("transformer.wpe.weight").astype(dt),
+        },
+        "layers": [],
+        "final_norm": {
+            "scale": get("transformer.ln_f.weight").astype(dt),
+            "bias": get("transformer.ln_f.bias").astype(dt),
+        },
+    }
+    for i in range(cfg.num_layers):
+        pre = f"transformer.h.{i}."
+        params["layers"].append(
+            {
+                "attn_norm": {
+                    "scale": get(pre + "ln_1.weight").astype(dt),
+                    "bias": get(pre + "ln_1.bias").astype(dt),
+                },
+                "attn": {
+                    "wqkv": get(pre + "attn.c_attn.weight").reshape(h, 3, nd).astype(dt),
+                    "wqkv_b": get(pre + "attn.c_attn.bias").reshape(3, nd).astype(dt),
+                    "wo": get(pre + "attn.c_proj.weight").astype(dt),
+                    "wo_b": get(pre + "attn.c_proj.bias").astype(dt),
+                },
+                "mlp_norm": {
+                    "scale": get(pre + "ln_2.weight").astype(dt),
+                    "bias": get(pre + "ln_2.bias").astype(dt),
+                },
+                "mlp": {
+                    "w1": get(pre + "mlp.c_fc.weight").astype(dt),
+                    "w1_b": get(pre + "mlp.c_fc.bias").astype(dt),
+                    "w2": get(pre + "mlp.c_proj.weight").astype(dt),
+                    "w2_b": get(pre + "mlp.c_proj.bias").astype(dt),
+                },
+            }
+        )
+    return params
+
+
+def load_hf_checkpoint(path_or_model: Any) -> tuple:
+    """(params, cfg) from a local HF checkpoint directory or an in-memory HF
+    model. Supported architectures: LLaMA family (RMSNorm/SwiGLU/RoPE, no
+    biases) and GPT-2 (LayerNorm/GeLU/learned positions, biases)."""
     if isinstance(path_or_model, str):
         from transformers import AutoConfig, AutoModelForCausalLM
 
         hf_cfg = AutoConfig.from_pretrained(path_or_model)
-        if "llama" not in type(hf_cfg).__name__.lower():
+        name = type(hf_cfg).__name__.lower()
+        if "llama" not in name and "gpt2" not in name:
             raise ValueError(
-                f"--load_hf supports LLaMA-architecture checkpoints; got "
-                f"{type(hf_cfg).__name__}"
+                f"--load_hf supports LLaMA-architecture and GPT-2 checkpoints; "
+                f"got {type(hf_cfg).__name__}"
             )
         # low_cpu_mem_usage streams weights instead of materializing a full
         # randomly-initialized module first (~halves host peak for 7B+)
@@ -162,5 +248,12 @@ def load_hf_llama(path_or_model: Any) -> tuple:
     else:
         model = path_or_model
         hf_cfg = model.config
+    if "gpt2" in type(hf_cfg).__name__.lower():
+        cfg = config_from_hf_gpt2(hf_cfg)
+        return from_hf_gpt2(model, cfg), cfg
     cfg = config_from_hf_llama(hf_cfg)
     return from_hf_llama(model, cfg), cfg
+
+
+# back-compat name (LLaMA was the first supported architecture)
+load_hf_llama = load_hf_checkpoint

@@ -59,7 +59,7 @@ def _layer_with_cache(x, p, cfg: ModelConfig, k_cache, v_cache, offset, cos_sin,
     hd = cfg.head_dim
     xa = modeling.norm(x, p["attn_norm"], cfg)
     pa = p["attn"]
-    q, k, v = modeling.project_qkv_heads(xa, pa["wqkv"], cfg)
+    q, k, v = modeling.project_qkv_heads(xa, pa, cfg)
     if cfg.pos_embed == "rope":
         cos, sin = cos_sin
         q = modeling.apply_rope(q, cos, sin)
@@ -67,7 +67,7 @@ def _layer_with_cache(x, p, cfg: ModelConfig, k_cache, v_cache, offset, cos_sin,
     k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, offset, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, offset, 0, 0))
     o = _cached_attention(q, k_cache, v_cache, offset, cfg, alibi=alibi)
-    x = x + o.reshape(b, s, cfg.num_heads * hd) @ pa["wo"].astype(x.dtype)
+    x = x + modeling.attn_output(o, pa, cfg, x.dtype)
     x = x + modeling.mlp_block(
         modeling.norm(x, p["mlp_norm"], cfg), p["mlp"], cfg, train=False
     )

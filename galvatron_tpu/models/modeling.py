@@ -47,6 +47,9 @@ class ModelConfig:
     norm_type: str = "rms"  # 'rms' | 'layernorm'
     act_fn: str = "swiglu"  # 'swiglu' | 'gelu'
     tie_word_embeddings: bool = False
+    # GPT-2-style projection biases on qkv/out/mlp GEMMs (norm biases are
+    # governed by norm_type). Requires the blocked qkv layout (no GQA).
+    use_bias: bool = False
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     attn_impl: str = "xla"  # 'xla' | 'flash' | 'ring'
@@ -176,11 +179,25 @@ def qkv_project(x, w, cfg: ModelConfig):
     return x @ w.astype(x.dtype)
 
 
-def project_qkv_heads(x, w, cfg: ModelConfig):
+def project_qkv_heads(x, p_attn, cfg: ModelConfig):
     """Fused projection straight to per-head q/k/v — the only supported way
-    to consume a wqkv weight (qkv_project and split_qkv are layout-dependent
-    halves that must always be paired)."""
-    return split_qkv(qkv_project(x, w, cfg), cfg)
+    to consume an attention param dict (qkv_project and split_qkv are
+    layout-dependent halves that must always be paired; the optional
+    GPT-2-style bias rides the blocked (3, n·hd) slots)."""
+    y = qkv_project(x, p_attn["wqkv"], cfg)
+    if "wqkv_b" in p_attn:
+        y = y + p_attn["wqkv_b"].astype(y.dtype)
+    return split_qkv(y, cfg)
+
+
+def attn_output(o, p_attn, cfg: ModelConfig, dtype):
+    """(B, S, n, hd) attention context → (B, S, h) via the output projection
+    (+ optional bias, added after the row-parallel reduction)."""
+    b, s = o.shape[:2]
+    y = o.reshape(b, s, cfg.num_heads * cfg.head_dim) @ p_attn["wo"].astype(dtype)
+    if "wo_b" in p_attn:
+        y = y + p_attn["wo_b"].astype(dtype)
+    return y
 
 
 def split_qkv(qkv, cfg: ModelConfig):
@@ -214,6 +231,11 @@ def init_layer_params(key, cfg: ModelConfig, cross: bool = False) -> Params:
         },
         "mlp_norm": {"scale": jnp.ones((h,), cfg.param_dtype)},
     }
+    if cfg.use_bias:
+        if not cfg.qkv_blocked:
+            raise ValueError("use_bias needs the blocked qkv layout (no GQA)")
+        p["attn"]["wqkv_b"] = jnp.zeros((3, q_out), cfg.param_dtype)
+        p["attn"]["wo_b"] = jnp.zeros((h,), cfg.param_dtype)
     if cross:  # enc-dec decoder layer: cross-attention over the encoder output
         ck = jax.random.split(ks[7], 4)
         p["cross_norm"] = {"scale": jnp.ones((h,), cfg.param_dtype)}
@@ -236,11 +258,17 @@ def init_layer_params(key, cfg: ModelConfig, cross: bool = False) -> Params:
             "w13": _dense_init(ks[4], h, 2 * cfg.ffn, cfg.param_dtype),
             "w2": _dense_init(ks[6], cfg.ffn, h, cfg.param_dtype),
         }
+        if cfg.use_bias:
+            p["mlp"]["w13_b"] = jnp.zeros((2 * cfg.ffn,), cfg.param_dtype)
+            p["mlp"]["w2_b"] = jnp.zeros((h,), cfg.param_dtype)
     else:
         p["mlp"] = {
             "w1": _dense_init(ks[4], h, cfg.ffn, cfg.param_dtype),
             "w2": _dense_init(ks[6], cfg.ffn, h, cfg.param_dtype),
         }
+        if cfg.use_bias:
+            p["mlp"]["w1_b"] = jnp.zeros((cfg.ffn,), cfg.param_dtype)
+            p["mlp"]["w2_b"] = jnp.zeros((h,), cfg.param_dtype)
     if cfg.norm_type == "layernorm":
         p["attn_norm"]["bias"] = jnp.zeros((h,), cfg.param_dtype)
         p["mlp_norm"]["bias"] = jnp.zeros((h,), cfg.param_dtype)
@@ -260,6 +288,11 @@ def layer_annotations(cfg: ModelConfig, cross: bool = False) -> Params:
         },
         "mlp_norm": {"scale": ("fsdp",)},
     }
+    if cfg.use_bias:
+        # column-parallel biases shard with their output dim; the
+        # row-parallel output bias is added once after the reduction
+        a["attn"]["wqkv_b"] = (None, "tp")
+        a["attn"]["wo_b"] = ("fsdp",)
     if cross:
         a["cross_norm"] = {"scale": ("fsdp",)}
         a["cross"] = {
@@ -275,8 +308,14 @@ def layer_annotations(cfg: ModelConfig, cross: bool = False) -> Params:
         a["mlp"] = moe.moe_annotations(cfg)
     elif cfg.act_fn == "swiglu":
         a["mlp"] = {"w13": ("fsdp", "tp"), "w2": ("tp", "fsdp")}
+        if cfg.use_bias:
+            a["mlp"]["w13_b"] = ("tp",)
+            a["mlp"]["w2_b"] = ("fsdp",)
     else:
         a["mlp"] = {"w1": ("fsdp", "tp"), "w2": ("tp", "fsdp")}
+        if cfg.use_bias:
+            a["mlp"]["w1_b"] = ("tp",)
+            a["mlp"]["w2_b"] = ("fsdp",)
     if cfg.norm_type == "layernorm":
         a["attn_norm"]["bias"] = ("fsdp",)
         a["mlp_norm"]["bias"] = ("fsdp",)
@@ -587,7 +626,7 @@ def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: boo
     hd = cfg.head_dim
     # one fused qkv GEMM (~2 ms/layer-batch over three narrow matmuls on the
     # v5e 7B-shape bench); layout per qkv_dims/qkv_project
-    q, k, v = project_qkv_heads(x, p["wqkv"], cfg)
+    q, k, v = project_qkv_heads(x, p, cfg)
     rope = cos_sin if cfg.pos_embed == "rope" else None
     bias = None
     if cfg.pos_embed == "alibi":
@@ -601,7 +640,7 @@ def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: boo
     if remat_attn:
         core = jax.checkpoint(core)
     o = core(q, k, v, bias)
-    return o.reshape(b, s, cfg.num_heads * hd) @ p["wo"].astype(x.dtype)
+    return attn_output(o, p, cfg, x.dtype)
 
 
 def mlp_block(x, p, cfg: ModelConfig, train: bool = True):
@@ -618,8 +657,17 @@ def mlp_block(x, p, cfg: ModelConfig, train: bool = True):
         # matmuls on the v5e 7B-shape bench)
         f = p["w13"].shape[-1] // 2
         g = x @ p["w13"].astype(x.dtype)
-        return (jax.nn.silu(g[..., :f]) * g[..., f:]) @ p["w2"].astype(x.dtype)
-    return jax.nn.gelu(x @ p["w1"].astype(x.dtype), approximate=True) @ p["w2"].astype(x.dtype)
+        if "w13_b" in p:
+            g = g + p["w13_b"].astype(x.dtype)
+        y = (jax.nn.silu(g[..., :f]) * g[..., f:]) @ p["w2"].astype(x.dtype)
+    else:
+        g = x @ p["w1"].astype(x.dtype)
+        if "w1_b" in p:
+            g = g + p["w1_b"].astype(x.dtype)
+        y = jax.nn.gelu(g, approximate=True) @ p["w2"].astype(x.dtype)
+    if "w2_b" in p:
+        y = y + p["w2_b"].astype(x.dtype)
+    return y
 
 
 def cross_attn_block(x, enc_out, p, cfg: ModelConfig):
@@ -772,7 +820,7 @@ def swin_attention(x, p, lcfg: ModelConfig, h: int, w: int, window: int, shift: 
         .transpose(0, 1, 3, 2, 4, 5)
         .reshape(b * nh * nw, ws2, c)
     )
-    q, k, v = project_qkv_heads(xw, p["wqkv"], lcfg)  # fused projection
+    q, k, v = project_qkv_heads(xw, p, lcfg)  # fused projection
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(hd)
     if shift:
         mask = jnp.asarray(_swin_attn_mask(h, w, window, shift))  # (nW, ws2, ws2)
@@ -999,21 +1047,25 @@ PRESETS: Dict[str, ModelConfig] = {
         ffn_dim=17920, max_seq_len=2048,
     ),
     "gpt-0.3b": ModelConfig(
+        use_bias=True,
         vocab_size=50257, hidden_size=1024, num_layers=24, num_heads=16,
         max_seq_len=1024, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
         tie_word_embeddings=True,
     ),
     "gpt-1.5b": ModelConfig(
+        use_bias=True,
         vocab_size=50257, hidden_size=1600, num_layers=48, num_heads=25,
         max_seq_len=1024, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
         tie_word_embeddings=True,
     ),
     "gpt-2.7b": ModelConfig(
+        use_bias=True,
         vocab_size=50257, hidden_size=2560, num_layers=32, num_heads=32,
         max_seq_len=2048, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
         tie_word_embeddings=True,
     ),
     "gpt-6.7b": ModelConfig(
+        use_bias=True,
         vocab_size=50257, hidden_size=4096, num_layers=32, num_heads=32,
         max_seq_len=2048, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
         tie_word_embeddings=True,
@@ -1021,11 +1073,13 @@ PRESETS: Dict[str, ModelConfig] = {
     # encoder families (reference legacy bert support: core/parallel.py:64-89,
     # cost_model.py model_type handling)
     "bert-base": ModelConfig(
+        use_bias=True,
         vocab_size=30528, hidden_size=768, num_layers=12, num_heads=12,
         max_seq_len=512, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
         tie_word_embeddings=True, causal=False, objective="mlm",
     ),
     "bert-large": ModelConfig(
+        use_bias=True,
         vocab_size=30528, hidden_size=1024, num_layers=24, num_heads=16,
         max_seq_len=512, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
         tie_word_embeddings=True, causal=False, objective="mlm",

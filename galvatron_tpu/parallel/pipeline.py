@@ -145,6 +145,23 @@ def base_model_annots(cfg: ModelConfig):
     return a
 
 
+def restack_flat_layers(flat_params, cfg: ModelConfig, hp: HybridParallelConfig):
+    """Flat model tree (modeling.init_model_params layout) → the pp-stacked
+    ``stages[j]`` layout of init_pipeline_params: stages[j][leaf] = stack over
+    stage s of layer s·lps+j. Shared by the GPipe and 1F1B runtimes'
+    init_state_from (pretrained-weight adoption)."""
+    lps = cfg.num_layers // hp.pp
+    layers = flat_params["layers"]
+    params = {k: v for k, v in flat_params.items() if k != "layers"}
+    params["stages"] = [
+        jax.tree.map(
+            lambda *ls: jnp.stack(ls), *[layers[s * lps + j] for s in range(hp.pp)]
+        )
+        for j in range(lps)
+    ]
+    return params
+
+
 def init_pipeline_params(key, cfg: ModelConfig, hp: HybridParallelConfig):
     """Param tree for pp>1: embed/final_norm/head as usual (replicated over pp);
     transformer layers as ``stages[j]`` — position-j layer params stacked over
@@ -403,13 +420,12 @@ def build_pipeline_runtime(
         return state
 
     def state_from(flat_params):
-        # flat model tree (modeling.init_model_params layout) → stage-stacked:
-        # stages[j][leaf] = stack over stage s of layer s*lps+j; interleaved
-        # vstages[q][leaf] = (pp, vpp) stack with [s, j] = layer
-        # (s + j*pp)*lpvs + q (init_interleaved_params layout)
-        layers = flat_params["layers"]
-        params = {k: v for k, v in flat_params.items() if k != "layers"}
+        # flat model tree → the schedule's stacked layout: restack_flat_layers
+        # for plain stages; interleaved vstages[q][leaf] = (pp, vpp) stack
+        # with [s, j] = layer (s + j*pp)*lpvs + q (init_interleaved_params)
         if interleaved:
+            layers = flat_params["layers"]
+            params = {k: v for k, v in flat_params.items() if k != "layers"}
             lpvs = cfg.num_layers // (hp.pp * hp.vpp)
             params["vstages"] = [
                 jax.tree.map(
@@ -425,13 +441,7 @@ def build_pipeline_runtime(
                 for q in range(lpvs)
             ]
         else:
-            lps = cfg.num_layers // hp.pp
-            params["stages"] = [
-                jax.tree.map(
-                    lambda *ls: jnp.stack(ls), *[layers[s * lps + j] for s in range(hp.pp)]
-                )
-                for j in range(lps)
-            ]
+            params = restack_flat_layers(flat_params, cfg, hp)
         state = {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
         if fp16:
             state["scaler"] = init_scaler_state(scaler_cfg)

@@ -275,16 +275,9 @@ def make_1f1b_train_step(
         return state
 
     def state_from(flat_params):
-        # flat model tree → stage-stacked (same layout as init_pipeline_params)
-        lps = cfg.num_layers // hp.pp
-        layers = flat_params["layers"]
-        params = {k: v for k, v in flat_params.items() if k != "layers"}
-        params["stages"] = [
-            jax.tree.map(
-                lambda *ls: jnp.stack(ls), *[layers[s * lps + j] for s in range(hp.pp)]
-            )
-            for j in range(lps)
-        ]
+        from galvatron_tpu.parallel.pipeline import restack_flat_layers
+
+        params = restack_flat_layers(flat_params, cfg, hp)
         state = {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
         if fp16:
             state["scaler"] = init_scaler_state(scaler_cfg)

@@ -289,7 +289,7 @@ def ring_decoder_layer(x, p, cfg: ModelConfig, mesh, cp_axes, cos_sin):
     def attn(xn):
         b, s, h = xn.shape
         hd = cfg.head_dim
-        q, k, v = modeling.project_qkv_heads(xn, p["attn"]["wqkv"], cfg)
+        q, k, v = modeling.project_qkv_heads(xn, p["attn"], cfg)
         if cfg.pos_embed == "rope":
             cos, sin = cos_sin
             q = modeling.apply_rope(q, cos, sin)
@@ -297,7 +297,7 @@ def ring_decoder_layer(x, p, cfg: ModelConfig, mesh, cp_axes, cos_sin):
         k = modeling._repeat_kv(k, cfg.num_heads // k.shape[2])
         v = modeling._repeat_kv(v, cfg.num_heads // v.shape[2])
         o = ring_attention(q, k, v, mesh, cp_axes)
-        return o.reshape(b, s, cfg.num_heads * hd) @ p["attn"]["wo"].astype(xn.dtype)
+        return modeling.attn_output(o, p["attn"], cfg, xn.dtype)
 
     x = x + attn(modeling.norm(x, p["attn_norm"], cfg))
     x = x + modeling.mlp_block(modeling.norm(x, p["mlp_norm"], cfg), p["mlp"], cfg)

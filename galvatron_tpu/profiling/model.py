@@ -37,7 +37,12 @@ def layer_param_count(cfg: ModelConfig) -> int:
     attn = h * cfg.num_heads * cfg.head_dim + 2 * h * cfg.kv_heads * cfg.head_dim + cfg.num_heads * cfg.head_dim * h
     mlp = (3 if cfg.act_fn == "swiglu" else 2) * h * f
     norms = 2 * h * (2 if cfg.norm_type == "layernorm" else 1)
-    return attn + mlp + norms
+    bias = 0
+    if cfg.use_bias:  # qkv slots + wo (+ dense-MLP biases; MoE MLPs carry none)
+        bias = 3 * cfg.num_heads * cfg.head_dim + h
+        if cfg.moe_experts == 0:
+            bias += (2 * f if cfg.act_fn == "swiglu" else f) + h
+    return attn + mlp + norms + bias
 
 
 def other_param_count(cfg: ModelConfig) -> int:

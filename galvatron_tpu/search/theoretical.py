@@ -38,7 +38,12 @@ def layer_param_count(cfg: ModelConfig) -> int:
     else:
         mlp = 2 * h * cfg.ffn
     norms = 2 * h if cfg.norm_type == "rms" else 4 * h
-    return attn + mlp + norms
+    bias = 0
+    if cfg.use_bias:  # qkv slots + wo (+ dense-MLP biases; MoE MLPs carry none)
+        bias = 3 * q_out + h
+        if cfg.moe_experts == 0:
+            bias += (2 * cfg.ffn if cfg.act_fn == "swiglu" else cfg.ffn) + h
+    return attn + mlp + norms + bias
 
 
 def other_param_count(cfg: ModelConfig) -> int:

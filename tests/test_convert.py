@@ -64,13 +64,66 @@ def test_load_hf_llama_roundtrip(tmp_path):
     assert params["layers"][0]["attn"]["wqkv"].shape == (64, 3, 64)
 
 
-def test_load_hf_rejects_non_llama(tmp_path):
-    gpt = transformers.GPT2LMHeadModel(
-        transformers.GPT2Config(n_embd=32, n_layer=1, n_head=2, vocab_size=64)
+def test_load_hf_rejects_unsupported_arch(tmp_path):
+    opt = transformers.OPTForCausalLM(
+        transformers.OPTConfig(
+            hidden_size=32, num_hidden_layers=1, num_attention_heads=2,
+            ffn_dim=64, vocab_size=64, max_position_embeddings=32,
+            word_embed_proj_dim=32,
+        )
     )
-    gpt.save_pretrained(tmp_path / "gpt")
-    with pytest.raises(ValueError, match="LLaMA-architecture"):
-        load_hf_llama(str(tmp_path / "gpt"))
+    opt.save_pretrained(tmp_path / "opt")
+    with pytest.raises(ValueError, match="LLaMA-architecture and GPT-2"):
+        load_hf_llama(str(tmp_path / "opt"))
+
+
+def test_hf_gpt2_logit_parity():
+    """GPT-2 import: biases + blocked c_attn mapping, logit parity vs the HF
+    torch forward (the reference's gpt_hf family wraps this exact model)."""
+    from galvatron_tpu.models.convert import config_from_hf_gpt2, from_hf_gpt2
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=96, n_embd=48, n_layer=2, n_head=4, n_positions=32
+    )
+    torch.manual_seed(2)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    cfg = config_from_hf_gpt2(hf_cfg).replace(
+        dtype=jnp.float32, param_dtype=jnp.float32, attn_impl="xla", fused_norm=False
+    )
+    params = from_hf_gpt2(hf, cfg)
+    tokens = np.random.RandomState(2).randint(0, 96, (2, 16))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(modeling.forward(params, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_load_hf_gpt2_through_runtime(tmp_path):
+    """GPT-2 checkpoint → dispatcher → hybrid runtime trains (bias params
+    shard and update end to end)."""
+    from galvatron_tpu.core.optim import AdamConfig
+    from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+    from galvatron_tpu.models.convert import load_hf_checkpoint
+    from galvatron_tpu.parallel.hybrid import build_runtime
+
+    hf = transformers.GPT2LMHeadModel(
+        transformers.GPT2Config(vocab_size=96, n_embd=48, n_layer=2, n_head=4,
+                                n_positions=32)
+    )
+    hf.save_pretrained(tmp_path / "gpt2")
+    params, cfg = load_hf_checkpoint(str(tmp_path / "gpt2"))
+    cfg = cfg.replace(dtype=jnp.float32, param_dtype=jnp.float32, attn_impl="xla")
+    hp = HybridParallelConfig(
+        layer_strategies=[LayerStrategy(tp=2, dp_type="zero3")] * 2,
+        mixed_precision="fp32",
+    )
+    rt = build_runtime(cfg, hp, adam=AdamConfig(lr=1e-3), global_batch_size=8, seq_len=16)
+    state = rt.init_state_from(params)
+    tokens = jnp.asarray(np.random.RandomState(3).randint(0, 96, (8, 17)), jnp.int32)
+    l0 = float(rt.eval_loss(state, tokens))
+    for _ in range(4):
+        state, loss = rt.train_step(state, tokens)
+    assert float(loss) < l0  # biases train too
 
 
 def hf_ce_loss(hf_model, tokens):
