@@ -9,10 +9,7 @@ profiler hooks. Plus what the reference lacks: checkpoint save/resume.
 from __future__ import annotations
 
 import argparse
-from typing import Optional
-
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from galvatron_tpu.core.arguments import hybrid_config_from_args, model_config_from_args

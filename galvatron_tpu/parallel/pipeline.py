@@ -34,7 +34,6 @@ Layout constraints under SPMD (documented deviations from the reference):
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax

@@ -26,7 +26,6 @@ engine (reference pipeline.py:373-378,966-968).
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict
 
 import jax

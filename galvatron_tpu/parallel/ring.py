@@ -28,7 +28,7 @@ Two per-hop compute paths:
 from __future__ import annotations
 
 import functools
-from typing import Sequence, Tuple
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

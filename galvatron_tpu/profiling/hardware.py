@@ -19,7 +19,6 @@ Writes the ProfiledHardware JSON schema consumed by the search engine.
 from __future__ import annotations
 
 import time
-from functools import partial
 from typing import Dict, Optional, Sequence
 
 import jax

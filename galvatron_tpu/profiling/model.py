@@ -19,7 +19,7 @@ Parameter sizes are computed analytically from the model config.
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

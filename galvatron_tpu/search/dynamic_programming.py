@@ -11,7 +11,7 @@ matrix: dynamic_programming.py:233-272)."""
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Tuple
 
 import numpy as np
 
