@@ -128,10 +128,26 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             while b <= ns.max_bsz:
                 bszs.append(b)
                 b *= ns.bsz_scale
-        res = eng.search(bszs, max_chunks=ns.max_chunks, verbose=True)
+        if ns.validate_top_k > 0:
+            # one sweep serves both the saved result and the validation
+            # candidates (search_topk ranks by predicted throughput, same
+            # criterion search() maximizes)
+            cands = eng.search_topk(
+                bszs, k=ns.validate_top_k, max_chunks=ns.max_chunks, verbose=True
+            )
+            res = cands[0] if cands else None
+        else:
+            cands = None
+            res = eng.search(bszs, max_chunks=ns.max_chunks, verbose=True)
         if res is None:
             print("no feasible strategy under the memory budget")
             return 1
+        if cands:
+            print(
+                f"Max throughput = {res.throughput_samples_per_s:.2f} samples/s "
+                f"(bsz {res.global_bsz})"
+            )
+            _validate_search(cands, cfg, ns)
         out = ns.output_config_path or f"galvatron_config_{ns.model_size}_{ns.num_devices}dev.json"
         eng.save_result(res, out)
         print(f"saved searched strategy → {out}")
@@ -227,6 +243,52 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
 
     print(f"unknown mode {mode!r}; expected train|search|profile|profile-hardware|generate|serve")
     return 2
+
+
+def _validate_search(cands, cfg, ns):
+    """Measured validation of the predicted ranking: train the top-k searched
+    candidates a few steps each and report predicted vs measured iteration
+    time. Ordering compares THROUGHPUT (the criterion the search maximizes —
+    candidates may differ in global batch size, so iteration time alone is
+    not comparable). The reference's check_cost_model stops at printed
+    predictions ("for developers", search_engine.py:369-421); this closes
+    the loop on real steps."""
+    import jax
+
+    from galvatron_tpu.profiling.model import measure_strategy_ms
+
+    world = len(jax.devices())
+    if world != ns.num_devices:
+        print(
+            f"--validate_top_k skipped: search was for {ns.num_devices} "
+            f"devices but this host has {world}"
+        )
+        return
+    rows = []
+    for r in cands:
+        try:
+            ms = measure_strategy_ms(cfg, r.config, r.global_bsz)
+        except Exception as e:  # candidate may not fit this host's memory
+            print(f"  candidate pp={r.config.pp} failed to run: {str(e)[:120]}")
+            continue
+        rows.append((r, r.global_bsz / (ms / 1000.0)))
+        print(
+            f"  pp={r.config.pp} chunks={r.config.chunks} "
+            f"{r.config.pipeline_type} vpp={r.config.vpp} bsz={r.global_bsz}: "
+            f"predicted {r.cost_ms:.1f} ms, measured {ms:.1f} ms "
+            f"(fidelity {r.cost_ms / ms:.3f})"
+        )
+    if len(rows) >= 2:
+        pred_order = [
+            id(r) for r, _ in sorted(rows, key=lambda x: -x[0].throughput_samples_per_s)
+        ]
+        meas_order = [id(r) for r, _ in sorted(rows, key=lambda x: -x[1])]
+        agree = sum(a == b for a, b in zip(pred_order, meas_order))
+        print(
+            f"predicted-vs-measured rank agreement: {agree}/{len(rows)} "
+            f"positions (best candidate "
+            f"{'confirmed' if pred_order[0] == meas_order[0] else 'NOT fastest measured'})"
+        )
 
 
 def _load_or_init_params(ns, cfg):

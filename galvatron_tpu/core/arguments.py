@@ -160,6 +160,11 @@ def _add_search_args(p: argparse.ArgumentParser):
                    choices=["fp32", "fp16", "bf16"])
     g.add_argument("--attn_impl", type=str, default="auto",
                    choices=["auto", "flash", "xla"])
+    g.add_argument("--validate_top_k", type=int, default=0,
+                   help="after searching, TRAIN the top-k candidates a few "
+                   "steps each on this host's devices and report measured vs "
+                   "predicted iteration time and whether the predicted "
+                   "ranking holds (requires --num_devices == local devices)")
 
 
 def _add_profile_args(p: argparse.ArgumentParser):

@@ -55,27 +55,24 @@ def other_param_count(cfg: ModelConfig) -> int:
     return n
 
 
-def _iter_time_ms(cfg: ModelConfig, bsz: int, seq: int, iters: int = 4) -> float:
-    """Wall time per training iteration, measured through the hybrid runtime's
-    own train_step on ONE device with the trivial strategy (tp=1, ddp,
-    chunks=1). The reference profiles through its real trainer the same way
-    (train_dist.py --profile, core/profiler.py:194-240); measuring a separate
-    plain-model loop instead was ~10% slower than what training actually runs
-    (no buffer donation, different loss plumbing), which skewed the cost
-    model's basis and with it predicted-vs-measured fidelity."""
-    from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+def measure_strategy_ms(
+    cfg: ModelConfig,
+    hp,
+    bsz: int,
+    seq: Optional[int] = None,
+    iters: int = 4,
+    devices=None,
+) -> float:
+    """Measured wall time per training iteration of ``hp`` through the hybrid
+    runtime's own train_step (windowed: one sync to open, one to close). The
+    reference profiles through its real trainer the same way (train_dist.py
+    --profile, core/profiler.py:194-240); a separate plain-model loop was
+    ~10% slower than what training actually runs (no buffer donation,
+    different loss plumbing), which skewed predicted-vs-measured fidelity."""
     from galvatron_tpu.parallel.hybrid import build_runtime
     from galvatron_tpu.parallel.mesh import build_mesh
 
-    mesh, axes = build_mesh(pp=1, devices=jax.devices()[:1])
-    mp = {jnp.bfloat16: "bf16", jnp.float16: "fp16"}.get(cfg.dtype, "fp32")
-    hp = HybridParallelConfig(
-        pp=1,
-        layer_strategies=[LayerStrategy()] * cfg.num_layers,
-        chunks=1,
-        vocab_tp=1,
-        mixed_precision=mp,
-    )
+    mesh, axes = build_mesh(pp=hp.pp, devices=devices)
     if cfg.objective == "cls":
         rt = build_runtime(
             cfg, hp, mesh=mesh, axes=axes, adam=AdamConfig(lr=1e-4),
@@ -87,7 +84,10 @@ def _iter_time_ms(cfg: ModelConfig, bsz: int, seq: int, iters: int = 4) -> float
             cfg, hp, mesh=mesh, axes=axes, adam=AdamConfig(lr=1e-4),
             global_batch_size=bsz, seq_len=seq,
         )
-        batch = jnp.zeros((bsz, seq + 1), jnp.int32)
+        # match build_runtime's own seq resolution (seq_len or cfg.sample_len
+        # — enc-dec samples are enc_seq + max_seq_len tokens)
+        batch = jnp.zeros((bsz, (seq or cfg.sample_len) + 1), jnp.int32)
+    batch = rt.shard_batch(batch)
     state = rt.init_state(jax.random.key(0))
     state, loss = rt.train_step(state, batch)  # compile
     _ = float(loss)
@@ -96,6 +96,22 @@ def _iter_time_ms(cfg: ModelConfig, bsz: int, seq: int, iters: int = 4) -> float
         state, loss = rt.train_step(state, batch)
     _ = float(loss)  # host sync
     return (time.perf_counter() - t0) / iters * 1000.0
+
+
+def _iter_time_ms(cfg: ModelConfig, bsz: int, seq: int, iters: int = 4) -> float:
+    """Single-device trivial-strategy iteration time — the per-layer profile
+    basis (tp=1, ddp, chunks=1 on ONE device)."""
+    from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+
+    mp = {jnp.bfloat16: "bf16", jnp.float16: "fp16"}.get(cfg.dtype, "fp32")
+    hp = HybridParallelConfig(
+        pp=1,
+        layer_strategies=[LayerStrategy()] * cfg.num_layers,
+        chunks=1,
+        vocab_tp=1,
+        mixed_precision=mp,
+    )
+    return measure_strategy_ms(cfg, hp, bsz, seq, iters, devices=jax.devices()[:1])
 
 
 def _temp_bytes(cfg: ModelConfig, bsz: int, seq: int) -> Optional[int]:

@@ -270,15 +270,9 @@ class SearchEngine:
 
     # -- full optimization loop ---------------------------------------------
 
-    def search(
-        self,
-        global_bsz_list: Sequence[int],
-        max_chunks: int = 64,
-        verbose: bool = False,
-    ) -> Optional[SearchResult]:
-        """Sweep (bsz, pp, chunks, schedule); maximize throughput (reference:
-        parallelism_optimization, search_engine.py:168-324)."""
-        best: Optional[SearchResult] = None
+    def _iter_results(self, global_bsz_list, max_chunks, verbose=False):
+        """Yield every feasible SearchResult in the (bsz, pp, chunks,
+        schedule, vpp) sweep."""
         pps = self.space.pp_choices or [
             p for p in _pow2s(self.space.world_size) if self.L % p == 0
         ]
@@ -286,8 +280,6 @@ class SearchEngine:
             for pp in pps:
                 chunk_opts = [c for c in _pow2s(min(max_chunks, bsz)) if bsz % c == 0]
                 for chunks in chunk_opts:
-                    if pp == 1 and chunks > 1 and len(chunk_opts) > 1:
-                        pass  # accumulation also searched at pp=1
                     for ptype in self.space.pipeline_types if pp > 1 else ("gpipe",):
                         vpps = [1]
                         if pp > 1 and ptype == "gpipe":
@@ -307,10 +299,43 @@ class SearchEngine:
                                     f"{r.throughput_samples_per_s:.2f} samples/s, "
                                     f"mem {r.memory_mb:.0f} MB"
                                 )
-                            if best is None or (
-                                r.throughput_samples_per_s > best.throughput_samples_per_s
-                            ):
-                                best = r
+                            yield r
+
+    def search_topk(
+        self, global_bsz_list: Sequence[int], k: int, max_chunks: int = 64,
+        verbose: bool = False,
+    ) -> List[SearchResult]:
+        """The k highest-predicted-throughput results (distinct (pp, chunks,
+        schedule, vpp, per-layer strategy) combinations) — the candidate set
+        for measured validation (CLI --validate_top_k)."""
+        seen = set()
+        out: List[SearchResult] = []
+        for r in self._iter_results(global_bsz_list, max_chunks, verbose=verbose):
+            key = (
+                r.global_bsz, r.config.pp, r.config.chunks, r.config.pipeline_type,
+                r.config.vpp, tuple(map(str, r.config.layer_strategies)),
+            )
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(r)
+        out.sort(key=lambda r: -r.throughput_samples_per_s)
+        return out[:k]
+
+    def search(
+        self,
+        global_bsz_list: Sequence[int],
+        max_chunks: int = 64,
+        verbose: bool = False,
+    ) -> Optional[SearchResult]:
+        """Sweep (bsz, pp, chunks, schedule); maximize throughput (reference:
+        parallelism_optimization, search_engine.py:168-324)."""
+        best: Optional[SearchResult] = None
+        for r in self._iter_results(global_bsz_list, max_chunks, verbose=verbose):
+            if best is None or (
+                r.throughput_samples_per_s > best.throughput_samples_per_s
+            ):
+                best = r
         if best is not None and verbose:
             s0 = best.config.layer_strategies[0]
             dp = self.space.world_size // (best.config.pp * s0.tp * s0.cp)

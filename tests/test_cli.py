@@ -181,3 +181,18 @@ def test_fidelity_report_on_searched_config(tmp_path, capsys):
     )
     assert rc == 0
     assert "cost-model fidelity: predicted" in capsys.readouterr().out
+
+
+def test_search_validate_top_k(tmp_path, capsys):
+    """--validate_top_k trains the top candidates and reports measured vs
+    predicted iteration time (the measured closure the reference's
+    check_cost_model never does)."""
+    rc = cli_main(
+        ["search", *TINY, "--num_devices", "8", "--memory_constraint_gb", "1",
+         "--settle_bsz", "8", "--mixed_precision", "fp32",
+         "--validate_top_k", "2", "--search_space", "dp",
+         "--output_config_path", str(tmp_path / "cfg.json")]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "measured" in out and "predicted" in out
