@@ -226,6 +226,52 @@ def from_hf_gpt2(model_or_state_dict: Any, cfg: ModelConfig) -> Params:
     return params
 
 
+def unpack_qkv(wqkv: np.ndarray, cfg: ModelConfig):
+    """Inverse of pack_qkv: fused wqkv → per-projection (h, out) matrices."""
+    h, hd = cfg.hidden_size, cfg.head_dim
+    n, kv = cfg.num_heads, cfg.kv_heads
+    if cfg.qkv_blocked:
+        return wqkv[:, 0, :], wqkv[:, 1, :], wqkv[:, 2, :]
+    npg = n // kv
+    r = wqkv.reshape(h, kv, npg + 2, hd)
+    wq = r[:, :, :npg, :].reshape(h, n * hd)
+    wk = r[:, :, npg, :].reshape(h, kv * hd)
+    wv = r[:, :, npg + 1, :].reshape(h, kv * hd)
+    return wq, wk, wv
+
+
+def to_hf_llama(params: Params, cfg: ModelConfig) -> Dict[str, np.ndarray]:
+    """Parameter pytree → an HF ``LlamaForCausalLM`` state dict (numpy fp32,
+    HF's output-major weight orientation) — the export half of the round
+    trip, so a model fine-tuned here can be served by any HF stack.
+    ``LlamaForCausalLM(config).load_state_dict`` accepts it after wrapping
+    leaves in torch tensors (tests/test_convert.py round-trips it)."""
+    f32 = lambda a: np.asarray(a, np.float32)
+    sd: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": f32(params["embed"]["tok"]),
+        "model.norm.weight": f32(params["final_norm"]["scale"]),
+    }
+    for i, lp in enumerate(params["layers"]):
+        pre = f"model.layers.{i}."
+        wq, wk, wv = unpack_qkv(f32(lp["attn"]["wqkv"]), cfg)
+        sd[pre + "self_attn.q_proj.weight"] = np.ascontiguousarray(wq.T)
+        sd[pre + "self_attn.k_proj.weight"] = np.ascontiguousarray(wk.T)
+        sd[pre + "self_attn.v_proj.weight"] = np.ascontiguousarray(wv.T)
+        sd[pre + "self_attn.o_proj.weight"] = np.ascontiguousarray(f32(lp["attn"]["wo"]).T)
+        w13 = f32(lp["mlp"]["w13"])
+        f = w13.shape[-1] // 2
+        sd[pre + "mlp.gate_proj.weight"] = np.ascontiguousarray(w13[:, :f].T)
+        sd[pre + "mlp.up_proj.weight"] = np.ascontiguousarray(w13[:, f:].T)
+        sd[pre + "mlp.down_proj.weight"] = np.ascontiguousarray(f32(lp["mlp"]["w2"]).T)
+        sd[pre + "input_layernorm.weight"] = f32(lp["attn_norm"]["scale"])
+        sd[pre + "post_attention_layernorm.weight"] = f32(lp["mlp_norm"]["scale"])
+    if not cfg.tie_word_embeddings:
+        sd["lm_head.weight"] = np.ascontiguousarray(f32(params["head"]["w"]).T)
+    else:
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    return sd
+
+
 def load_hf_checkpoint(path_or_model: Any) -> tuple:
     """(params, cfg) from a local HF checkpoint directory or an in-memory HF
     model. Supported architectures: LLaMA family (RMSNorm/SwiGLU/RoPE, no

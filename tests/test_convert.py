@@ -225,3 +225,26 @@ def test_rejects_rope_scaling_and_biases():
     )
     with pytest.raises(ValueError, match="bias"):
         config_from_hf_llama(cfg2)
+
+
+def test_to_hf_llama_roundtrip():
+    """Export: a fine-tuned param tree loads into HF LlamaForCausalLM and
+    reproduces our logits — fine-tune here, serve on any HF stack."""
+    from galvatron_tpu.models.convert import from_hf_llama, to_hf_llama
+
+    for kv in (4, 2):  # blocked and GQA-interleaved unpacking
+        hf = tiny_hf(num_kv_heads=kv)
+        cfg = config_from_hf_llama(hf.config).replace(
+            dtype=jnp.float32, param_dtype=jnp.float32, attn_impl="xla", fused_norm=False
+        )
+        params = from_hf_llama(hf, cfg)
+        # perturb so the export is not just the identity of the import
+        params["layers"][0]["attn"]["wo"] = params["layers"][0]["attn"]["wo"] + 0.01
+        sd = {k: torch.tensor(v) for k, v in to_hf_llama(params, cfg).items()}
+        hf2 = tiny_hf(num_kv_heads=kv)
+        hf2.load_state_dict(sd)
+        tokens = np.random.RandomState(4).randint(0, cfg.vocab_size, (2, 12))
+        with torch.no_grad():
+            ref = hf2(torch.tensor(tokens)).logits.numpy()
+        ours = np.asarray(modeling.forward(params, jnp.asarray(tokens, jnp.int32), cfg))
+        np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
