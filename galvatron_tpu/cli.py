@@ -9,6 +9,7 @@ models/<name>/{train_dist,search_dist,profiler}.py + profile_hardware):
   profile-hardware  ICI bandwidth + overlap sweep → JSON
   generate          KV-cache text generation from a checkpoint (or random init)
   serve             REST generation server (text_generation_server equivalent)
+  export-hf         trainer checkpoint → HuggingFace-format checkpoint
 
 The per-model modules (galvatron_tpu.models.<family>) re-export these with
 family defaults, mirroring the reference's directory-per-model layout.
@@ -188,6 +189,41 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         print(f"p2p: {hw.p2p_bw}")
         print(f"overlap_coe: {hw.overlap_coe}")
         print(f"saved → {ns.hardware_output_path}")
+        return 0
+
+    if mode == "export-hf":
+        ns = initialize_galvatron("export_hf", rest, model_default)
+        if not ns.output_dir:
+            print("error: export-hf needs --output_dir")
+            return 2
+        cfg = model_config_from_args(ns)
+        from galvatron_tpu.models.convert import to_hf_llama
+
+        params = _load_or_init_params(ns, cfg)  # validates shape vs config
+        sd = to_hf_llama(params, cfg)
+        import numpy as _np
+
+        os.makedirs(ns.output_dir, exist_ok=True)
+        try:
+            import torch
+            from transformers import LlamaConfig, LlamaForCausalLM
+
+            hf_cfg = LlamaConfig(
+                vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+                intermediate_size=cfg.ffn, num_hidden_layers=cfg.num_layers,
+                num_attention_heads=cfg.num_heads, num_key_value_heads=cfg.kv_heads,
+                max_position_embeddings=cfg.max_seq_len,
+                rms_norm_eps=cfg.norm_eps, rope_theta=cfg.rope_theta,
+                tie_word_embeddings=cfg.tie_word_embeddings,
+            )
+            model = LlamaForCausalLM(hf_cfg)
+            model.load_state_dict({k: torch.tensor(v) for k, v in sd.items()})
+            model.save_pretrained(ns.output_dir)
+            print(f"exported HF checkpoint → {ns.output_dir}")
+        except ImportError:
+            _np.savez(os.path.join(ns.output_dir, "state_dict.npz"), **sd)
+            print(f"transformers unavailable; wrote raw state dict → "
+                  f"{ns.output_dir}/state_dict.npz")
         return 0
 
     if mode in ("generate", "serve"):

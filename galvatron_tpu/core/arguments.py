@@ -196,6 +196,8 @@ def _add_generate_args(p: argparse.ArgumentParser):
     g.add_argument("--seed", type=int, default=1234)
     g.add_argument("--port", type=int, default=5000)
     g.add_argument("--host", type=str, default="127.0.0.1")
+    g.add_argument("--output_dir", type=str, default=None,
+                   help="export-hf: directory for the HF-format checkpoint")
 
 
 def _add_hardware_args(p: argparse.ArgumentParser):
@@ -219,7 +221,7 @@ def build_parser(mode: str, model_default: Optional[str] = None) -> argparse.Arg
         _add_training_args(p)
     elif mode == "profile_hardware":
         _add_hardware_args(p)
-    elif mode in ("generate", "serve"):
+    elif mode in ("generate", "serve", "export_hf"):
         _add_generate_args(p)
     else:
         raise ValueError(f"unknown mode {mode}")

@@ -246,6 +246,11 @@ def to_hf_llama(params: Params, cfg: ModelConfig) -> Dict[str, np.ndarray]:
     trip, so a model fine-tuned here can be served by any HF stack.
     ``LlamaForCausalLM(config).load_state_dict`` accepts it after wrapping
     leaves in torch tensors (tests/test_convert.py round-trips it)."""
+    if cfg.act_fn != "swiglu" or cfg.norm_type != "rms" or cfg.use_bias:
+        raise ValueError(
+            "to_hf_llama exports the LLaMA architecture family only "
+            "(RMSNorm + SwiGLU, no projection biases)"
+        )
     f32 = lambda a: np.asarray(a, np.float32)
     sd: Dict[str, np.ndarray] = {
         "model.embed_tokens.weight": f32(params["embed"]["tok"]),

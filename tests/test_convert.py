@@ -248,3 +248,23 @@ def test_to_hf_llama_roundtrip():
             ref = hf2(torch.tensor(tokens)).logits.numpy()
         ours = np.asarray(modeling.forward(params, jnp.asarray(tokens, jnp.int32), cfg))
         np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_cli_export_hf(tmp_path, capsys):
+    """train --save → export-hf → HF checkpoint loads back via load_hf."""
+    from galvatron_tpu.cli import main as cli_main
+    from galvatron_tpu.models.convert import load_hf_checkpoint
+
+    save = str(tmp_path / "ckpt")
+    args = ["--model_size", "llama-0.3b", "--hidden_size", "64", "--num_layers", "2",
+            "--num_heads", "4", "--ffn_dim", "112", "--vocab_size", "128",
+            "--seq_length", "16"]
+    rc = cli_main(["train", *args, "--global_train_batch_size", "8",
+                   "--train_iters", "2", "--mixed_precision", "fp32",
+                   "--save", save])
+    assert rc == 0
+    out_dir = str(tmp_path / "hf")
+    rc = cli_main(["export-hf", *args, "--load", save, "--output_dir", out_dir])
+    assert rc == 0
+    params, cfg = load_hf_checkpoint(out_dir)
+    assert cfg.hidden_size == 64 and cfg.num_layers == 2
