@@ -1107,27 +1107,32 @@ PRESETS: Dict[str, ModelConfig] = {
     # vision families (reference legacy vit/swin model_type branches,
     # core/parallel.py:64-89, cost_model.py:76,87-106)
     "vit-base": ModelConfig(
+        use_bias=True,
         vocab_size=1, hidden_size=768, num_layers=12, num_heads=12,
         max_seq_len=0, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
         causal=False, objective="cls", image_size=224, patch_size=16,
     ),
     "vit-large": ModelConfig(
+        use_bias=True,
         vocab_size=1, hidden_size=1024, num_layers=24, num_heads=16,
         max_seq_len=0, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
         causal=False, objective="cls", image_size=224, patch_size=16,
     ),
     "vit-huge": ModelConfig(
+        use_bias=True,
         vocab_size=1, hidden_size=1280, num_layers=32, num_heads=16,
         max_seq_len=0, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
         causal=False, objective="cls", image_size=224, patch_size=14,
     ),
     "swin-base": ModelConfig(
+        use_bias=True,
         vocab_size=1, hidden_size=128, num_layers=24, num_heads=4,
         max_seq_len=0, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
         causal=False, objective="cls", image_size=224, patch_size=4,
         swin_depths=(2, 2, 18, 2), swin_window=7,
     ),
     "swin-large": ModelConfig(
+        use_bias=True,
         vocab_size=1, hidden_size=192, num_layers=24, num_heads=6,
         max_seq_len=0, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
         causal=False, objective="cls", image_size=224, patch_size=4,
