@@ -132,6 +132,43 @@ def _temp_bytes(cfg: ModelConfig, bsz: int, seq: int) -> Optional[int]:
         return None
 
 
+def _temp_bytes_tp(cfg: ModelConfig, bsz: int, seq: int, tp: int) -> Optional[int]:
+    """Per-device XLA temp bytes of the ACTUAL tp-sharded train step,
+    compiled (not run) on ``tp`` local devices — the measured counterpart of
+    the reference's per-tp memory profiling sweep (core/profiler.py:194-240
+    launches real runs across tp degrees). Needs >= tp devices (a pod host);
+    single-chip hosts fall back to the analytic ~1/tp curve."""
+    if tp > len(jax.devices()):
+        return None
+    try:
+        from galvatron_tpu.core.checkpoint import abstract_state_of
+        from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+        from galvatron_tpu.parallel.hybrid import build_runtime
+        from galvatron_tpu.parallel.mesh import build_mesh
+
+        mesh, axes = build_mesh(pp=1, devices=jax.devices()[:tp])
+        mp = {jnp.bfloat16: "bf16", jnp.float16: "fp16"}.get(cfg.dtype, "fp32")
+        hp = HybridParallelConfig(
+            pp=1,
+            layer_strategies=[LayerStrategy(tp=tp)] * cfg.num_layers,
+            chunks=1, vocab_tp=tp, mixed_precision=mp,
+        )
+        rt = build_runtime(
+            cfg, hp, mesh=mesh, axes=axes, adam=AdamConfig(lr=1e-4),
+            global_batch_size=bsz, seq_len=seq,
+        )
+        abstract = abstract_state_of(rt)
+        batch = jax.ShapeDtypeStruct(
+            (bsz, seq + 1), jnp.int32, sharding=rt.batch_sharding
+        )
+        ma = rt.train_step.lower(abstract, batch).compile().memory_analysis()
+        if ma is None:
+            return None
+        return int(ma.temp_size_in_bytes)
+    except Exception:
+        return None
+
+
 def profile_model(
     cfg: ModelConfig,
     bsz: int = 8,
@@ -159,6 +196,20 @@ def profile_model(
     else:  # analytic fallback: residuals + attn + mlp intermediates, bf16
         act_bytes = seq * cfg.hidden_size * (10 + 4 * cfg.ffn / cfg.hidden_size)
         act_mb = act_bytes * 2 / 1e6
+    # per-tp curve: measured (compiled tp-sharded step) where the host has
+    # enough devices, ~1/tp analytic otherwise (reference sweeps real runs
+    # across tp degrees, core/profiler.py:194-240)
+    act_curve = {1: float(act_mb)}
+    for t in (2, 4, 8):
+        if cfg.hidden_size % t or cfg.num_heads % t or bsz % t:
+            act_curve[t] = float(act_mb / t)
+            continue
+        bt1 = _temp_bytes_tp(cfg1, bsz, seq, t)
+        bt2 = _temp_bytes_tp(cfg2, bsz, seq, t)
+        if bt1 is not None and bt2 is not None and bt2 > bt1:
+            act_curve[t] = (bt2 - bt1) / (l2 - l1) / bsz / 1e6
+        else:
+            act_curve[t] = float(act_mb / t)
 
     boundary_mb = seq * cfg.hidden_size * 2 / 1e6  # one bf16 (S, H) tensor
     p_layer = layer_param_count(cfg)
@@ -175,7 +226,7 @@ def profile_model(
             0: ProfiledLayerType(
                 fwd_ms_per_sample=float(fwd_ms),
                 parameter_mb=float(p_mb),
-                activation_mb_per_sample={t: float(act_mb / t) for t in (1, 2, 4, 8)},
+                activation_mb_per_sample=act_curve,
                 boundary_activation_mb_per_sample=float(boundary_mb),
                 moe_expert_param_fraction=float(moe_frac),
                 moe_a2a_mb_per_sample=float(moe_a2a),
