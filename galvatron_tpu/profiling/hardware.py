@@ -30,18 +30,43 @@ from galvatron_tpu.parallel.mesh import MeshAxes, build_mesh
 from galvatron_tpu.search.cost_model import ProfiledHardware
 
 
-def _time_fn(fn, *args, iters: int = 5, warmup: int = 2) -> float:
-    """Median wall time (s) with a host fetch to force completion (device
-    timers differ across backends; host fetch is the portable sync)."""
-    for _ in range(warmup):
-        out = fn(*args)
+def _default_chain() -> int:
+    """Measurement window length: on accelerators, chain dependent in-jit
+    applications and sync once per window — per-call host syncs would fold
+    the host round-trip into every sample (it dwarfs a single collective on
+    remote-dispatch setups and pads small-message bandwidths everywhere).
+    On the CPU simulation the numbers are synthetic anyway and the scanned
+    program compiles much slower, so stay with per-call timing."""
+    return 1 if jax.default_backend() == "cpu" else 8
+
+
+def _time_fn(fn, *args, iters: int = 5, chain: Optional[int] = None) -> float:
+    """Median wall time (s) per application of ``fn`` (shape-preserving —
+    every profiled collective here is), timed in windows of ``chain``
+    dependent applications (see _default_chain)."""
+    chain = chain or _default_chain()
+    single = len(args) == 1
+    if chain == 1:
+        run = fn if getattr(fn, "lower", None) else jax.jit(fn)
+    else:
+
+        @jax.jit
+        def run(*a):
+            def body(c, _):
+                o = fn(*c)
+                return ((o,) if single else tuple(o)), None
+
+            c, _ = jax.lax.scan(body, tuple(a), None, length=chain)
+            return c
+
+    out = run(*args)  # compile + warm
     jax.block_until_ready(out)
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        out = fn(*args)
-        _ = np.asarray(jax.tree.leaves(out)[0].ravel()[0])  # host fetch
-        times.append(time.perf_counter() - t0)
+        out = run(*args)
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) / chain)
     return float(np.median(times))
 
 
