@@ -277,7 +277,10 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         )
         return 0
 
-    print(f"unknown mode {mode!r}; expected train|search|profile|profile-hardware|generate|serve")
+    print(
+        f"unknown mode {mode!r}; expected "
+        "train|search|profile|profile-hardware|generate|serve|export-hf"
+    )
     return 2
 
 
