@@ -7,18 +7,19 @@ sitecustomize, so the platform is forced programmatically (the backend client
 is created lazily, so this still takes effect)."""
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # NOTE: the XLA:CPU all-reduce-promotion crash on sub-f32 pipeline backwards
 # is handled per-compile by galvatron_tpu.parallel.pipeline.
 # cpu_sim_compiler_options — deliberately NOT disabled globally here, so the
 # bf16/fp16 pipeline tests exercise the same mechanism real CPU-sim users get.
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
+import __graft_entry__
+
+__graft_entry__._force_virtual_cpu(8)
 
 import jax
-
-jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
