@@ -82,7 +82,25 @@ def _legacy_layout_message(abstract_state: Any, err: str) -> Optional[str]:
         return any(pred(kp, leaf) for kp, leaf in flat)
 
     low = err.lower()
-    if ("shape" in low or "rank" in low) and has(
+    bias_keys = {"wqkv_b", "wo_b", "w1_b", "w2_b", "w13_b"}
+    # Bias branch first, gated on a missing-key mismatch that NAMES a bias
+    # leaf — orbax's structure-mismatch error lists the offending paths with
+    # "Target: MISSING", and its ShapeDtypeStruct reprs mention "shape",
+    # which would otherwise trip the wqkv branch. Errors that merely mention
+    # a bias leaf without a missing-key mismatch (shape conflict, corrupt
+    # array) must surface verbatim.
+    if "missing" in low and any(bk in low for bk in bias_keys) and has(
+        lambda kp, leaf: any(getattr(k, "key", None) in bias_keys for k in kp)
+    ):
+        return (
+            "restore failed and the target model carries projection biases "
+            "(use_bias — on by default for the gpt/bert presets since the "
+            "GPT-2-faithful bias change): a checkpoint saved before that "
+            "change has no *_b leaves. Re-export it with the producing "
+            "revision, or add zero biases to the saved tree. Original "
+            f"error: {err[:500]}"
+        )
+    if ("shape" in low or "rank" in low) and "missing" not in low and has(
         lambda kp, leaf: any(getattr(k, "key", None) == "wqkv" for k in kp)
         and hasattr(leaf, "shape")
         and len(leaf.shape) >= 3
@@ -93,18 +111,6 @@ def _legacy_layout_message(abstract_state: Any, err: str) -> Optional[str]:
             "re-export it by loading with the producing revision and "
             "re-saving, e.g. transpose each wqkv from (h, n, 3, head_dim) "
             "column order to (h, 3, n*head_dim)"
-        )
-    bias_keys = {"wqkv_b", "wo_b", "w1_b", "w2_b", "w13_b"}
-    if has(
-        lambda kp, leaf: any(getattr(k, "key", None) in bias_keys for k in kp)
-    ):
-        return (
-            "restore failed and the target model carries projection biases "
-            "(use_bias — on by default for the gpt/bert presets since the "
-            "GPT-2-faithful bias change): a checkpoint saved before that "
-            "change has no *_b leaves. Re-export it with the producing "
-            "revision, or add zero biases to the saved tree. Original "
-            f"error: {err[:500]}"
         )
     return None
 

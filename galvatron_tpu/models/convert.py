@@ -16,7 +16,7 @@ tests/test_convert.py (logits agree to ~1e-4 in fp32).
 
 from __future__ import annotations
 
-from typing import Any, Mapping
+from typing import Any, Dict, Mapping
 
 import numpy as np
 

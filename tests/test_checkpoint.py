@@ -1,0 +1,54 @@
+"""Checkpoint/resume diagnostics (core/checkpoint.py): restore-failure
+classification for known parameter-layout migrations."""
+
+
+def test_legacy_layout_message_gating():
+    """The bias-layout relabel fires only when the error names a missing bias
+    leaf; unrelated restore failures (corrupt file, IO) surface verbatim, and
+    missing-bias errors are not mislabeled as the wqkv-layout change."""
+    import jax
+
+    from galvatron_tpu.core.checkpoint import _legacy_layout_message
+
+    biased = {
+        "layers": [
+            {
+                "attn": {
+                    "wqkv": jax.ShapeDtypeStruct((4, 3, 4), "float32"),
+                    "wqkv_b": jax.ShapeDtypeStruct((4,), "float32"),
+                }
+            }
+        ]
+    }
+    # orbax-style structure mismatch naming the bias leaf (its leaf reprs
+    # mention "shape" too -- must pick the bias message, not the wqkv one)
+    msg = _legacy_layout_message(
+        biased,
+        "Dict key mismatch; target: MISSING layers[0].attn.wqkv_b "
+        "Source: ShapeDtypeStruct(shape=(4,), dtype=float32)",
+    )
+    assert msg and "projection biases" in msg
+    # non-structural failure on the same tree -> no relabel
+    assert _legacy_layout_message(biased, "failed to deserialize array: corrupt chunk") is None
+    # structural failure not naming a bias leaf -> no bias relabel
+    plain = {"layers": [{"attn": {"wo": jax.ShapeDtypeStruct((4, 4), "float32")}}]}
+    assert _legacy_layout_message(plain, "Dict key mismatch; missing keys: x") is None
+    # genuine wqkv shape mismatch (no missing keys) still gets the wqkv message
+    msg2 = _legacy_layout_message(biased, "shape mismatch for layers[0].attn.wqkv")
+    assert msg2 and "fused-QKV" in msg2
+
+
+def test_legacy_layout_message_requires_missing_key():
+    """Errors that mention a bias leaf WITHOUT a missing-key mismatch (shape
+    conflict, corrupt array) surface verbatim — no migration relabel."""
+    import jax
+
+    from galvatron_tpu.core.checkpoint import _legacy_layout_message
+
+    biased = {"layers": [{"attn": {"wqkv_b": jax.ShapeDtypeStruct((4,), "float32")}}]}
+    assert (
+        _legacy_layout_message(
+            biased, "corrupt chunk deserializing layers[0].attn.wqkv_b"
+        )
+        is None
+    )

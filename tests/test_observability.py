@@ -125,3 +125,4 @@ def test_trainer_jax_profiler_trace(tmp_path):
         os.path.join(r, f) for r, _, fs in os.walk(trace_dir) for f in fs
     ]
     assert captured, "trace dir is empty — no profile captured"
+
