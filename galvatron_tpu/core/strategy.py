@@ -176,6 +176,13 @@ class HybridParallelConfig:
                 raise ValueError("pp_division length must equal pp")
             if sum(self.pp_division) != self.num_layers:
                 raise ValueError("pp_division must sum to the layer count")
+            if any(n < 1 for n in self.pp_division):
+                raise ValueError("pp_division entries must be >= 1")
+            if self.vpp > 1 and len(set(self.pp_division)) > 1:
+                raise ValueError(
+                    "the interleaved schedule (vpp>1) requires a uniform "
+                    "pp_division (virtual stages are evenly stacked)"
+                )
         if self.pp > 1 and self.chunks < 1:
             raise ValueError("chunks must be >= 1")
         if self.vpp < 1:
@@ -313,8 +320,8 @@ class HybridParallelConfig:
 def balanced_division(num_layers: int, pp: int) -> List[int]:
     """Even layer split across stages, remainder to the middle stages — the
     uniform fallback of the reference's memory-balanced division
-    (galvatron/core/search_engine.py:586-654; the memory-aware version lives in
-    galvatron_tpu.search.search_engine)."""
+    (galvatron/core/search_engine.py:586-654); the memory-aware version is
+    ``galvatron_tpu.search.pp_division.pp_division_memory_balanced``."""
     base, rem = divmod(num_layers, pp)
     division = [base] * pp
     # give the extra layers to the later-middle stages (first/last stages carry

@@ -23,7 +23,10 @@ TPU-native replacement for the reference's 1340-line NCCL pipeline engine
                                            grads are pure values)
 
 Layout constraints under SPMD (documented deviations from the reference):
-- layer count must divide evenly across stages (pp_division uniform);
+- uneven stage divisions (searched ``pp_division``) are supported via padded
+  stacking: stacks are max(division) tall, light stages carry zero-filled
+  masked padding slots (free in wall-clock — ticks are lockstep — and
+  per-device memory is bounded by the heaviest stage regardless);
 - layers at the same position within their stage share one strategy (stacked
   arrays have a single sharding). Per-position heterogeneity is retained;
   arbitrary per-layer heterogeneity is available at pp=1.
@@ -52,7 +55,11 @@ from galvatron_tpu.core.schedules import (
     init_scaler_state,
     scaled_value_and_grad,
 )
-from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+from galvatron_tpu.core.strategy import (
+    HybridParallelConfig,
+    LayerStrategy,
+    balanced_division,
+)
 from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
 from galvatron_tpu.parallel.mesh import MeshAxes, batch_spec
@@ -74,26 +81,50 @@ def cpu_sim_compiler_options():
 # ---------------------------------------------------------------------------
 
 
-def validate_pipeline_strategies(cfg: ModelConfig, hp: HybridParallelConfig) -> int:
-    """Check SPMD stacking constraints; returns layers-per-stage."""
+def stage_layout(
+    cfg: ModelConfig, hp: HybridParallelConfig
+) -> Tuple[List[int], List[int], List[LayerStrategy]]:
+    """(division, offsets, position_strategies) for the stage-stacked pipeline.
+
+    Uneven divisions (the reference's searched ``pp_division``,
+    galvatron/core/search_engine.py:586-654 / pipeline placement
+    core/pipeline/pipeline.py:75-77) are realized by PADDED stacking: every
+    stage's param stack carries ``max(division)`` positions; stages with fewer
+    real layers carry zero-filled padding slots whose compute is masked out.
+    Padding is free in wall-clock — the clocked schedules are lockstep, so
+    tick time is set by the heaviest stage either way — and per-device memory
+    is bounded by the heaviest stage regardless of padding.
+
+    ``position_strategies[j]`` is the shared strategy of every real layer at
+    stage position ``j`` (stacked arrays have one sharding, so layers at the
+    same position must agree — checked here).
+    """
     L, pp = cfg.num_layers, hp.pp
-    if L % pp != 0:
+    div = list(hp.pp_division) if hp.pp_division else balanced_division(L, pp)
+    if len(div) != pp or sum(div) != L or any(n < 1 for n in div):
         raise ValueError(
-            f"pp={pp} requires the layer count {L} to divide evenly across stages "
-            "(SPMD stage stacking; use pp=1 for ragged divisions)"
+            f"pp_division {div} must have {pp} entries >= 1 summing to {L}"
         )
-    lps = L // pp
-    for j in range(lps):
-        base = hp.layer_strategies[j]
-        for s in range(1, pp):
-            other = hp.layer_strategies[s * lps + j]
-            if other != base:
-                raise ValueError(
-                    f"layers at stage-position {j} must share one strategy across "
-                    f"stages (stage 0 has {base}, stage {s} has {other}); "
-                    "per-position heterogeneity only under pp>1"
-                )
-    return lps
+    offsets = list(np.cumsum([0] + div[:-1]))
+    position_strategies: List[LayerStrategy] = []
+    for j in range(max(div)):
+        stages_with_j = [s for s in range(pp) if div[s] > j]
+        strats = {hp.layer_strategies[offsets[s] + j] for s in stages_with_j}
+        if len(strats) > 1:
+            raise ValueError(
+                f"layers at stage-position {j} must share one strategy across "
+                f"stages (got {sorted(map(str, strats))}); arbitrary per-layer "
+                "heterogeneity is available at pp=1"
+            )
+        position_strategies.append(next(iter(strats)))
+    return div, offsets, position_strategies
+
+
+def validate_pipeline_strategies(cfg: ModelConfig, hp: HybridParallelConfig) -> int:
+    """Check SPMD stacking constraints; returns positions-per-stage (the
+    padded stack height, max of the stage division)."""
+    div, _, pos = stage_layout(cfg, hp)
+    return len(pos)
 
 
 def base_model_params(ks, cfg: ModelConfig):
@@ -147,16 +178,22 @@ def base_model_annots(cfg: ModelConfig):
 def restack_flat_layers(flat_params, cfg: ModelConfig, hp: HybridParallelConfig):
     """Flat model tree (modeling.init_model_params layout) → the pp-stacked
     ``stages[j]`` layout of init_pipeline_params: stages[j][leaf] = stack over
-    stage s of layer s·lps+j. Shared by the GPipe and 1F1B runtimes'
+    stage s of the stage's j-th layer (zero padding where a stage has fewer
+    layers than max(division)). Shared by the GPipe and 1F1B runtimes'
     init_state_from (pretrained-weight adoption)."""
-    lps = cfg.num_layers // hp.pp
+    div, offsets, pos = stage_layout(cfg, hp)
     layers = flat_params["layers"]
     params = {k: v for k, v in flat_params.items() if k != "layers"}
+    zeros = jax.tree.map(jnp.zeros_like, layers[0])
     params["stages"] = [
         jax.tree.map(
-            lambda *ls: jnp.stack(ls), *[layers[s * lps + j] for s in range(hp.pp)]
+            lambda *ls: jnp.stack(ls),
+            *[
+                layers[offsets[s] + j] if div[s] > j else zeros
+                for s in range(hp.pp)
+            ],
         )
-        for j in range(lps)
+        for j in range(len(pos))
     ]
     return params
 
@@ -164,16 +201,26 @@ def restack_flat_layers(flat_params, cfg: ModelConfig, hp: HybridParallelConfig)
 def init_pipeline_params(key, cfg: ModelConfig, hp: HybridParallelConfig):
     """Param tree for pp>1: embed/final_norm/head as usual (replicated over pp);
     transformer layers as ``stages[j]`` — position-j layer params stacked over
-    stages, leading dim pp."""
-    lps = validate_pipeline_strategies(cfg, hp)
+    stages, leading dim pp; padding slots (uneven division) zero-filled."""
+    div, offsets, pos = stage_layout(cfg, hp)
     ks = jax.random.split(key, 4)
     base = base_model_params(ks, cfg)
     layer_keys = jax.random.split(ks[3], cfg.num_layers)
-    # stages[j][leaf] has shape (pp, *leaf_shape); stage s slice is layer s*lps+j
+    # stages[j][leaf] has shape (pp, *leaf_shape); stage s slice is the
+    # stage's j-th layer (offsets[s]+j globally), zeroed where j >= div[s]
     stages = []
-    for j in range(lps):
-        keys_j = jnp.stack([layer_keys[s * lps + j] for s in range(hp.pp)])
-        stages.append(jax.vmap(lambda k: modeling.init_layer_params(k, cfg))(keys_j))
+    for j in range(len(pos)):
+        keys_j = jnp.stack(
+            [layer_keys[offsets[s] + j if div[s] > j else 0] for s in range(hp.pp)]
+        )
+        stacked = jax.vmap(lambda k: modeling.init_layer_params(k, cfg))(keys_j)
+        if any(div[s] <= j for s in range(hp.pp)):
+            mask = np.array([div[s] > j for s in range(hp.pp)])
+            stacked = jax.tree.map(
+                lambda a: a * mask.reshape((hp.pp,) + (1,) * (a.ndim - 1)).astype(a.dtype),
+                stacked,
+            )
+        stages.append(stacked)
     base["stages"] = stages
     return base
 
@@ -184,7 +231,6 @@ def pipeline_param_specs(
 ):
     """Specs: stages[j] leaves get P('pp', *strategy_j_spec); embed/head/norm
     get the vocab strategy without a pp entry (replicated over pp)."""
-    lps = cfg.num_layers // hp.pp
     annots = modeling.layer_annotations(cfg)
     embed_strategy = LayerStrategy(
         tp=hp.vocab_tp, tp_consec=True, dp_type=hp.embed_dp_type, sp=hp.vocab_sp
@@ -194,9 +240,10 @@ def pipeline_param_specs(
     model_annots = base_model_annots(cfg)
     for key in params_shape:
         if key == "stages":
+            _, _, pos_strategies = stage_layout(cfg, hp)
             specs["stages"] = []
-            for j in range(lps):
-                s_j = hp.layer_strategies[j]
+            for j in range(len(params_shape["stages"])):
+                s_j = pos_strategies[j]
                 specs["stages"].append(
                     jax.tree.map(
                         lambda leaf, a: P(
@@ -228,12 +275,21 @@ def pipeline_param_specs(
 
 
 def make_block_fn(
-    cfg: ModelConfig, strategies: List[LayerStrategy], mesh: Mesh, axes: MeshAxes
+    cfg: ModelConfig,
+    strategies: List[LayerStrategy],
+    mesh: Mesh,
+    axes: MeshAxes,
+    active_counts: Optional[List[int]] = None,
 ):
     """Run ``len(strategies)`` decoder layers with per-position sharding
     constraints + remat (the per-layer wrap steps [3,5,6] of the reference
     construction, galvatron/core/hybrid_parallel_model.py:81-153). Used as one
-    pipeline stage (gpipe/1F1B) or one virtual stage (interleaved)."""
+    pipeline stage (gpipe/1F1B) or one virtual stage (interleaved).
+
+    ``active_counts`` (uneven stage division): per-stage real-layer counts;
+    position j acts as identity on stages where ``j >= active_counts[stage]``
+    (padding slots of the stacked params). The masked select also zeroes the
+    padding slots' gradients. Requires the 'pp' axis (shard_map manual)."""
 
     def act_spec(s: LayerStrategy) -> P:
         bs = batch_spec(axes, s)
@@ -245,6 +301,11 @@ def make_block_fn(
             jnp.asarray(modeling.alibi_slopes(cfg.num_heads))
             if cfg.pos_embed == "alibi"
             else None
+        )
+        n_active = (
+            None
+            if active_counts is None
+            else jnp.asarray(active_counts)[jax.lax.axis_index("pp")]
         )
         for j, s in enumerate(strategies):
             x = constrain(x, mesh, act_spec(s))
@@ -265,17 +326,23 @@ def make_block_fn(
 
             if s.ckpt == "full":
                 run = jax.checkpoint(run)
-            x = run(x, stage_params[j])
+            out = run(x, stage_params[j])
+            # identity on padding positions (and zero grads to their params)
+            x = out if n_active is None else jnp.where(j < n_active, out, x)
         return x
 
     return stage_fn
 
 
 def make_stage_fn(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axes: MeshAxes):
-    """One physical pipeline stage: the first stage's position strategies
-    (validate_pipeline_strategies guarantees stages agree per position)."""
-    lps = cfg.num_layers // hp.pp
-    return make_block_fn(cfg, hp.layer_strategies[:lps], mesh, axes)
+    """One physical pipeline stage: per-position strategies from the stage
+    layout (stage_layout guarantees stages agree per position); uneven
+    divisions mask the padding positions."""
+    div, _, pos_strategies = stage_layout(cfg, hp)
+    uneven = len(set(div)) > 1
+    return make_block_fn(
+        cfg, pos_strategies, mesh, axes, active_counts=div if uneven else None
+    )
 
 
 # ---------------------------------------------------------------------------

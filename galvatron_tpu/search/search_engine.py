@@ -36,6 +36,7 @@ from galvatron_tpu.search.cost_model import (
     pipeline_time_cost,
 )
 from galvatron_tpu.search.dynamic_programming import run_dp, transition_cost_ms
+from galvatron_tpu.search.pp_division import pp_division_memory_balanced
 
 
 @dataclass
@@ -157,7 +158,7 @@ class SearchEngine:
     ) -> Optional[SearchResult]:
         space = self.space
         world = space.world_size
-        if world % pp or self.L % pp:
+        if world % pp or self.L < pp:
             return None
         if pp > 1 and len(self.costs.layer_types) > 1:
             # heterogeneous layer types (Swin pyramid, enc-dec): the runtime's
@@ -174,7 +175,22 @@ class SearchEngine:
                 return None
             if self.L % (pp * vpp) or chunks % pp:
                 return None
-        lps = self.L // pp
+        # stage division: uniform when possible; memory-balanced (reference
+        # pp_division_memory_balanced) for ragged layer counts — the runtime
+        # realizes it with padded stage stacking (pipeline.stage_layout)
+        lps = -(-self.L // pp)  # positions per stage = max(division)
+        division: Optional[List[int]] = None
+        if pp > 1 and self.L % pp:
+            # single layer type here (heterogeneous types return None above),
+            # so one baseline cost covers every layer; tp=1 pure-dp baseline
+            # mirrors the reference (:598)
+            base_mb = layer_memory_cost(
+                self._layer_type(0), LayerStrategy(), world, pp, global_bsz,
+                chunks, stage_idx=0, pipeline_type=pipeline_type,
+                mixed_precision=self.mp,
+            ).total_mb
+            division = pp_division_memory_balanced([base_mb] * self.L, pp)
+            lps = max(division)
         cands = generate_layer_strategies(space, pp)
         # the micro-batch (global_bsz / chunks) must split over each
         # strategy's dp axes — strict chunk filter
@@ -228,8 +244,14 @@ class SearchEngine:
 
         chosen = [cands[k] for k in res]
         if pp > 1:
-            # same per-position pattern in every (virtual) stage
-            layer_strategies = chosen * (pp * vpp)
+            # same per-position pattern in every (virtual) stage; uneven
+            # divisions truncate the pattern on light stages
+            if division is not None:
+                layer_strategies = [
+                    chosen[j] for s in range(pp) for j in range(division[s])
+                ]
+            else:
+                layer_strategies = chosen * (pp * vpp)
             per_stage_ms = sum(intra[j, res[j]] for j in range(n_pos)) * vpp / chunks
             stage_ms = [per_stage_ms] * pp
             boundary_msg = (
@@ -252,6 +274,7 @@ class SearchEngine:
             pp=pp,
             vpp=vpp,
             layer_strategies=layer_strategies,
+            pp_division=division,
             chunks=chunks,
             pipeline_type=pipeline_type,
             vocab_tp=1,
@@ -274,7 +297,7 @@ class SearchEngine:
         """Yield every feasible SearchResult in the (bsz, pp, chunks,
         schedule, vpp) sweep."""
         pps = self.space.pp_choices or [
-            p for p in _pow2s(self.space.world_size) if self.L % p == 0
+            p for p in _pow2s(self.space.world_size) if p <= self.L
         ]
         for bsz in global_bsz_list:
             for pp in pps:

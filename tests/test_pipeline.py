@@ -113,10 +113,13 @@ def test_pipeline_stage_param_placement():
     assert wq.sharding.spec[1] in ("x0", ("x0",))  # zero3 on in dim
 
 
-def test_pipeline_rejects_ragged_division():
+def test_pipeline_rejects_invalid_division():
+    # ragged divisions are supported (padded stacking, test_pipeline_uneven);
+    # a division that does not cover the layer count is not
     hp = HybridParallelConfig.uniform(5, pp=2, chunks=2, mixed_precision="fp32")
+    hp.pp_division = [1, 3]
     cfg = CFG.replace(num_layers=5)
-    with pytest.raises(ValueError, match="divide evenly"):
+    with pytest.raises(ValueError, match="sum"):
         build_runtime(cfg, hp, adam=ADAM, global_batch_size=8, seq_len=32)
 
 

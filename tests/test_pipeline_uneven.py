@@ -1,0 +1,167 @@
+"""Uneven (memory-balanced) pipeline stage division.
+
+The reference searches a memory-balanced layer split per pp degree
+(galvatron/core/search_engine.py:586-654) and places arbitrary layer ranges
+per stage (core/pipeline/pipeline.py:75-77). Here uneven divisions run via
+padded stage stacking (parallel/pipeline.stage_layout): stacks are
+max(division) tall, light stages carry zero-filled masked padding slots.
+Parity methodology mirrors test_pipeline.py: pipeline losses must equal the
+flat single-path model on identical weights."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from galvatron_tpu.core.optim import AdamConfig, adamw_update, init_opt_state
+from galvatron_tpu.core.strategy import HybridParallelConfig, balanced_division
+from galvatron_tpu.models import modeling
+from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.parallel.hybrid import build_runtime
+from galvatron_tpu.search.pp_division import pp_division_memory_balanced
+
+CFG5 = ModelConfig(
+    vocab_size=128,
+    hidden_size=64,
+    num_layers=5,
+    num_heads=4,
+    ffn_dim=128,
+    max_seq_len=32,
+    dtype=jnp.float32,
+)
+ADAM = AdamConfig(lr=1e-3, grad_clip=1.0)
+
+
+def make_batch(seed=0, batch=8, seq=32, vocab=128):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randint(0, vocab, (batch, seq + 1)), jnp.int32)
+
+
+def flat_loss(flat_params, batch, cfg):
+    return float(jax.jit(lambda p, b: modeling.lm_loss(p, b, cfg))(flat_params, batch))
+
+
+@pytest.mark.parametrize(
+    "ptype,division",
+    [
+        ("gpipe", [2, 3]),
+        ("gpipe", [3, 2]),
+        ("pipedream_flush", [2, 3]),
+        ("pipedream_flush", [3, 2]),
+    ],
+)
+def test_uneven_division_loss_parity(ptype, division):
+    hp = HybridParallelConfig.uniform(
+        5, pp=2, tp=2, chunks=2, vocab_tp=2, mixed_precision="fp32",
+        pipeline_type=ptype,
+    )
+    hp.pp_division = division
+    rt = build_runtime(CFG5, hp, adam=ADAM, global_batch_size=8, seq_len=32)
+    flat = modeling.init_model_params(jax.random.key(0), CFG5)
+    state = rt.init_state_from(flat)
+    batch = make_batch()
+    ref = flat_loss(flat, batch, CFG5)
+    np.testing.assert_allclose(float(rt.eval_loss(state, batch)), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_uneven_1f1b_training_matches_flat_trajectory():
+    """Two 1F1B steps at division [3, 2] track a manual flat AdamW loop —
+    padding slots must contribute zero gradient."""
+    hp = HybridParallelConfig.uniform(
+        5, pp=2, tp=1, chunks=2, vocab_tp=1, mixed_precision="fp32",
+        pipeline_type="pipedream_flush",
+    )
+    hp.pp_division = [3, 2]
+    rt = build_runtime(CFG5, hp, adam=ADAM, global_batch_size=8, seq_len=32)
+    flat = modeling.init_model_params(jax.random.key(1), CFG5)
+    state = rt.init_state_from(flat)
+    opt = init_opt_state(flat)
+    pipe_losses, ref_losses = [], []
+    for i in range(2):
+        b = make_batch(seed=i)
+        state, loss = rt.train_step(state, b)
+        pipe_losses.append(float(loss))
+        ref_loss, grads = jax.jit(
+            jax.value_and_grad(lambda p, bb: modeling.lm_loss(p, bb, CFG5))
+        )(flat, b)
+        flat, opt = adamw_update(flat, grads, opt, ADAM)
+        ref_losses.append(float(ref_loss))
+    np.testing.assert_allclose(pipe_losses, ref_losses, rtol=5e-5, atol=5e-5)
+
+
+def test_default_division_pp4_ragged():
+    """26-layer-style case scaled down: 6 layers at pp=4 auto-divides
+    (balanced_division) and trains without an explicit pp_division."""
+    cfg = CFG5.replace(num_layers=6)
+    hp = HybridParallelConfig.uniform(
+        6, pp=4, tp=1, chunks=2, mixed_precision="fp32", pipeline_type="gpipe"
+    )
+    assert sorted(hp.pp_division) == [1, 1, 2, 2]  # balanced default
+    rt = build_runtime(cfg, hp, adam=ADAM, global_batch_size=8, seq_len=32)
+    flat = modeling.init_model_params(jax.random.key(2), cfg)
+    state = rt.init_state_from(flat)
+    batch = make_batch()
+    ref = flat_loss(flat, batch, cfg)
+    np.testing.assert_allclose(float(rt.eval_loss(state, batch)), ref, rtol=2e-5, atol=2e-5)
+    state, loss = rt.train_step(state, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_memory_balanced_division():
+    # heterogeneous layer memories equalize per-stage totals
+    assert pp_division_memory_balanced([10] * 4 + [40] * 4, 2) == [5, 3]
+    # uniform memories: near-even split, early stages lighter (reference bias)
+    div = pp_division_memory_balanced([1.0] * 26, 4)
+    assert sum(div) == 26 and len(div) == 4 and min(div) >= 1
+    assert div[0] == min(div)
+    # per-stage other memory shifts layers away from the loaded stage
+    div2 = pp_division_memory_balanced([1.0] * 8, 2, other_mem_per_stage_mb=[4.0, 0.0])
+    assert div2[0] < div2[1]
+    # degenerate cases
+    assert pp_division_memory_balanced([1.0] * 7, 1) == [7]
+    with pytest.raises(ValueError):
+        pp_division_memory_balanced([1.0] * 3, 4)
+
+
+def test_search_emits_ragged_division_and_runtime_accepts(tmp_path):
+    """Search→train closure for a ragged layer count (5 layers, pp=2): the
+    emitted config carries pp_division and builds + trains."""
+    from galvatron_tpu.search.cost_model import (
+        ProfiledHardware,
+        ProfiledLayerType,
+        ProfiledModelCosts,
+    )
+    from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+
+    lt = ProfiledLayerType(
+        fwd_ms_per_sample=2.0,
+        parameter_mb=80.0,
+        activation_mb_per_sample={1: 40.0, 2: 20.0, 4: 10.0, 8: 5.0},
+        boundary_activation_mb_per_sample=4.0,
+    )
+    costs = ProfiledModelCosts(
+        layer_types={0: lt}, other_param_mb=100.0, other_act_mb_per_sample=8.0,
+        other_fwd_ms_per_sample=0.3,
+    )
+    hw = ProfiledHardware(
+        allreduce_bw={"2_1": 150.0, "2_0": 30.0, "4_1": 140.0, "8_1": 120.0},
+        p2p_bw={2: 50.0, 4: 50.0},
+        overlap_coe=1.1,
+    )
+    eng = SearchEngine(
+        costs, hw, num_layers=5,
+        space=SearchSpace(world_size=8, pp_choices=[2], max_tp=2),
+        memory_budget_mb=20000.0,
+    )
+    r = eng.evaluate(2, 8, 2, "gpipe")
+    assert r is not None
+    assert r.config.pp_division is not None and sum(r.config.pp_division) == 5
+    path = tmp_path / "ragged.json"
+    eng.save_result(r, str(path))
+    hp = HybridParallelConfig.load(str(path))
+    hp.validate(8)
+    assert hp.pp_division == r.config.pp_division
+    rt = build_runtime(CFG5, hp, adam=ADAM, global_batch_size=8, seq_len=32)
+    state = rt.init_state(jax.random.key(0))
+    state, loss = rt.train_step(state, make_batch())
+    assert np.isfinite(float(loss))
