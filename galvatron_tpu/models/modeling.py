@@ -76,6 +76,11 @@ class ModelConfig:
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_sinkhorn_iters: int = 8
+    # (mesh, ep_axes, token_axes) installed by the layer hooks for ep>1
+    # layers so moe_block can pin dispatch-buffer shardings (keeps the
+    # expert all-to-all at the dispatch einsum instead of an SPMD
+    # replicate-and-repartition). None → unconstrained (single-device paths).
+    moe_shard_ctx: Optional[Any] = None
     # vision families (reference legacy vit/swin model_type branches,
     # galvatron/core/parallel.py:64-89, cost_model.py:76,87-106).
     # image_size > 0 switches the input pipeline from token ids to uint8

@@ -107,9 +107,15 @@ def init_moe_params(key, cfg) -> Params:
 def moe_annotations(cfg) -> Params:
     """Logical axes: 'ep' shards the expert dim over the expert-parallel mesh
     axes; within an expert the FFN dims carry the usual Megatron 'tp'
-    column/row sharding; 'fsdp' dims ZeRO-shard over the non-EP data axes."""
+    column/row sharding; 'fsdp' dims ZeRO-shard over the non-EP data axes.
+
+    The router weight stays replicated: it is a tiny (h, E) matrix, and
+    ZeRO-sharding its h dim propagates an h-sharding onto the flattened
+    token activations, which forced an SPMD "involuntary full
+    rematerialization" (replicate-then-repartition) on the dispatch reshape
+    — measurable HBM traffic for ~zero memory savings."""
     a: Params = {
-        "router": {"w": ("fsdp", None)},
+        "router": {"w": (None, None)},
         "w1": ("ep", "fsdp", "tp"),
         "w2": ("ep", "tp", "fsdp"),
     }
@@ -120,21 +126,49 @@ def moe_annotations(cfg) -> Params:
 
 def moe_block(x: jax.Array, p: Params, cfg, train: bool = True) -> jax.Array:
     """Switch-MoE MLP on a (B, S, H) activation (SwitchMLP.forward equivalent,
-    reference: transformer.py:210-295)."""
+    reference: transformer.py:210-295).
+
+    When ``cfg.moe_shard_ctx`` is installed (layer hooks, ep>1), the token-
+    side tensors are pinned to the token/batch sharding and the per-expert
+    buffers to the ep sharding, so the expert all-to-all happens exactly at
+    the dispatch/combine einsums — without the pins, sharding propagation
+    let the backward pick an SPMD replicate-and-repartition ("involuntary
+    full rematerialization") on the dispatch reshape."""
+    from jax.sharding import PartitionSpec as P
+
+    ctx = cfg.moe_shard_ctx
+
+    def pin_tok(a):  # (T, ...) token-major
+        if ctx is None:
+            return a
+        from galvatron_tpu.parallel.sharding import constrain
+
+        mesh, _, tok_ax = ctx
+        return constrain(a, mesh, P(tok_ax, *([None] * (a.ndim - 1))))
+
+    def pin_ep(a):  # (E, ...) expert-major
+        if ctx is None:
+            return a
+        from galvatron_tpu.parallel.sharding import constrain
+
+        mesh, ep_ax, _ = ctx
+        return constrain(a, mesh, P(ep_ax, *([None] * (a.ndim - 1))))
+
     b, s, h = x.shape
     T = b * s
     E = cfg.moe_experts
-    xt = x.reshape(T, h)
+    xt = pin_tok(x.reshape(T, h))
     logits = xt.astype(jnp.float32) @ p["router"]["w"].astype(jnp.float32)  # (T, E)
     C = moe_capacity(T, E, cfg.moe_capacity_factor)
     dispatch, combine = route_top1(
         logits, C, sinkhorn_iters=cfg.moe_sinkhorn_iters, train=train
     )
+    dispatch, combine = pin_tok(dispatch), pin_tok(combine)
 
     # scatter tokens into per-expert buffers: (E, C, H). XLA turns the expert
     # dim's sharding mismatch (tokens batch-sharded vs experts ep-sharded)
     # into the expert-parallel all-to-all.
-    xe = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
+    xe = pin_ep(jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt))
     w1 = p["w1"].astype(x.dtype)
     w2 = p["w2"].astype(x.dtype)
     if cfg.act_fn == "swiglu":
@@ -144,6 +178,6 @@ def moe_block(x: jax.Array, p: Params, cfg, train: bool = True) -> jax.Array:
         )
     else:
         hmid = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", xe, w1), approximate=True)
-    ye = jnp.einsum("ecf,efh->ech", hmid, w2)
-    yt = jnp.einsum("tec,ech->th", combine.astype(x.dtype), ye)
+    ye = pin_ep(jnp.einsum("ecf,efh->ech", hmid, w2))
+    yt = pin_tok(jnp.einsum("tec,ech->th", combine.astype(x.dtype), ye))
     return yt.reshape(b, s, h)

@@ -163,6 +163,14 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
         layer_cfg = cfg
         if s.cp > 1 and s.cp_impl == "ring":
             layer_cfg = cfg.replace(attn_impl="ring")
+        if cfg.moe_experts > 0 and s.ep > 1:
+            layer_cfg = layer_cfg.replace(
+                moe_shard_ctx=(
+                    mesh,
+                    axes.ep_axes(s.tp, s.tp_consec, s.ep),
+                    batch_spec(axes, s)[0],
+                )
+            )
         cos_sin = (
             modeling.rope_tables(layer_cfg, x.shape[1]) if layer_cfg.pos_embed == "rope" else None
         )

@@ -309,6 +309,15 @@ def make_block_fn(
         )
         for j, s in enumerate(strategies):
             x = constrain(x, mesh, act_spec(s))
+            layer_cfg = cfg
+            if cfg.moe_experts > 0 and s.ep > 1:
+                layer_cfg = cfg.replace(
+                    moe_shard_ctx=(
+                        mesh,
+                        axes.ep_axes(s.tp, s.tp_consec, s.ep),
+                        batch_spec(axes, s)[0],
+                    )
+                )
 
             def run(x_, lp_):
                 if s.cp > 1:
@@ -321,7 +330,8 @@ def make_block_fn(
 
                     return ring_decoder_layer(x_, lp_, cfg, mesh, cp_axes, cos_sin)
                 return modeling.decoder_layer(
-                    x_, lp_, cfg, cos_sin, alibi, remat_attn=(s.ckpt == "selective")
+                    x_, lp_, layer_cfg, cos_sin, alibi,
+                    remat_attn=(s.ckpt == "selective"),
                 )
 
             if s.ckpt == "full":

@@ -31,28 +31,12 @@ from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
 from galvatron_tpu.search.cost_model import ProfiledLayerType, ProfiledModelCosts
 
-
-def layer_param_count(cfg: ModelConfig) -> int:
-    h, f = cfg.hidden_size, cfg.ffn
-    attn = h * cfg.num_heads * cfg.head_dim + 2 * h * cfg.kv_heads * cfg.head_dim + cfg.num_heads * cfg.head_dim * h
-    mlp = (3 if cfg.act_fn == "swiglu" else 2) * h * f
-    norms = 2 * h * (2 if cfg.norm_type == "layernorm" else 1)
-    bias = 0
-    if cfg.use_bias:  # qkv slots + wo (+ dense-MLP biases; MoE MLPs carry none)
-        bias = 3 * cfg.num_heads * cfg.head_dim + h
-        if cfg.moe_experts == 0:
-            bias += (2 * f if cfg.act_fn == "swiglu" else f) + h
-    return attn + mlp + norms + bias
-
-
-def other_param_count(cfg: ModelConfig) -> int:
-    n = cfg.vocab_size * cfg.hidden_size
-    if cfg.pos_embed == "learned":
-        n += cfg.max_seq_len * cfg.hidden_size
-    if not cfg.tie_word_embeddings:
-        n += cfg.hidden_size * cfg.vocab_size
-    n += cfg.hidden_size * (2 if cfg.norm_type == "layernorm" else 1)
-    return n
+# Single source of truth for analytic parameter counts (MoE-aware: the
+# expert-stack branch matters — a dense count here once made
+# moe_expert_param_fraction exceed 1 and turned dense_mb negative in the
+# cost model).
+from galvatron_tpu.search import theoretical
+from galvatron_tpu.search.theoretical import layer_param_count, other_param_count
 
 
 def measure_strategy_ms(
@@ -219,7 +203,7 @@ def profile_model(
     # theoretical.py uses the same derivation)
     moe_frac, moe_a2a = 0.0, 0.0
     if cfg.moe_experts > 0:
-        moe_frac = (cfg.moe_experts * 3 * cfg.hidden_size * cfg.ffn) / p_layer
+        moe_frac = theoretical.moe_expert_params(cfg) / p_layer
         moe_a2a = 2.0 * seq * cfg.hidden_size * 2 / 1e6  # bf16, each way
     costs = ProfiledModelCosts(
         layer_types={

@@ -58,6 +58,15 @@ class ProfiledLayerType:
     moe_expert_param_fraction: float = 0.0
     moe_a2a_mb_per_sample: float = 0.0
 
+    def __post_init__(self):
+        if not (0.0 <= self.moe_expert_param_fraction < 1.0):
+            raise ValueError(
+                "moe_expert_param_fraction must be in [0, 1) — it is the "
+                "expert-stack share of parameter_mb (a value >= 1 means the "
+                "per-layer param count ignored the expert stack, which would "
+                f"drive dense memory negative); got {self.moe_expert_param_fraction}"
+            )
+
     def act_mb(self, tp: int, sp: bool, cp: int = 1) -> float:
         base = self.activation_mb_per_sample.get(tp)
         if base is None:  # extrapolate ~1/tp from the closest profiled degree

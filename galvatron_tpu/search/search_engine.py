@@ -182,14 +182,9 @@ class SearchEngine:
         division: Optional[List[int]] = None
         if pp > 1 and self.L % pp:
             # single layer type here (heterogeneous types return None above),
-            # so one baseline cost covers every layer; tp=1 pure-dp baseline
-            # mirrors the reference (:598)
-            base_mb = layer_memory_cost(
-                self._layer_type(0), LayerStrategy(), world, pp, global_bsz,
-                chunks, stage_idx=0, pipeline_type=pipeline_type,
-                mixed_precision=self.mp,
-            ).total_mb
-            division = pp_division_memory_balanced([base_mb] * self.L, pp)
+            # and the balanced division is scale-invariant over uniform
+            # memories — unit weights give the same split as any baseline cost
+            division = pp_division_memory_balanced([1.0] * self.L, pp)
             lps = max(division)
         cands = generate_layer_strategies(space, pp)
         # the micro-batch (global_bsz / chunks) must split over each

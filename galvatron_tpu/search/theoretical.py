@@ -25,14 +25,21 @@ from galvatron_tpu.models.modeling import ModelConfig
 _BYTES = {"fp32": 4, "bf16": 2, "fp16": 2}
 
 
+def moe_expert_params(cfg: ModelConfig) -> int:
+    """Parameters in the expert stack (shardable by ep): E MLPs, w1/w2
+    (+ w3 for swiglu) — matches moe.init_moe_params."""
+    mats = 3 if cfg.act_fn == "swiglu" else 2
+    return cfg.moe_experts * mats * cfg.hidden_size * cfg.ffn
+
+
 def layer_param_count(cfg: ModelConfig) -> int:
     """Exact per-decoder-layer parameter count (matches init_layer_params)."""
     h, hd = cfg.hidden_size, cfg.head_dim
     q_out, kv_out = cfg.num_heads * hd, cfg.kv_heads * hd
     attn = h * q_out + 2 * h * kv_out + q_out * h
     if cfg.moe_experts > 0:
-        # router + per-expert swiglu MLPs
-        mlp = h * cfg.moe_experts + cfg.moe_experts * 3 * h * cfg.ffn
+        # router + per-expert MLPs
+        mlp = h * cfg.moe_experts + moe_expert_params(cfg)
     elif cfg.act_fn == "swiglu":
         mlp = 3 * h * cfg.ffn
     else:
@@ -168,8 +175,7 @@ def analytic_model_costs(
     frac = 0.0
     a2a = 0.0
     if cfg.moe_experts > 0:
-        exp_params = cfg.moe_experts * 3 * cfg.hidden_size * cfg.ffn
-        frac = exp_params / p_layer
+        frac = moe_expert_params(cfg) / p_layer
         a2a = 2.0 * S * cfg.hidden_size * b / 1e6
     return ProfiledModelCosts(
         layer_types={

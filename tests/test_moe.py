@@ -204,3 +204,32 @@ def test_moe_expert_parallel_train_step():
     assert np.isfinite(float(loss))
     state, loss2 = rt.train_step(state, batch)
     assert float(loss2) < float(loss)  # training reduces loss on a repeated batch
+
+
+def test_moe_profiled_costs_search():
+    """Profiled (not analytic) MoE costs feed the search sanely: the expert
+    param fraction is a true fraction and searched memory stays positive —
+    regression for the dense-count bug that drove dense_mb negative."""
+    from galvatron_tpu.profiling.model import layer_param_count, profile_model
+    from galvatron_tpu.search.cost_model import ProfiledHardware, layer_memory_cost
+    from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+
+    cfg = small_moe_cfg()
+    # the unified count includes the expert stack (router + E swiglu MLPs)
+    dense = layer_param_count(cfg.replace(moe_experts=0))
+    assert layer_param_count(cfg) > dense
+    costs = profile_model(cfg, bsz=8, measure_time=False)
+    lt = costs.layer_types[0]
+    assert 0.0 < lt.moe_expert_param_fraction < 1.0
+    mc = layer_memory_cost(
+        lt, LayerStrategy(tp=1, dp_type="ddp", ep=2), world=8, pp=1,
+        global_bsz=8, chunks=1, mixed_precision="bf16",
+    )
+    assert mc.states_mb > 0 and mc.total_mb > 0
+    eng = SearchEngine(
+        costs, ProfiledHardware(), num_layers=2,
+        space=SearchSpace(world_size=8, allow_ep=True, moe_experts=4, max_tp=2),
+        memory_budget_mb=20000.0,
+    )
+    r = eng.search([8])
+    assert r is not None and r.memory_mb > 0
