@@ -43,7 +43,13 @@ from galvatron_tpu.core.schedules import (
 from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
 from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
-from galvatron_tpu.parallel.mesh import MeshAxes, batch_spec, build_mesh, global_batch_spec
+from galvatron_tpu.parallel.mesh import (
+    MeshAxes,
+    batch_spec,
+    build_mesh,
+    global_batch_spec,
+    moe_token_axes,
+)
 from galvatron_tpu.parallel.sharding import constrain, param_spec, sharding_tree
 
 
@@ -168,7 +174,7 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
                 moe_shard_ctx=(
                     mesh,
                     axes.ep_axes(s.tp, s.tp_consec, s.ep),
-                    batch_spec(axes, s)[0],
+                    moe_token_axes(axes, s),
                 )
             )
         cos_sin = (

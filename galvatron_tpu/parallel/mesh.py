@@ -131,6 +131,20 @@ def batch_spec(axes: MeshAxes, s: LayerStrategy) -> P:
     return P(dp or None, seq_axes or None)
 
 
+def moe_token_axes(axes: MeshAxes, s: LayerStrategy) -> Tuple[str, ...]:
+    """Axes sharding the flattened (B·S) token dim for MoE dispatch: the
+    batch axes plus (under SP/CP) the sequence axes — the row-major
+    (B, S, H) → (B·S, H) merge keeps the product sharding."""
+    bs = batch_spec(axes, s)
+
+    def flat(e) -> Tuple[str, ...]:
+        if e is None:
+            return ()
+        return (e,) if isinstance(e, str) else tuple(e)
+
+    return flat(bs[0]) + flat(bs[1])
+
+
 def global_batch_spec(axes: MeshAxes) -> P:
     """Sharding for the raw token batch: all data axes (dataloader layout)."""
     return P(axes.data_axes or None, None)

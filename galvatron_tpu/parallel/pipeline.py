@@ -62,7 +62,7 @@ from galvatron_tpu.core.strategy import (
 )
 from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
-from galvatron_tpu.parallel.mesh import MeshAxes, batch_spec
+from galvatron_tpu.parallel.mesh import MeshAxes, batch_spec, moe_token_axes
 from galvatron_tpu.parallel.sharding import constrain, param_spec, sharding_tree
 
 def cpu_sim_compiler_options():
@@ -315,7 +315,7 @@ def make_block_fn(
                     moe_shard_ctx=(
                         mesh,
                         axes.ep_axes(s.tp, s.tp_consec, s.ep),
-                        batch_spec(axes, s)[0],
+                        moe_token_axes(axes, s),
                     )
                 )
 

@@ -219,6 +219,49 @@ def other_memory_cost(
     return states + act
 
 
+def other_time_cost(
+    costs: ProfiledModelCosts,
+    hw: ProfiledHardware,
+    world: int,
+    pp: int,
+    vocab_tp: int,
+    embed_dp_type: str,
+    global_bsz: int,
+    mixed_precision: str = "bf16",
+) -> float:
+    """Embedding/head/loss time (ms) per iteration under the vocab strategy
+    (the whole-model extension the reference prices via hp_config_whole_model,
+    galvatron/core/hybrid_parallel_config.py:141-179). Compute is spread over
+    the full mesh regardless of vocab_tp (batch x vocab shardings cover all
+    devices); the strategy moves the comm terms: embedding-grad reduction
+    over the dp extent, ZeRO-3 param all-gathers, and the vocab-parallel
+    cross-entropy reductions."""
+    compute = costs.other_fwd_ms_per_sample * global_bsz / world * 3.0
+    dp = world // (pp * vocab_tp)
+    comm_bytes = 0.5 if mixed_precision in ("bf16", "fp16") else 1.0
+    p_mb = costs.other_param_mb / vocab_tp
+    dp_consec = not (vocab_tp > 1)
+    dp_bw = hw.bw(dp, dp_consec)
+    # grad allreduce (ddp) / reduce-scatter+gathers (zero3 ≈ allreduce + 2
+    # param all-gathers), same shape as the layer cost model
+    comm = _allreduce_ms(p_mb * comm_bytes * 2.0, dp, dp_bw)
+    if embed_dp_type == "zero3":
+        comm += 2.0 * _allgather_ms(p_mb * comm_bytes, dp, dp_bw)
+    if vocab_tp > 1 and costs.layer_types:
+        lt0 = next(iter(costs.layer_types.values()))
+        # vocab-parallel embedding: each device holds a vocab shard, so the
+        # (B, S, h) embedding output is a psum over the vocab_tp group, fwd
+        # and mirrored bwd (Megatron VocabParallelEmbedding semantics)
+        act_msg = (
+            lt0.boundary_activation_mb_per_sample * (global_bsz / dp) * comm_bytes
+        )
+        comm += 2.0 * _allreduce_ms(act_msg, vocab_tp, hw.bw(vocab_tp, True))
+        # vocab-parallel cross entropy allreduces per-token max/sumexp/
+        # picked-logit scalars — ≈ 6/h of the boundary volume
+        comm += _allreduce_ms(0.002 * act_msg, vocab_tp, hw.bw(vocab_tp, True))
+    return compute + comm
+
+
 # ---------------------------------------------------------------------------
 # Time cost
 # ---------------------------------------------------------------------------

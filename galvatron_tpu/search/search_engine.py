@@ -33,6 +33,7 @@ from galvatron_tpu.search.cost_model import (
     layer_memory_cost,
     layer_time_cost,
     other_memory_cost,
+    other_time_cost,
     pipeline_time_cost,
 )
 from galvatron_tpu.search.dynamic_programming import run_dp, transition_cost_ms
@@ -70,6 +71,14 @@ def _pow2s(n: int) -> List[int]:
         out.append(v)
         v *= 2
     return out
+
+
+def _vocab_strategy_pairs(world: int, pp: int):
+    """Searched (vocab_tp, embed_dp_type) candidates — one rule shared by
+    evaluate() and check_cost_model()."""
+    for vt in _pow2s(world // pp):
+        for et in ["ddp", "zero3"] if world // (pp * vt) > 1 else ["ddp"]:
+            yield vt, et
 
 
 def generate_layer_strategies(space: SearchSpace, pp: int) -> List[LayerStrategy]:
@@ -198,14 +207,6 @@ class SearchEngine:
             return None
         S = len(cands)
 
-        budget = self.budget_mb - other_memory_cost(
-            self.costs, world, pp, vocab_tp=1, embed_dp_type="zero3" if pp == 1 else "ddp",
-            global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,
-        )
-        if budget <= 0:
-            return None
-        V = int(budget / self.unit)
-
         # positions: pp=1 → every layer; pp>1 → one per stage position (the
         # stage-stacking constraint makes positions the DP unit; vpp>1 tightens
         # the period to layers-per-virtual-stage); memory is identical across
@@ -233,9 +234,57 @@ class SearchEngine:
                     cands[a], cands[b], lt0, self.hw, world, pp, global_bsz, self.mp
                 )
 
-        cost, res, mem_used = run_dp(mem, intra, inter, V)
-        if not np.isfinite(cost) or (res < 0).any():
+        # vocab/embedding strategy is a searched dimension (reference:
+        # --vocab_tp / --embed_sdp, hybrid_parallel_config.py:141-179,
+        # arguments.py:128-130): sweep (vocab_tp, embed_dp_type), re-running
+        # the layer DP only when the remaining budget actually changes
+        dp_cache: Dict[int, tuple] = {}
+        best = None  # (total_ms, res, mem_used, vt, et, other_mb)
+        for vt, et in _vocab_strategy_pairs(world, pp):
+            other_mb = other_memory_cost(
+                self.costs, world, pp, vocab_tp=vt, embed_dp_type=et,
+                global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,
+            )
+            budget = self.budget_mb - other_mb
+            if budget <= 0:
+                continue
+            V = int(budget / self.unit)
+            if V not in dp_cache:
+                dp_cache[V] = run_dp(mem, intra, inter, V)
+            cost, res, mem_used = dp_cache[V]
+            if not np.isfinite(cost) or (res < 0).any():
+                continue
+            if pp > 1:
+                # per-tick stage time: layer compute plus the inter-
+                # position resharding every micro-batch pays on its stage
+                # pass (the transition tables price the full global batch,
+                # so /chunks yields the per-micro-batch share; riding the
+                # tick time lets pipeline_time_cost amplify it by the
+                # fill/steady factor instead of counting it flat)
+                inter_sum = sum(
+                    inter[res[j], res[j + 1]] for j in range(n_pos - 1)
+                )
+                per_stage_ms = (
+                    sum(intra[j, res[j]] for j in range(n_pos)) + inter_sum
+                ) * vpp / chunks
+                boundary_msg = (
+                    lt0.boundary_activation_mb_per_sample
+                    * (global_bsz / chunks)
+                    * (0.5 if self.mp in ("bf16", "fp16") else 1.0)
+                )
+                total_ms = pipeline_time_cost(
+                    [per_stage_ms] * pp, boundary_msg, pp, chunks, self.hw, vpp=vpp
+                )
+            else:
+                total_ms = cost
+            total_ms += other_time_cost(
+                self.costs, self.hw, world, pp, vt, et, global_bsz, self.mp
+            )
+            if best is None or total_ms < best[0]:
+                best = (total_ms, res, mem_used, vt, et, other_mb)
+        if best is None:
             return None
+        total_ms, res, mem_used, vocab_tp, embed_dp_type, other_mb = best
 
         chosen = [cands[k] for k in res]
         if pp > 1:
@@ -247,24 +296,9 @@ class SearchEngine:
                 ]
             else:
                 layer_strategies = chosen * (pp * vpp)
-            per_stage_ms = sum(intra[j, res[j]] for j in range(n_pos)) * vpp / chunks
-            stage_ms = [per_stage_ms] * pp
-            boundary_msg = (
-                lt0.boundary_activation_mb_per_sample
-                * (global_bsz / chunks)
-                * (0.5 if self.mp in ("bf16", "fp16") else 1.0)
-            )
-            total_ms = pipeline_time_cost(
-                stage_ms, boundary_msg, pp, chunks, self.hw, vpp=vpp
-            )
-            total_ms += sum(
-                inter[res[j], res[j + 1]] for j in range(n_pos - 1)
-            )
         else:
             layer_strategies = chosen
-            total_ms = cost
 
-        total_ms += self.costs.other_fwd_ms_per_sample * global_bsz / world * 3.0
         hp = HybridParallelConfig(
             pp=pp,
             vpp=vpp,
@@ -272,8 +306,8 @@ class SearchEngine:
             pp_division=division,
             chunks=chunks,
             pipeline_type=pipeline_type,
-            vocab_tp=1,
-            embed_dp_type="zero3" if pp == 1 else "ddp",
+            vocab_tp=vocab_tp,
+            embed_dp_type=embed_dp_type,
             mixed_precision=self.mp,
             default_dp_type="ddp",
         )
@@ -282,8 +316,13 @@ class SearchEngine:
             cost_ms=float(total_ms),
             throughput_samples_per_s=global_bsz / (total_ms / 1000.0),
             global_bsz=global_bsz,
-            memory_mb=float(mem_used * self.unit),
-            details={"pp": pp, "vpp": vpp, "chunks": chunks, "pipeline_type": pipeline_type},
+            memory_mb=float(mem_used * self.unit + other_mb),
+            details={
+                "pp": pp, "vpp": vpp, "chunks": chunks,
+                "pipeline_type": pipeline_type,
+                "vocab_tp": vocab_tp, "embed_dp_type": embed_dp_type,
+                "other_memory_mb": float(other_mb),
+            },
         )
 
     # -- full optimization loop ---------------------------------------------
@@ -390,12 +429,21 @@ class SearchEngine:
                 f"{form_strategy(s, pp, dp):>16} | {mc.states_mb:9.1f} | "
                 f"{mc.activation_mb:8.1f} | {mc.total_mb:8.1f} | {t:8.2f}"
             )
-        other = other_memory_cost(
-            self.costs, world, pp, vocab_tp=1,
-            embed_dp_type="zero3" if pp == 1 else "ddp",
-            global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,
+        # vocab/embedding strategy tradeoff (searched dimension)
+        lines.append(
+            f"{'vocab strategy':>16} | {'other MB':>9} | {'other ms':>8}"
         )
-        lines.append(f"other (embed/head) memory: {other:.1f} MB")
+        for vt in _pow2s(world // pp):
+            for et in ["ddp", "zero3"] if world // (pp * vt) > 1 else ["ddp"]:
+                omb = other_memory_cost(
+                    self.costs, world, pp, vocab_tp=vt, embed_dp_type=et,
+                    global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,
+                )
+                oms = other_time_cost(
+                    self.costs, self.hw, world, pp, vt, et, global_bsz, self.mp
+                )
+                tag = f"vtp{vt}-{et}"
+                lines.append(f"{tag:>16} | {omb:9.1f} | {oms:8.2f}")
         return "\n".join(lines)
 
     def save_result(self, result: SearchResult, path: str) -> None:

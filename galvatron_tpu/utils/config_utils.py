@@ -82,16 +82,15 @@ def load_profiled_model(time_path: str, mem_path: str) -> ProfiledModelCosts:
             continue
         i = int(key.split("_")[1])
         m = mem[key]
-        layer_types[i] = ProfiledLayerType(
-            fwd_ms_per_sample=float(t),
-            parameter_mb=float(m["parameter_mb"]),
-            activation_mb_per_sample={
-                int(k): float(v) for k, v in m["activation_mb_per_sample"].items()
-            },
-            boundary_activation_mb_per_sample=float(m["boundary_activation_mb_per_sample"]),
-            moe_expert_param_fraction=float(m.get("moe_expert_param_fraction", 0.0)),
-            moe_a2a_mb_per_sample=float(m.get("moe_a2a_mb_per_sample", 0.0)),
-        )
+        try:
+            layer_types[i] = _load_layer_type(t, m)
+        except ValueError as e:
+            raise ValueError(
+                f"profile {mem_path!r} ({key}) carries invalid data — likely "
+                "written by an older profiler revision (a pre-fix MoE profile "
+                "has moe_expert_param_fraction > 1): re-run `profile` to "
+                f"regenerate it. Original error: {e}"
+            ) from e
     other = mem.get("other", {})
     other_ms = times.get("other", other.get("fwd_ms_per_sample", 0.0))
     return ProfiledModelCosts(
@@ -99,6 +98,19 @@ def load_profiled_model(time_path: str, mem_path: str) -> ProfiledModelCosts:
         other_param_mb=float(other.get("param_mb", 0.0)),
         other_act_mb_per_sample=float(other.get("act_mb_per_sample", 0.0)),
         other_fwd_ms_per_sample=float(other_ms),
+    )
+
+
+def _load_layer_type(t, m) -> ProfiledLayerType:
+    return ProfiledLayerType(
+        fwd_ms_per_sample=float(t),
+        parameter_mb=float(m["parameter_mb"]),
+        activation_mb_per_sample={
+            int(k): float(v) for k, v in m["activation_mb_per_sample"].items()
+        },
+        boundary_activation_mb_per_sample=float(m["boundary_activation_mb_per_sample"]),
+        moe_expert_param_fraction=float(m.get("moe_expert_param_fraction", 0.0)),
+        moe_a2a_mb_per_sample=float(m.get("moe_a2a_mb_per_sample", 0.0)),
     )
 
 

@@ -233,3 +233,28 @@ def test_moe_profiled_costs_search():
     )
     r = eng.search([8])
     assert r is not None and r.memory_mb > 0
+
+
+def test_moe_sp_with_ep_trains():
+    """sp=True + ep>1 is a legal searched combination: the token-dim pin must
+    include the SP sequence axes (regression: pin_tok once used the batch
+    axes only, forcing a seq all-gather over the tp group before routing)."""
+    from galvatron_tpu.core.optim import AdamConfig
+    from galvatron_tpu.parallel.hybrid import build_runtime
+
+    cfg = small_moe_cfg()
+    hp = HybridParallelConfig(
+        pp=1,
+        layer_strategies=[LayerStrategy(tp=2, sp=True, dp_type="zero3", ep=2)] * 2,
+        vocab_tp=2,
+        mixed_precision="fp32",
+    )
+    rt = build_runtime(cfg, hp, adam=AdamConfig(lr=3e-3), global_batch_size=8, seq_len=16)
+    state = rt.init_state(jax.random.key(0))
+    rng = np.random.RandomState(0)
+    batch = jnp.asarray(rng.randint(0, 64, (8, 17)), jnp.int32)
+    losses = []
+    for _ in range(3):
+        state, loss = rt.train_step(state, batch)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]

@@ -204,3 +204,87 @@ def test_vpp_searched_and_reduces_pipeline_cost():
     from galvatron_tpu.core.strategy import HybridParallelConfig
 
     assert HybridParallelConfig.from_json_dict(d).vpp == best.config.vpp
+
+
+def test_vocab_strategy_searched():
+    """vocab_tp x embed_dp_type is a searched dimension (reference:
+    --vocab_tp/--embed_sdp): a huge embedding under a tight budget forces the
+    search off vocab_tp=1/ddp; a roomy budget keeps the comm-free default."""
+    lt = ProfiledLayerType(
+        fwd_ms_per_sample=2.0,
+        parameter_mb=80.0,
+        activation_mb_per_sample={1: 40.0, 2: 20.0, 4: 10.0, 8: 5.0},
+        boundary_activation_mb_per_sample=4.0,
+    )
+    big_embed = ProfiledModelCosts(
+        layer_types={0: lt}, other_param_mb=4000.0, other_act_mb_per_sample=8.0,
+        other_fwd_ms_per_sample=0.3,
+    )
+    hw = ProfiledHardware(
+        allreduce_bw={"2_1": 150.0, "2_0": 30.0, "4_1": 140.0, "8_1": 120.0},
+        overlap_coe=1.1,
+    )
+    space = SearchSpace(world_size=8, pp_choices=[1], max_tp=2)
+    roomy = SearchEngine(big_embed, hw, 4, space, memory_budget_mb=50000.0).search([8])
+    tight = SearchEngine(big_embed, hw, 4, space, memory_budget_mb=2600.0).search([8])
+    assert roomy is not None and tight is not None
+    # a 4 GB embedding's per-step grad allreduce dwarfs the vocab-TP
+    # activation psums: sharding must win even with a roomy budget
+    assert roomy.config.vocab_tp > 1 or roomy.config.embed_dp_type == "zero3"
+    # 4 GB fp32 embedding states (~18 GB with grads+Adam) cannot fit 2.6 GB
+    # unsharded: the searched vocab strategy must shard it
+    t = tight.config
+    assert t.vocab_tp > 1 or t.embed_dp_type == "zero3", (t.vocab_tp, t.embed_dp_type)
+    assert tight.details["other_memory_mb"] <= roomy.details["other_memory_mb"]
+    # small embedding + roomy budget: comm terms are minor either way, but
+    # the sweep must price vocab_tp (details carry the searched choice)
+    small = ProfiledModelCosts(
+        layer_types={0: lt}, other_param_mb=10.0, other_act_mb_per_sample=8.0,
+        other_fwd_ms_per_sample=0.3,
+    )
+    r2 = SearchEngine(small, hw, 4, space, memory_budget_mb=50000.0).search([8])
+    assert "vocab_tp" in r2.details and "embed_dp_type" in r2.details
+
+
+def test_transition_costs_ride_pipeline_ticks():
+    """Inter-position resharding is paid per micro-batch stage pass: its
+    contribution to a pp>1 prediction must carry the pipeline fill/steady
+    amplification (~(chunks+pp-1)/chunks x the flat per-iteration volume),
+    not be added flat (the old 1x under-count)."""
+    import galvatron_tpu.search.search_engine as se
+
+    lt = ProfiledLayerType(
+        fwd_ms_per_sample=2.0,
+        parameter_mb=80.0,
+        activation_mb_per_sample={1: 40.0, 2: 20.0, 4: 10.0, 8: 5.0},
+        boundary_activation_mb_per_sample=4.0,
+    )
+    costs = ProfiledModelCosts(
+        layer_types={0: lt}, other_param_mb=100.0, other_act_mb_per_sample=8.0,
+        other_fwd_ms_per_sample=0.0,
+    )
+    hw = ProfiledHardware(overlap_coe=1.0)
+    space = SearchSpace(
+        world_size=8, pp_choices=[2], max_tp=1, allow_sp=False, allow_ckpt=False,
+        allow_zero2=False, allow_zero3=False, allow_strided=False,
+    )
+    eng = SearchEngine(costs, hw, 4, space, memory_budget_mb=50000.0)
+
+    K = 7.0  # ms of resharding per boundary per iteration (global volume)
+    orig = se.transition_cost_ms
+    try:
+        se.transition_cost_ms = lambda a, b, *r, **kw: K  # every boundary pays
+        pp, chunks = 2, 4
+        with_t = eng.evaluate(pp, 16, chunks, "gpipe")
+        se.transition_cost_ms = lambda a, b, *r, **kw: 0.0
+        without = eng.evaluate(pp, 16, chunks, "gpipe")
+    finally:
+        se.transition_cost_ms = orig
+    assert with_t is not None and without is not None
+    n_boundaries = 4 // pp - 1  # positions per stage - 1
+    delta = with_t.cost_ms - without.cost_ms
+    # per-tick share K/chunks, amplified by the (chunks + pp - 1) ticks every
+    # stage's clock runs (pipeline_time_cost: sum + bottleneck*(chunks-1))
+    expected = n_boundaries * K / chunks * (chunks + pp - 1)
+    assert abs(delta - expected) < 1e-6, (delta, expected)
+    assert delta > n_boundaries * K  # strictly more than the old flat count

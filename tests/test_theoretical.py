@@ -76,7 +76,8 @@ def test_check_cost_model_table():
         space=SearchSpace(world_size=8), memory_budget_mb=16000,
     )
     table = eng.check_cost_model(global_bsz=8)
-    assert "states MB" in table and "other (embed/head)" in table
+    assert "states MB" in table and "vocab strategy" in table
+    assert "vtp2-zero3" in table  # vocab-TP tradeoff rows (searched dimension)
     # every generated strategy appears as a row
     assert table.count("\n") >= 4
     # explicit strategies path
