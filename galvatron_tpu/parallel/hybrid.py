@@ -248,11 +248,6 @@ def build_runtime(
             "assume a causal mask); encoder models must use tp/sp instead"
         )
     if cfg.enc_layers > 0:
-        if hp.pp > 1:
-            raise ValueError(
-                "encoder-decoder models run at pp=1 (the SPMD stage stacking "
-                "needs homogeneous layer pytrees; enc and dec layers differ)"
-            )
         if any(s.cp > 1 for s in hp.layer_strategies):
             raise ValueError("context parallelism is not supported for enc-dec models")
     if cfg.swin_depths and hp.pp > 1:
@@ -276,6 +271,14 @@ def build_runtime(
         scaler_cfg = LossScalerConfig()
 
     if hp.pp > 1:
+        if cfg.enc_layers > 0:
+            from galvatron_tpu.parallel.pipeline_encdec import (
+                build_encdec_pipeline_runtime,
+            )
+
+            return build_encdec_pipeline_runtime(
+                cfg, hp, mesh, axes, adam, global_batch_size, seq_len
+            )
         from galvatron_tpu.parallel.pipeline import build_pipeline_runtime
 
         return build_pipeline_runtime(cfg, hp, mesh, axes, adam, global_batch_size, seq_len)

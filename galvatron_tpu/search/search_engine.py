@@ -160,6 +160,19 @@ class SearchEngine:
         lts = self.costs.layer_types
         return lts.get(i, lts[0]) if len(lts) > 1 else lts[0]
 
+    def _type_groups(self):
+        """Contiguous (start, count, layer_type) runs over layer indices.
+        Grouped by VALUE equality — JSON-loaded profiles materialize a fresh
+        ProfiledLayerType per index, so identity would split every layer."""
+        groups = []
+        for i in range(self.L):
+            lt = self._layer_type(i)
+            if groups and groups[-1][2] == lt:
+                groups[-1][1] += 1
+            else:
+                groups.append([i, 1, lt])
+        return groups
+
     # -- single (pp, bsz, chunks, pipeline_type) evaluation ------------------
 
     def evaluate(
@@ -169,13 +182,24 @@ class SearchEngine:
         world = space.world_size
         if world % pp or self.L < pp:
             return None
+        multi_type = None  # (n_first, n_second) for a 2-group pp>1 pipeline
         if pp > 1 and len(self.costs.layer_types) > 1:
-            # heterogeneous layer types (Swin pyramid, enc-dec): the runtime's
-            # SPMD stage stacking needs homogeneous layer pytrees, so these
-            # models run at pp=1 (hybrid.build_runtime rejects pp>1) — and the
-            # per-stage-position costing below would mis-cost them anyway
-            # (stage memory is NOT identical across stages)
-            return None
+            # heterogeneous layer types: the enc-dec pipeline (two coupled
+            # sub-pipelines, parallel/pipeline_encdec.py) handles exactly TWO
+            # contiguous type groups whose counts pp divides, gpipe-ordered,
+            # chunks % pp == 0 (the reference's multi-layer-type DP,
+            # dynamic_programming.py:304-455, served the same model class).
+            # Swin pyramids (>2 groups) stay pp=1.
+            groups = self._type_groups()
+            if (
+                len(groups) != 2
+                or any(cnt % pp for _, cnt, _ in groups)
+                or chunks % pp
+                or vpp > 1
+                or pipeline_type != "gpipe"
+            ):
+                return None
+            multi_type = (groups[0][1], groups[1][1])
         if global_bsz % chunks:
             return None
         if vpp > 1:
@@ -210,12 +234,22 @@ class SearchEngine:
         # positions: pp=1 → every layer; pp>1 → one per stage position (the
         # stage-stacking constraint makes positions the DP unit; vpp>1 tightens
         # the period to layers-per-virtual-stage); memory is identical across
-        # stages, stage 0 carries the 1F1B worst case
-        n_pos = self.L if pp == 1 else lps // vpp
+        # stages, stage 0 carries the 1F1B worst case. Multi-type (enc-dec)
+        # pp>1: a device holds one virtual stage of EACH type, so positions =
+        # lpe enc positions followed by lpd dec positions.
+        if multi_type is not None:
+            lpe, lpd = multi_type[0] // pp, multi_type[1] // pp
+            n_pos = lpe + lpd
+            pos_lt = lambda j: (
+                self._layer_type(0) if j < lpe else self._layer_type(multi_type[0])
+            )
+        else:
+            n_pos = self.L if pp == 1 else lps // vpp
+            pos_lt = self._layer_type
         mem = np.zeros((n_pos, S), np.int32)
         intra = np.zeros((n_pos, S), np.float64)
         for j in range(n_pos):
-            lt = self._layer_type(j)
+            lt = pos_lt(j)
             for k, s in enumerate(cands):
                 mc = layer_memory_cost(
                     lt, s, world, pp, global_bsz, chunks, stage_idx=0,
@@ -272,9 +306,26 @@ class SearchEngine:
                     * (global_bsz / chunks)
                     * (0.5 if self.mp in ("bf16", "fp16") else 1.0)
                 )
-                total_ms = pipeline_time_cost(
-                    [per_stage_ms] * pp, boundary_msg, pp, chunks, self.hw, vpp=vpp
-                )
+                if multi_type is not None:
+                    # two coupled sub-pipelines (pipeline_encdec.py): every
+                    # tick runs one enc + one dec virtual stage, so per-tick
+                    # time is the full position sum; chunks + 2·pp - 1 ticks
+                    # (the runtime's T); three ppermutes per tick — enc out
+                    # and ctx at the encoder boundary size, dec y at the
+                    # decoder boundary size
+                    bf = 0.5 if self.mp in ("bf16", "fp16") else 1.0
+                    enc_b = self._layer_type(0).boundary_activation_mb_per_sample
+                    dec_b = self._layer_type(
+                        multi_type[0]
+                    ).boundary_activation_mb_per_sample
+                    p2p_mb = (2.0 * enc_b + dec_b) * (global_bsz / chunks) * bf
+                    p2p_ms = p2p_mb / self.hw.p2p(pp)
+                    total_ms = (chunks + 2 * pp - 1) * (per_stage_ms + p2p_ms)
+                else:
+                    total_ms = pipeline_time_cost(
+                        [per_stage_ms] * pp, boundary_msg, pp, chunks, self.hw,
+                        vpp=vpp,
+                    )
             else:
                 total_ms = cost
             total_ms += other_time_cost(
@@ -290,7 +341,10 @@ class SearchEngine:
         if pp > 1:
             # same per-position pattern in every (virtual) stage; uneven
             # divisions truncate the pattern on light stages
-            if division is not None:
+            if multi_type is not None:
+                lpe = multi_type[0] // pp
+                layer_strategies = chosen[:lpe] * pp + chosen[lpe:] * pp
+            elif division is not None:
                 layer_strategies = [
                     chosen[j] for s in range(pp) for j in range(division[s])
                 ]
@@ -433,8 +487,7 @@ class SearchEngine:
         lines.append(
             f"{'vocab strategy':>16} | {'other MB':>9} | {'other ms':>8}"
         )
-        for vt in _pow2s(world // pp):
-            for et in ["ddp", "zero3"] if world // (pp * vt) > 1 else ["ddp"]:
+        for vt, et in _vocab_strategy_pairs(world, pp):
                 omb = other_memory_cost(
                     self.costs, world, pp, vocab_tp=vt, embed_dp_type=et,
                     global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,

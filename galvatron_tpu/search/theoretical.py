@@ -32,11 +32,16 @@ def moe_expert_params(cfg: ModelConfig) -> int:
     return cfg.moe_experts * mats * cfg.hidden_size * cfg.ffn
 
 
-def layer_param_count(cfg: ModelConfig) -> int:
-    """Exact per-decoder-layer parameter count (matches init_layer_params)."""
+def layer_param_count(cfg: ModelConfig, cross: bool = False) -> int:
+    """Exact per-layer parameter count (matches init_layer_params).
+    ``cross``: enc-dec decoder layers carry a cross-attention block
+    (wq + wkv + wo + cross_norm)."""
     h, hd = cfg.hidden_size, cfg.head_dim
     q_out, kv_out = cfg.num_heads * hd, cfg.kv_heads * hd
     attn = h * q_out + 2 * h * kv_out + q_out * h
+    if cross:
+        attn += h * q_out + 2 * h * kv_out + q_out * h
+        attn += h if cfg.norm_type == "rms" else 2 * h  # cross_norm
     if cfg.moe_experts > 0:
         # router + per-expert MLPs
         mlp = h * cfg.moe_experts + moe_expert_params(cfg)
@@ -152,6 +157,8 @@ def analytic_model_costs(
 
     if cfg.image_size:
         return _analytic_vision_costs(cfg, peak_tflops, mfu, mixed_precision)
+    if cfg.enc_layers > 0:
+        return _analytic_encdec_costs(cfg, peak_tflops, mfu, mixed_precision)
     S = seq_len or cfg.max_seq_len
     b = _BYTES[mixed_precision]
     p_layer = layer_param_count(cfg)
@@ -191,6 +198,60 @@ def analytic_model_costs(
         other_param_mb=other_p * 4 / 1e6,
         other_act_mb_per_sample=other_act,
         other_fwd_ms_per_sample=other_flops / (peak_tflops * 1e12 * mfu) * 1e3,
+    )
+
+
+def _analytic_encdec_costs(
+    cfg: ModelConfig, peak_tflops: float, mfu: float, mixed_precision: str
+):
+    """Enc-dec variant: TWO layer types (encoder at enc_seq; decoder with
+    cross-attention at max_seq_len) so the multi-layer-type search — incl.
+    the pp>1 enc-dec pipeline path — gets per-type costs."""
+    from galvatron_tpu.search.cost_model import ProfiledLayerType, ProfiledModelCosts
+
+    b = _BYTES[mixed_precision]
+    S_e, S_d = cfg.enc_seq, cfg.max_seq_len
+    rate = peak_tflops * 1e12 * mfu
+
+    def make_lt(S, cross):
+        p = layer_param_count(cfg, cross=cross)
+        flops = 2.0 * p * S
+        flops += 4.0 * cfg.num_heads * cfg.head_dim * S * S  # self attn
+        if cross:
+            flops += 4.0 * cfg.num_heads * cfg.head_dim * S * S_e  # cross attn
+        act = {
+            tp: layer_activation_mb_per_sample(
+                cfg, LayerStrategy(tp=tp), S, mixed_precision
+            )
+            # cross-attention roughly replays the attention activations
+            * (1.5 if cross else 1.0)
+            for tp in (1, 2, 4, 8)
+            if cfg.hidden_size % tp == 0
+        }
+        frac = moe_expert_params(cfg) / p if cfg.moe_experts > 0 else 0.0
+        a2a = 2.0 * S * cfg.hidden_size * b / 1e6 if cfg.moe_experts > 0 else 0.0
+        return ProfiledLayerType(
+            fwd_ms_per_sample=flops / rate * 1e3,
+            parameter_mb=p * 4 / 1e6,
+            activation_mb_per_sample=act,
+            boundary_activation_mb_per_sample=S * cfg.hidden_size * b / 1e6,
+            moe_expert_param_fraction=frac,
+            moe_a2a_mb_per_sample=a2a,
+        )
+
+    enc_lt = make_lt(S_e, cross=False)
+    dec_lt = make_lt(S_d, cross=True)
+    layer_types = {i: enc_lt for i in range(cfg.enc_layers)}
+    layer_types.update(
+        {cfg.enc_layers + i: dec_lt for i in range(cfg.num_layers)}
+    )
+    other_p = other_param_count(cfg)
+    other_flops = 2.0 * cfg.hidden_size * cfg.vocab_size * S_d
+    return ProfiledModelCosts(
+        layer_types=layer_types,
+        other_param_mb=other_p * 4 / 1e6,
+        other_act_mb_per_sample=S_d * cfg.vocab_size * b / 1e6,
+        other_fwd_ms_per_sample=other_flops / rate * 1e3,
     )
 
 

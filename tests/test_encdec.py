@@ -78,13 +78,40 @@ def test_encdec_parity_tp2_and_heterogeneous():
     assert spec[2] is not None and len(spec[2]) == 2  # two binary axes = tp4
 
 
-def test_encdec_rejects_pp_and_cp():
-    hp = HybridParallelConfig.uniform(4, pp=2, chunks=2, mixed_precision="fp32")
-    with pytest.raises(ValueError, match="pp=1"):
-        build_runtime(T5, hp, adam=AdamConfig(), global_batch_size=8)
+def test_encdec_rejects_cp_and_bad_pipeline_shapes():
     hp2 = HybridParallelConfig.uniform(4, cp=2, mixed_precision="fp32")
     with pytest.raises(ValueError, match="enc-dec"):
         build_runtime(T5, hp2, adam=AdamConfig(), global_batch_size=8)
+    # pipeline constraints: chunks must flow in groups of pp
+    hp3 = HybridParallelConfig.uniform(4, pp=2, chunks=1, mixed_precision="fp32")
+    with pytest.raises(ValueError, match="chunks"):
+        build_runtime(T5, hp3, adam=AdamConfig(), global_batch_size=8)
+    # pp must divide both stacks (enc_layers=2 here)
+    cfg4 = T5.replace(enc_layers=2, num_layers=2)
+    hp4 = HybridParallelConfig.uniform(4, pp=4, chunks=4, mixed_precision="fp32")
+    with pytest.raises(ValueError, match="divide"):
+        build_runtime(cfg4, hp4, adam=AdamConfig(), global_batch_size=8)
+
+
+@pytest.mark.parametrize("tp,dp_type,ckpt", [(1, "ddp", False), (2, "zero3", True)])
+def test_encdec_pp2_parity(tp, dp_type, ckpt):
+    """T5-class pp=2 (two coupled sub-pipelines) matches the flat pp=1 loss
+    on identical weights — the reference pipelines enc-dec by arbitrary stage
+    ranges (core/pipeline/pipeline.py:75-77); this is the capability
+    equivalent."""
+    hp = HybridParallelConfig.uniform(
+        4, pp=2, tp=tp, dp_type=dp_type, ckpt=ckpt, chunks=2,
+        vocab_tp=tp, mixed_precision="fp32",
+    )
+    rt = build_runtime(T5, hp, adam=AdamConfig(lr=1e-3), global_batch_size=8)
+    flat = modeling.init_model_params(jax.random.key(0), T5)
+    state = rt.init_state_from(flat)
+    b = batch()
+    ref = float(jax.jit(lambda p, bb: modeling.lm_loss(p, bb, T5))(flat, b))
+    np.testing.assert_allclose(float(rt.eval_loss(state, b)), ref, rtol=3e-5, atol=3e-5)
+    state, loss = rt.train_step(state, b)
+    state, loss2 = rt.train_step(state, b)
+    assert np.isfinite(float(loss2)) and float(loss2) < float(loss)
 
 
 def test_multi_layer_type_search():
@@ -152,3 +179,54 @@ def test_t5_family_entry(capsys):
     )
     assert rc == 0
     assert "iter 0: loss" in capsys.readouterr().out
+
+
+def test_multi_layer_type_search_pp2():
+    """The multi-layer-type search emits a pp>1 config for enc-dec models
+    (reference: per-stage DP, dynamic_programming.py:304-455) and the config
+    builds + trains through the enc-dec pipeline."""
+    from galvatron_tpu.search.cost_model import (
+        ProfiledHardware,
+        ProfiledLayerType,
+        ProfiledModelCosts,
+    )
+    from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+
+    enc_lt = ProfiledLayerType(
+        fwd_ms_per_sample=1.0, parameter_mb=40.0,
+        activation_mb_per_sample={1: 20.0, 2: 10.0, 4: 5.0},
+        boundary_activation_mb_per_sample=2.0,
+    )
+    dec_lt = ProfiledLayerType(
+        fwd_ms_per_sample=2.5, parameter_mb=70.0,
+        activation_mb_per_sample={1: 40.0, 2: 20.0, 4: 10.0},
+        boundary_activation_mb_per_sample=2.0,
+    )
+    costs = ProfiledModelCosts(
+        layer_types={0: enc_lt, 1: enc_lt, 2: dec_lt, 3: dec_lt},
+        other_param_mb=30.0, other_act_mb_per_sample=4.0,
+        other_fwd_ms_per_sample=0.2,
+    )
+    hw = ProfiledHardware(
+        allreduce_bw={"2_1": 150.0, "2_0": 30.0, "4_1": 140.0, "8_1": 120.0},
+        p2p_bw={2: 50.0}, overlap_coe=1.1,
+    )
+    eng = SearchEngine(
+        costs, hw, num_layers=4,
+        space=SearchSpace(world_size=8, pp_choices=[2], max_tp=2),
+        memory_budget_mb=700.0,
+    )
+    res = eng.search([8])
+    assert res is not None and res.config.pp == 2
+    assert len(res.config.layer_strategies) == 4
+    assert res.config.chunks % 2 == 0 and res.config.pipeline_type == "gpipe"
+    # enc strategies (first 2) may differ from dec strategies (last 2), but
+    # each pair must agree across stages (one virtual stage each here)
+    ls = res.config.layer_strategies
+    assert ls[0] == ls[1] and ls[2] == ls[3]
+    rt = build_runtime(
+        T5, res.config, adam=AdamConfig(lr=1e-3), global_batch_size=8,
+    )
+    state = rt.init_state(jax.random.key(0))
+    state, loss = rt.train_step(state, batch())
+    assert np.isfinite(float(loss))
