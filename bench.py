@@ -25,31 +25,44 @@ import numpy as np
 REF_MS_PER_LAYER_PER_SAMPLE = 4.64
 
 
-def measure(cfg, bsz, seq, iters=6, reps=3):
-    """Best-of-``reps`` timing windows (min is the standard noise-robust
-    estimator for a fixed workload; run-to-run spread through the remote
-    dispatch path is ±0.2 ms/layer/sample otherwise)."""
+def measure(cfg, bsz, seq, iters=6, reps=5):
+    """Best-of-``reps`` timing windows of ``iters`` chained forwards.
+
+    The whole window runs as ONE dispatch (a ``lax.scan`` whose carry makes
+    every iteration data-dependent on the last — XLA cannot fold or reorder
+    it), so a busy host cannot starve the device between iterations: per-iter
+    Python dispatch through the remote tunnel is exactly the contention
+    artifact that inflated driver-captured numbers by ~0.4 ms/layer/sample.
+    Min over windows is the standard noise-robust estimator."""
     from galvatron_tpu.models import modeling
 
     params = modeling.init_model_params(jax.random.key(0), cfg)
     tokens = jnp.zeros((bsz, seq), jnp.int32)
 
-    @jax.jit
-    def fwd(params, tokens):
+    def fwd(params, tokens, c):
         x = modeling.embed(tokens, params, cfg)
+        # the tiny carry-dependent bias chains iterations without touching
+        # the math at bf16 precision
+        x = x + c.astype(x.dtype)
         cos_sin = modeling.rope_tables(cfg, seq)
         for lp in params["layers"]:
             x = modeling.decoder_layer(x, lp, cfg, cos_sin, None)
         return jnp.sum(x.astype(jnp.float32))
 
-    out = fwd(params, tokens)
-    _ = float(out)  # compile + sync
+    @jax.jit
+    def window(params, tokens):
+        def body(c, _):
+            out = fwd(params, tokens, c * 1e-30)
+            return out * 1e-30, None
+
+        c, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=iters)
+        return c
+
+    _ = float(window(params, tokens))  # compile + sync
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fwd(params, tokens)
-        _ = float(out)
+        _ = float(window(params, tokens))
         best = min(best, (time.perf_counter() - t0) / iters * 1000.0)
     return best
 

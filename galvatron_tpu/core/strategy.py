@@ -190,11 +190,9 @@ class HybridParallelConfig:
         if self.vpp > 1:
             if self.pp == 1:
                 raise ValueError("vpp>1 (interleaved schedule) requires pp>1")
-            if self.pipeline_type != "gpipe":
-                raise ValueError(
-                    "vpp>1 is implemented for pipeline_type='gpipe' (the "
-                    "interleaved clocked scan; 1F1B+vpp is future work)"
-                )
+            # vpp composes with both schedules: 'gpipe' = interleaved clocked
+            # scan (autodiff backward), 'pipedream_flush' = interleaved 1F1B
+            # (hand-written mirrored backward wave, bounded activations)
             if self.num_layers % (self.pp * self.vpp) != 0:
                 raise ValueError(
                     f"vpp={self.vpp} needs the layer count {self.num_layers} "

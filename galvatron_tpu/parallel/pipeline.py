@@ -429,6 +429,14 @@ def build_pipeline_runtime(
 
         lpvs = validate_interleaved_strategies(cfg, hp)
         block_fn = make_block_fn(cfg, hp.layer_strategies[:lpvs], mesh, axes)
+        if hp.pipeline_type == "pipedream_flush":
+            from galvatron_tpu.parallel.pipeline_interleaved import (
+                make_interleaved_1f1b_train_step,
+            )
+
+            return make_interleaved_1f1b_train_step(
+                cfg, hp, mesh, axes, adam, global_batch_size, seq_len, block_fn
+            )
         pipe = interleaved_pipeline(block_fn, pp, hp.vpp, chunks, mesh)
         init_params_fn = lambda key: init_interleaved_params(key, cfg, hp)
         param_specs_fn = interleaved_param_specs

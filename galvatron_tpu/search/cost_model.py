@@ -155,6 +155,7 @@ def layer_memory_cost(
     stage_idx: int = 0,
     pipeline_type: str = "gpipe",
     mixed_precision: str = "bf16",
+    vpp: int = 1,
 ) -> MemoryCost:
     """Per-chip memory for one layer under strategy ``s``
     (reference: MemoryCostModel, galvatron/core/cost_model.py:4-122)."""
@@ -190,8 +191,10 @@ def layer_memory_cost(
         act = act_per_mb  # accumulation scan keeps one micro-batch live
     elif pipeline_type == "gpipe":
         act = act_per_mb * chunks
-    else:  # 1F1B: bounded in-flight stash
-        act = act_per_mb * min(chunks, 2 * (pp - 1 - stage_idx) + 1)
+    else:  # 1F1B: bounded in-flight stash (interleaved 1F1B: the mirrored
+        # backward wave holds up to 3*pp+1 micro-batches per virtual stage)
+        bound = 2 * (pp - 1 - stage_idx) + 1 if vpp == 1 else 3 * pp + 1
+        act = act_per_mb * min(chunks, bound)
     return MemoryCost(states, act, states + act)
 
 

@@ -203,8 +203,10 @@ class SearchEngine:
         if global_bsz % chunks:
             return None
         if vpp > 1:
-            # interleaved-schedule constraints (strategy.py validate)
-            if pp == 1 or pipeline_type != "gpipe":
+            # interleaved-schedule constraints (strategy.py validate);
+            # both schedules compose with vpp (gpipe = autodiff backward,
+            # pipedream_flush = interleaved 1F1B, bounded activations)
+            if pp == 1:
                 return None
             if self.L % (pp * vpp) or chunks % pp:
                 return None
@@ -254,6 +256,7 @@ class SearchEngine:
                 mc = layer_memory_cost(
                     lt, s, world, pp, global_bsz, chunks, stage_idx=0,
                     pipeline_type=pipeline_type, mixed_precision=self.mp,
+                    vpp=vpp,
                 )
                 # a device holds vpp layers per searched position (interleaved)
                 mem[j, k] = max(1, int(np.ceil(vpp * mc.total_mb / self.unit)))
@@ -301,11 +304,6 @@ class SearchEngine:
                 per_stage_ms = (
                     sum(intra[j, res[j]] for j in range(n_pos)) + inter_sum
                 ) * vpp / chunks
-                boundary_msg = (
-                    lt0.boundary_activation_mb_per_sample
-                    * (global_bsz / chunks)
-                    * (0.5 if self.mp in ("bf16", "fp16") else 1.0)
-                )
                 if multi_type is not None:
                     # two coupled sub-pipelines (pipeline_encdec.py): every
                     # tick runs one enc + one dec virtual stage, so per-tick
@@ -322,6 +320,11 @@ class SearchEngine:
                     p2p_ms = p2p_mb / self.hw.p2p(pp)
                     total_ms = (chunks + 2 * pp - 1) * (per_stage_ms + p2p_ms)
                 else:
+                    boundary_msg = (
+                        lt0.boundary_activation_mb_per_sample
+                        * (global_bsz / chunks)
+                        * (0.5 if self.mp in ("bf16", "fp16") else 1.0)
+                    )
                     total_ms = pipeline_time_cost(
                         [per_stage_ms] * pp, boundary_msg, pp, chunks, self.hw,
                         vpp=vpp,
@@ -393,7 +396,7 @@ class SearchEngine:
                 for chunks in chunk_opts:
                     for ptype in self.space.pipeline_types if pp > 1 else ("gpipe",):
                         vpps = [1]
-                        if pp > 1 and ptype == "gpipe":
+                        if pp > 1:
                             vpps = [
                                 v for v in _pow2s(self.space.max_vpp)
                                 if self.L % (pp * v) == 0

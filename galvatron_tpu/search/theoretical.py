@@ -219,6 +219,10 @@ def _analytic_encdec_costs(
         flops += 4.0 * cfg.num_heads * cfg.head_dim * S * S  # self attn
         if cross:
             flops += 4.0 * cfg.num_heads * cfg.head_dim * S * S_e  # cross attn
+            # the cross K/V projection runs over the ENCODER tokens (S_e),
+            # not the decoder length the 2pS term assumed
+            cross_kv = 2 * cfg.hidden_size * cfg.kv_heads * cfg.head_dim
+            flops += 2.0 * cross_kv * (S_e - S)
         act = {
             tp: layer_activation_mb_per_sample(
                 cfg, LayerStrategy(tp=tp), S, mixed_precision

@@ -21,6 +21,16 @@ __graft_entry__._force_virtual_cpu(8)
 
 import jax
 
+# Persistent compilation cache: the suite is compile-bound (every pipeline
+# test builds fresh shard_map programs); caching compiled executables across
+# test processes cuts re-run wall time drastically.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import pytest
 
 

@@ -196,7 +196,10 @@ def test_vpp_searched_and_reduces_pipeline_cost():
     # constraints: chunks % pp and layers % (pp*vpp)
     assert eng.evaluate(2, 16, 2, "gpipe", vpp=8) is None  # 8 layers % 16 != 0
     assert eng.evaluate(2, 18, 3, "gpipe", vpp=2) is None  # chunks 3 % pp 2
-    assert eng.evaluate(2, 16, 4, "pipedream_flush", vpp=2) is None
+    # vpp now composes with pipedream_flush (interleaved 1F1B)
+    r3 = eng.evaluate(2, 16, 4, "pipedream_flush", vpp=2)
+    assert r3 is not None and r3.config.vpp == 2
+    r3.config.validate(8)
     # the full sweep explores vpp when enabled
     best = eng.search([16])
     assert best is not None
