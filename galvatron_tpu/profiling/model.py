@@ -90,7 +90,7 @@ def _iter_time_ms(cfg: ModelConfig, bsz: int, seq: int, iters: int = 4) -> float
     mp = {jnp.bfloat16: "bf16", jnp.float16: "fp16"}.get(cfg.dtype, "fp32")
     hp = HybridParallelConfig(
         pp=1,
-        layer_strategies=[LayerStrategy()] * cfg.num_layers,
+        layer_strategies=[LayerStrategy()] * cfg.total_layers,  # enc + dec
         chunks=1,
         vocab_tp=1,
         mixed_precision=mp,
@@ -153,6 +153,21 @@ def _temp_bytes_tp(cfg: ModelConfig, bsz: int, seq: int, tp: int) -> Optional[in
         return None
 
 
+def _act_fallback_mb(cfg: ModelConfig, S: int) -> float:
+    """Analytic activation fallback (bf16): residuals + attn + mlp
+    intermediates per layer per sample."""
+    return S * cfg.hidden_size * (10 + 4 * cfg.ffn / cfg.hidden_size) * 2 / 1e6
+
+
+def _maybe_save(costs: ProfiledModelCosts, out_prefix: Optional[str]) -> None:
+    if out_prefix:
+        from galvatron_tpu.utils.config_utils import save_profiled_model
+
+        save_profiled_model(
+            costs, f"{out_prefix}_computation.json", f"{out_prefix}_memory.json"
+        )
+
+
 def profile_model(
     cfg: ModelConfig,
     bsz: int = 8,
@@ -163,6 +178,13 @@ def profile_model(
 ) -> ProfiledModelCosts:
     """Difference-method profile (reference: process_profiled_data,
     core/profiler.py:243-401). Writes reference-schema JSONs if out_prefix."""
+    if cfg.enc_layers > 0:
+        if seq is not None:
+            raise ValueError(
+                "seq does not apply to enc-dec profiles (two sequence "
+                "lengths); set cfg.enc_seq / cfg.max_seq_len instead"
+            )
+        return _profile_encdec_model(cfg, bsz, layernums, measure_time, out_prefix)
     seq = seq or cfg.max_seq_len
     l1, l2 = layernums
     cfg1, cfg2 = cfg.replace(num_layers=l1), cfg.replace(num_layers=l2)
@@ -177,9 +199,8 @@ def profile_model(
     b1, b2 = _temp_bytes(cfg1, bsz, seq), _temp_bytes(cfg2, bsz, seq)
     if b1 is not None and b2 is not None and b2 > b1:
         act_mb = (b2 - b1) / (l2 - l1) / bsz / 1e6
-    else:  # analytic fallback: residuals + attn + mlp intermediates, bf16
-        act_bytes = seq * cfg.hidden_size * (10 + 4 * cfg.ffn / cfg.hidden_size)
-        act_mb = act_bytes * 2 / 1e6
+    else:
+        act_mb = _act_fallback_mb(cfg, seq)
     # per-tp curve: measured (compiled tp-sharded step) where the host has
     # enough devices, ~1/tp analytic otherwise (reference sweeps real runs
     # across tp degrees, core/profiler.py:194-240)
@@ -220,10 +241,75 @@ def profile_model(
         other_act_mb_per_sample=float(seq * cfg.vocab_size * 4 / 1e6),  # logits fp32
         other_fwd_ms_per_sample=float(other_ms),
     )
-    if out_prefix:
-        from galvatron_tpu.utils.config_utils import save_profiled_model
+    _maybe_save(costs, out_prefix)
+    return costs
 
-        save_profiled_model(
-            costs, f"{out_prefix}_computation.json", f"{out_prefix}_memory.json"
+
+def _profile_encdec_model(
+    cfg: ModelConfig,
+    bsz: int,
+    layernums: Tuple[int, int],
+    measure_time: bool,
+    out_prefix: Optional[str],
+) -> ProfiledModelCosts:
+    """Enc-dec difference profile: TWO layer types from a three-point sweep —
+    vary the decoder count at fixed encoder count, then the encoder count at
+    fixed decoder count (the reference's multi-layer-type layernum lists,
+    core/profiler.py:194-240 launch matrix)."""
+    l1, l2 = layernums
+    S_e, S_d = cfg.enc_seq, cfg.max_seq_len
+    c11 = cfg.replace(num_layers=l1, enc_layers=l1)
+    c12 = cfg.replace(num_layers=l2, enc_layers=l1)
+    c21 = cfg.replace(num_layers=l1, enc_layers=l2)
+
+    if measure_time:
+        t11 = _iter_time_ms(c11, bsz, None)
+        t12 = _iter_time_ms(c12, bsz, None)
+        t21 = _iter_time_ms(c21, bsz, None)
+        dec_ms = max(1e-4, (t12 - t11) / (l2 - l1) / bsz / 3.0)
+        enc_ms = max(1e-4, (t21 - t11) / (l2 - l1) / bsz / 3.0)
+        other_ms = max(
+            0.0, (t11 - (enc_ms + dec_ms) * 3.0 * bsz * l1) / bsz / 3.0
         )
+    else:
+        enc_ms, dec_ms, other_ms = 1.0, 1.5, 0.1
+
+    S = cfg.sample_len
+    b11, b12, b21 = (
+        _temp_bytes(c11, bsz, S), _temp_bytes(c12, bsz, S), _temp_bytes(c21, bsz, S)
+    )
+
+    def act_of(b_hi, b_lo, S_type):
+        if b_hi is not None and b_lo is not None and b_hi > b_lo:
+            return (b_hi - b_lo) / (l2 - l1) / bsz / 1e6
+        return _act_fallback_mb(cfg, S_type)
+
+    enc_act = act_of(b21, b11, S_e)
+    dec_act = act_of(b12, b11, S_d)
+
+    def make_lt(fwd, act_mb, S_type, cross):
+        p_mb = layer_param_count(cfg, cross=cross) * 4 / 1e6
+        curve = {
+            t: float(act_mb / t)
+            for t in (1, 2, 4, 8)
+            if cfg.hidden_size % t == 0
+        }
+        return ProfiledLayerType(
+            fwd_ms_per_sample=float(fwd),
+            parameter_mb=float(p_mb),
+            activation_mb_per_sample=curve,
+            boundary_activation_mb_per_sample=float(S_type * cfg.hidden_size * 2 / 1e6),
+        )
+
+    enc_lt = make_lt(enc_ms, enc_act, S_e, cross=False)
+    dec_lt = make_lt(dec_ms, dec_act, S_d, cross=True)
+    layer_types = {i: enc_lt for i in range(cfg.enc_layers)}
+    layer_types.update({cfg.enc_layers + i: dec_lt for i in range(cfg.num_layers)})
+    costs = ProfiledModelCosts(
+        layer_types=layer_types,
+        other_param_mb=float(other_param_count(cfg) * 4 / 1e6),
+        other_act_mb_per_sample=float(S_d * cfg.vocab_size * 4 / 1e6),
+        other_fwd_ms_per_sample=float(other_ms),
+    )
+    _maybe_save(costs, out_prefix)
     return costs

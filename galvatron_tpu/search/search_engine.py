@@ -379,6 +379,9 @@ class SearchEngine:
                 "pipeline_type": pipeline_type,
                 "vocab_tp": vocab_tp, "embed_dp_type": embed_dp_type,
                 "other_memory_mb": float(other_mb),
+                # non-empty => comm terms priced from built-in defaults, not
+                # measured bandwidths (e.g. search ran on a single-chip host)
+                "fallback_bandwidths": self.hw.fallback_sources(pp),
             },
         )
 
@@ -508,5 +511,8 @@ class SearchEngine:
         d["search_throughput_samples_per_s"] = result.throughput_samples_per_s
         d["global_bsz"] = result.global_bsz
         d["memory_mb"] = result.memory_mb
+        fb = result.details.get("fallback_bandwidths")
+        if fb:
+            d["fallback_bandwidths"] = fb  # priced from defaults, not measured
         with open(path, "w") as f:
             json.dump(d, f, indent=2)

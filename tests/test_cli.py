@@ -39,6 +39,7 @@ def test_train_mode_pipeline(capsys):
     assert "iter 1: loss" in capsys.readouterr().out
 
 
+@pytest.mark.slow
 def test_search_then_train_closure(tmp_path, capsys):
     """search emits a config; train consumes it (reference loop:
     search_dist.py → configs/galvatron_config_*.json → train_dist.py)."""
@@ -59,6 +60,7 @@ def test_search_then_train_closure(tmp_path, capsys):
     assert rc == 0
 
 
+@pytest.mark.slow
 def test_profile_mode(tmp_path):
     prefix = str(tmp_path / "prof")
     rc = cli_main(["profile", *TINY, "--profile_batch_size", "4",
@@ -68,6 +70,7 @@ def test_profile_mode(tmp_path):
     assert os.path.exists(f"{prefix}_memory.json")
 
 
+@pytest.mark.slow
 def test_profile_hardware_mode(tmp_path):
     out = str(tmp_path / "hw.json")
     rc = cli_main(["profile-hardware", "--profile_size_mb", "1",
@@ -163,6 +166,7 @@ def test_model_family_entries(capsys):
         assert "iter 0: loss" in capsys.readouterr().out
 
 
+@pytest.mark.slow
 def test_fidelity_report_on_searched_config(tmp_path, capsys):
     """Training the searched config at its searched batch size prints the
     predicted-vs-measured fidelity line (SURVEY §6 — the benchmark the
@@ -183,6 +187,7 @@ def test_fidelity_report_on_searched_config(tmp_path, capsys):
     assert "cost-model fidelity: predicted" in capsys.readouterr().out
 
 
+@pytest.mark.slow
 def test_search_validate_top_k(tmp_path, capsys):
     """--validate_top_k trains the top candidates and reports measured vs
     predicted iteration time (the measured closure the reference's

@@ -230,3 +230,23 @@ def test_multi_layer_type_search_pp2():
     state = rt.init_state(jax.random.key(0))
     state, loss = rt.train_step(state, batch())
     assert np.isfinite(float(loss))
+
+
+def test_encdec_measured_profile_two_types():
+    """profile_model on an enc-dec config yields distinct enc/dec layer types
+    (three-point layernum difference) that feed the multi-type search."""
+    from galvatron_tpu.profiling.model import profile_model
+    from galvatron_tpu.search.cost_model import ProfiledHardware
+    from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+
+    costs = profile_model(T5, bsz=8, measure_time=False)
+    assert len(set(id(v) for v in costs.layer_types.values())) == 2
+    enc, dec = costs.layer_types[0], costs.layer_types[T5.enc_layers]
+    assert dec.parameter_mb > enc.parameter_mb  # cross-attention params
+    eng = SearchEngine(
+        costs, ProfiledHardware(), num_layers=T5.total_layers,
+        space=SearchSpace(world_size=8, pp_choices=[2], max_tp=2),
+        memory_budget_mb=2000.0,
+    )
+    r = eng.evaluate(2, 8, 2, "gpipe")
+    assert r is not None and r.config.pp == 2

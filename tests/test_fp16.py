@@ -29,10 +29,18 @@ def batches(n, seed=0):
     "hp",
     [
         HybridParallelConfig.uniform(2, tp=1, mixed_precision="fp16"),
-        HybridParallelConfig.uniform(2, tp=2, mixed_precision="fp16", vocab_tp=2, chunks=2),
-        HybridParallelConfig.uniform(
-            2, pp=2, tp=1, mixed_precision="fp16", chunks=2,
-            pipeline_type="pipedream_flush",
+        pytest.param(
+            HybridParallelConfig.uniform(
+                2, tp=2, mixed_precision="fp16", vocab_tp=2, chunks=2
+            ),
+            marks=pytest.mark.slow,
+        ),
+        pytest.param(
+            HybridParallelConfig.uniform(
+                2, pp=2, tp=1, mixed_precision="fp16", chunks=2,
+                pipeline_type="pipedream_flush",
+            ),
+            marks=pytest.mark.slow,
         ),
     ],
     ids=["pp1", "pp1_tp2_accum", "pp2_1f1b"],

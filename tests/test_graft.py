@@ -8,6 +8,7 @@ from pathlib import Path
 
 import jax
 import numpy as np
+import pytest
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
@@ -21,5 +22,6 @@ def test_entry_compiles_and_runs():
     assert arr.ndim == 3 and np.isfinite(arr.astype(np.float32)).all()
 
 
+@pytest.mark.slow  # the round driver executes this itself
 def test_dryrun_multichip_8():
     graft.dryrun_multichip(8)  # asserts finite losses internally

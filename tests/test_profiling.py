@@ -35,6 +35,7 @@ def test_other_param_count_matches_init():
     assert n_full == other_param_count(CFG) + CFG.num_layers * layer_param_count(CFG)
 
 
+@pytest.mark.slow
 def test_hardware_profile_schema(tmp_path):
     hw = profile_hardware(msg_mb=1.0, out_path=str(tmp_path / "hw.json"))
     # 8-device sim → sizes 2, 4 (consec+strided) and 8
@@ -48,6 +49,7 @@ def test_hardware_profile_schema(tmp_path):
     assert hw2.allreduce_bw == hw.allreduce_bw and hw2.p2p_bw == hw.p2p_bw
 
 
+@pytest.mark.slow
 def test_model_profile_and_search_consume(tmp_path):
     costs = profile_model(
         CFG, bsz=4, seq=32, layernums=(2, 4), out_prefix=str(tmp_path / "llama_tiny")
@@ -84,6 +86,7 @@ def test_runtime_profiler_fidelity_report():
     assert "cost-model fidelity" in rep
 
 
+@pytest.mark.slow
 def test_per_tp_activation_curve_measured():
     """Per-tp activation memory is measured by compiling the tp-sharded step
     (the reference sweeps real runs across tp degrees, core/profiler.py:

@@ -291,3 +291,36 @@ def test_transition_costs_ride_pipeline_ticks():
     expected = n_boundaries * K / chunks * (chunks + pp - 1)
     assert abs(delta - expected) < 1e-6, (delta, expected)
     assert delta > n_boundaries * K  # strictly more than the old flat count
+
+
+def test_fallback_bandwidths_labeled(tmp_path):
+    """Predictions priced from built-in default bandwidths (unprofiled
+    single-chip hosts) are labeled in the result and the saved config."""
+    import json as _json
+
+    lt = ProfiledLayerType(
+        fwd_ms_per_sample=2.0, parameter_mb=80.0,
+        activation_mb_per_sample={1: 40.0, 2: 20.0},
+        boundary_activation_mb_per_sample=4.0,
+    )
+    costs = ProfiledModelCosts(
+        layer_types={0: lt}, other_param_mb=100.0, other_act_mb_per_sample=8.0,
+        other_fwd_ms_per_sample=0.3,
+    )
+    eng = SearchEngine(
+        costs, ProfiledHardware(), 4,
+        SearchSpace(world_size=8, pp_choices=[2], max_tp=2),
+        memory_budget_mb=20000.0,
+    )
+    r = eng.evaluate(2, 8, 2, "gpipe")
+    assert set(r.details["fallback_bandwidths"]) == {"allreduce_bw", "p2p_bw"}
+    path = tmp_path / "cfg.json"
+    eng.save_result(r, str(path))
+    assert "fallback_bandwidths" in _json.load(open(path))
+    # measured hardware: no label
+    hw = ProfiledHardware(allreduce_bw={"2_1": 100.0}, p2p_bw={2: 50.0})
+    eng2 = SearchEngine(
+        costs, hw, 4, SearchSpace(world_size=8, pp_choices=[2], max_tp=2),
+        memory_budget_mb=20000.0,
+    )
+    assert eng2.evaluate(2, 8, 2, "gpipe").details["fallback_bandwidths"] == []
