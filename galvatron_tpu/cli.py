@@ -197,26 +197,50 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             print("error: export-hf needs --output_dir")
             return 2
         cfg = model_config_from_args(ns)
-        from galvatron_tpu.models.convert import to_hf_llama
+        from galvatron_tpu.models.convert import to_hf_gpt2, to_hf_llama
 
         params = _load_or_init_params(ns, cfg)  # validates shape vs config
-        sd = to_hf_llama(params, cfg)
+        # architecture by config shape: GPT-2-style (learned positions +
+        # biases + gelu) exports as GPT2LMHeadModel, else LlamaForCausalLM
+        gpt2_style = (
+            cfg.pos_embed == "learned" and cfg.use_bias and cfg.act_fn == "gelu"
+        )
+        if cfg.act_fn == "relu":
+            print(
+                "error: export-hf does not support the OPT family — the +2 "
+                "position offset dropped at import cannot be reconstructed "
+                "for HF's padded-position rows"
+            )
+            return 2
+        sd = (to_hf_gpt2 if gpt2_style else to_hf_llama)(params, cfg)
         import numpy as _np
 
         os.makedirs(ns.output_dir, exist_ok=True)
         try:
             import torch
-            from transformers import LlamaConfig, LlamaForCausalLM
 
-            hf_cfg = LlamaConfig(
-                vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
-                intermediate_size=cfg.ffn, num_hidden_layers=cfg.num_layers,
-                num_attention_heads=cfg.num_heads, num_key_value_heads=cfg.kv_heads,
-                max_position_embeddings=cfg.max_seq_len,
-                rms_norm_eps=cfg.norm_eps, rope_theta=cfg.rope_theta,
-                tie_word_embeddings=cfg.tie_word_embeddings,
-            )
-            model = LlamaForCausalLM(hf_cfg)
+            if gpt2_style:
+                from transformers import GPT2Config, GPT2LMHeadModel
+
+                hf_cfg = GPT2Config(
+                    vocab_size=cfg.vocab_size, n_embd=cfg.hidden_size,
+                    n_layer=cfg.num_layers, n_head=cfg.num_heads,
+                    n_inner=cfg.ffn, n_positions=cfg.max_seq_len,
+                    layer_norm_epsilon=cfg.norm_eps,
+                )
+                model = GPT2LMHeadModel(hf_cfg)
+            else:
+                from transformers import LlamaConfig, LlamaForCausalLM
+
+                hf_cfg = LlamaConfig(
+                    vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+                    intermediate_size=cfg.ffn, num_hidden_layers=cfg.num_layers,
+                    num_attention_heads=cfg.num_heads, num_key_value_heads=cfg.kv_heads,
+                    max_position_embeddings=cfg.max_seq_len,
+                    rms_norm_eps=cfg.norm_eps, rope_theta=cfg.rope_theta,
+                    tie_word_embeddings=cfg.tie_word_embeddings,
+                )
+                model = LlamaForCausalLM(hf_cfg)
             model.load_state_dict({k: torch.tensor(v) for k, v in sd.items()})
             model.save_pretrained(ns.output_dir)
             print(f"exported HF checkpoint → {ns.output_dir}")

@@ -277,19 +277,159 @@ def to_hf_llama(params: Params, cfg: ModelConfig) -> Dict[str, np.ndarray]:
     return sd
 
 
+def config_from_hf_opt(hf_config) -> ModelConfig:
+    """ModelConfig from a ``transformers.OPTConfig``-shaped object (decoder-
+    only, ReLU MLPs, LayerNorm, learned positions with OPT's +2 offset)."""
+    if getattr(hf_config, "word_embed_proj_dim", hf_config.hidden_size) != hf_config.hidden_size:
+        raise ValueError(
+            "OPT checkpoints with projected embeddings (word_embed_proj_dim "
+            "!= hidden_size, e.g. opt-350m) are not supported"
+        )
+    if not getattr(hf_config, "do_layer_norm_before", True):
+        raise ValueError(
+            "post-norm OPT variants (do_layer_norm_before=False, opt-350m) "
+            "are not supported (this decoder is pre-norm)"
+        )
+    act = getattr(hf_config, "activation_function", "relu")
+    if act != "relu":
+        raise ValueError(f"unsupported OPT activation {act!r} (expected relu)")
+    if not getattr(hf_config, "tie_word_embeddings", True):
+        raise ValueError(
+            "untied OPT checkpoints (tie_word_embeddings=False) are not "
+            "supported — the lm_head would be silently dropped"
+        )
+    return ModelConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        ffn_dim=hf_config.ffn_dim,
+        max_seq_len=hf_config.max_position_embeddings,
+        pos_embed="learned",
+        norm_type="layernorm",
+        act_fn="relu",
+        use_bias=True,
+        tie_word_embeddings=True,
+    )
+
+
+def from_hf_opt(model_or_state_dict: Any, cfg: ModelConfig) -> Params:
+    """HF ``OPTForCausalLM`` (or its state dict) → parameter pytree. OPT has
+    separate q/k/v projections with biases (packed into the blocked fused
+    layout here) and a learned position table indexed at position+2 — the
+    offset is baked in by slicing the table, exactly equivalent for
+    left-aligned (unpadded) sequences, which is this runtime's batch
+    contract."""
+    sd: Mapping[str, Any] = (
+        model_or_state_dict
+        if isinstance(model_or_state_dict, Mapping)
+        else model_or_state_dict.state_dict()
+    )
+    dt = cfg.param_dtype
+
+    def get(name: str) -> np.ndarray:
+        if name not in sd:
+            raise KeyError(f"HF state dict is missing '{name}'")
+        return _np(sd[name])
+
+    pos = get("model.decoder.embed_positions.weight")[2 : 2 + cfg.max_seq_len]
+    params: Params = {
+        "embed": {
+            "tok": get("model.decoder.embed_tokens.weight").astype(dt),
+            "pos": pos.astype(dt),
+        },
+        "layers": [],
+        "final_norm": {
+            "scale": get("model.decoder.final_layer_norm.weight").astype(dt),
+            "bias": get("model.decoder.final_layer_norm.bias").astype(dt),
+        },
+    }
+    for i in range(cfg.num_layers):
+        pre = f"model.decoder.layers.{i}."
+        wq = get(pre + "self_attn.q_proj.weight").T
+        wk = get(pre + "self_attn.k_proj.weight").T
+        wv = get(pre + "self_attn.v_proj.weight").T
+        bq = get(pre + "self_attn.q_proj.bias")
+        bk = get(pre + "self_attn.k_proj.bias")
+        bv = get(pre + "self_attn.v_proj.bias")
+        params["layers"].append(
+            {
+                "attn_norm": {
+                    "scale": get(pre + "self_attn_layer_norm.weight").astype(dt),
+                    "bias": get(pre + "self_attn_layer_norm.bias").astype(dt),
+                },
+                "attn": {
+                    "wqkv": pack_qkv(wq, wk, wv, cfg).astype(dt),
+                    "wqkv_b": np.stack([bq, bk, bv], axis=0).astype(dt),
+                    "wo": np.ascontiguousarray(
+                        get(pre + "self_attn.out_proj.weight").T
+                    ).astype(dt),
+                    "wo_b": get(pre + "self_attn.out_proj.bias").astype(dt),
+                },
+                "mlp_norm": {
+                    "scale": get(pre + "final_layer_norm.weight").astype(dt),
+                    "bias": get(pre + "final_layer_norm.bias").astype(dt),
+                },
+                "mlp": {
+                    "w1": np.ascontiguousarray(get(pre + "fc1.weight").T).astype(dt),
+                    "w1_b": get(pre + "fc1.bias").astype(dt),
+                    "w2": np.ascontiguousarray(get(pre + "fc2.weight").T).astype(dt),
+                    "w2_b": get(pre + "fc2.bias").astype(dt),
+                },
+            }
+        )
+    return params
+
+
+def to_hf_gpt2(params: Params, cfg: ModelConfig) -> Dict[str, np.ndarray]:
+    """Parameter pytree → an HF ``GPT2LMHeadModel`` state dict (numpy fp32;
+    GPT-2's Conv1D weights are input-major, so this is reshape-only) — the
+    export half of the GPT-2 round trip."""
+    if not cfg.tie_word_embeddings:
+        raise ValueError(
+            "to_hf_gpt2 exports tied-embedding models only (GPT2LMHeadModel "
+            "ties lm_head to wte); an untied head would be silently dropped"
+        )
+    h, nd = cfg.hidden_size, cfg.num_heads * cfg.head_dim
+    np32 = lambda a: np.asarray(a, np.float32)
+    sd: Dict[str, np.ndarray] = {
+        "transformer.wte.weight": np32(params["embed"]["tok"]),
+        "transformer.wpe.weight": np32(params["embed"]["pos"]),
+        "transformer.ln_f.weight": np32(params["final_norm"]["scale"]),
+        "transformer.ln_f.bias": np32(params["final_norm"]["bias"]),
+        "lm_head.weight": np32(params["embed"]["tok"]),
+    }
+    for i, lp in enumerate(params["layers"]):
+        pre = f"transformer.h.{i}."
+        sd[pre + "ln_1.weight"] = np32(lp["attn_norm"]["scale"])
+        sd[pre + "ln_1.bias"] = np32(lp["attn_norm"]["bias"])
+        sd[pre + "attn.c_attn.weight"] = np32(lp["attn"]["wqkv"]).reshape(h, 3 * nd)
+        sd[pre + "attn.c_attn.bias"] = np32(lp["attn"]["wqkv_b"]).reshape(3 * nd)
+        sd[pre + "attn.c_proj.weight"] = np32(lp["attn"]["wo"])
+        sd[pre + "attn.c_proj.bias"] = np32(lp["attn"]["wo_b"])
+        sd[pre + "ln_2.weight"] = np32(lp["mlp_norm"]["scale"])
+        sd[pre + "ln_2.bias"] = np32(lp["mlp_norm"]["bias"])
+        sd[pre + "mlp.c_fc.weight"] = np32(lp["mlp"]["w1"])
+        sd[pre + "mlp.c_fc.bias"] = np32(lp["mlp"]["w1_b"])
+        sd[pre + "mlp.c_proj.weight"] = np32(lp["mlp"]["w2"])
+        sd[pre + "mlp.c_proj.bias"] = np32(lp["mlp"]["w2_b"])
+    return sd
+
+
 def load_hf_checkpoint(path_or_model: Any) -> tuple:
     """(params, cfg) from a local HF checkpoint directory or an in-memory HF
     model. Supported architectures: LLaMA family (RMSNorm/SwiGLU/RoPE, no
-    biases) and GPT-2 (LayerNorm/GeLU/learned positions, biases)."""
+    biases), GPT-2 (LayerNorm/GeLU/learned positions, biases) and OPT
+    (LayerNorm/ReLU/learned positions with the +2 offset, biases)."""
     if isinstance(path_or_model, str):
         from transformers import AutoConfig, AutoModelForCausalLM
 
         hf_cfg = AutoConfig.from_pretrained(path_or_model)
         name = type(hf_cfg).__name__.lower()
-        if "llama" not in name and "gpt2" not in name:
+        if "llama" not in name and "gpt2" not in name and "opt" not in name:
             raise ValueError(
-                f"--load_hf supports LLaMA-architecture and GPT-2 checkpoints; "
-                f"got {type(hf_cfg).__name__}"
+                f"--load_hf supports LLaMA-architecture, GPT-2 and OPT "
+                f"checkpoints; got {type(hf_cfg).__name__}"
             )
         # low_cpu_mem_usage streams weights instead of materializing a full
         # randomly-initialized module first (~halves host peak for 7B+)
@@ -299,9 +439,13 @@ def load_hf_checkpoint(path_or_model: Any) -> tuple:
     else:
         model = path_or_model
         hf_cfg = model.config
-    if "gpt2" in type(hf_cfg).__name__.lower():
+    arch = type(hf_cfg).__name__.lower()
+    if "gpt2" in arch:
         cfg = config_from_hf_gpt2(hf_cfg)
         return from_hf_gpt2(model, cfg), cfg
+    if "opt" in arch:
+        cfg = config_from_hf_opt(hf_cfg)
+        return from_hf_opt(model, cfg), cfg
     cfg = config_from_hf_llama(hf_cfg)
     return from_hf_llama(model, cfg), cfg
 

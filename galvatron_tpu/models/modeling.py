@@ -45,7 +45,7 @@ class ModelConfig:
     max_seq_len: int = 2048
     pos_embed: str = "rope"  # 'rope' | 'learned' | 'alibi'
     norm_type: str = "rms"  # 'rms' | 'layernorm'
-    act_fn: str = "swiglu"  # 'swiglu' | 'gelu'
+    act_fn: str = "swiglu"  # 'swiglu' | 'gelu' | 'relu' (OPT-style)
     tie_word_embeddings: bool = False
     # GPT-2-style projection biases on qkv/out/mlp GEMMs (norm biases are
     # governed by norm_type). Requires the blocked qkv layout (no GQA).
@@ -68,7 +68,12 @@ class ModelConfig:
     # pretraining) with deterministic token-hash masking (see mlm_loss_sum)
     objective: str = "clm"
     mlm_mask_rate: float = 0.15
-    fused_norm: bool = True  # Pallas fused rms/layernorm on TPU (jnp on CPU)
+    # Pallas fused rms/layernorm kernels (opt-in). Off by default: measured
+    # on the v5e 7B-shape bench (2026-07-30), XLA's own norm fusion beats the
+    # custom kernels by ~0.05 ms/layer/sample fwd and ~0.27 fwd+bwd — the
+    # custom-call boundary blocks producer/consumer fusion with the residual
+    # adds and GEMMs around the norm (BASELINE.md round-2 notes).
+    fused_norm: bool = False
     dtype: Any = jnp.bfloat16  # compute dtype
     param_dtype: Any = jnp.float32
     # Mixture-of-Experts (SwitchMLP equivalent, reference:
@@ -669,7 +674,10 @@ def mlp_block(x, p, cfg: ModelConfig, train: bool = True):
         g = x @ p["w1"].astype(x.dtype)
         if "w1_b" in p:
             g = g + p["w1_b"].astype(x.dtype)
-        y = jax.nn.gelu(g, approximate=True) @ p["w2"].astype(x.dtype)
+        act = jax.nn.relu if cfg.act_fn == "relu" else partial(
+            jax.nn.gelu, approximate=True
+        )
+        y = act(g) @ p["w2"].astype(x.dtype)
     if "w2_b" in p:
         y = y + p["w2_b"].astype(x.dtype)
     return y
@@ -1073,6 +1081,39 @@ PRESETS: Dict[str, ModelConfig] = {
         use_bias=True,
         vocab_size=50257, hidden_size=4096, num_layers=32, num_heads=32,
         max_seq_len=2048, pos_embed="learned", norm_type="layernorm", act_fn="gelu",
+        tie_word_embeddings=True,
+    ),
+    # OPT family (decoder-only, ReLU MLPs, learned positions with the
+    # characteristic +2 offset — handled at HF import by slicing the table;
+    # reference parity target: the gpt_hf-style HF-wrapping family pattern)
+    "opt-125m": ModelConfig(
+        use_bias=True,
+        vocab_size=50272, hidden_size=768, num_layers=12, num_heads=12,
+        max_seq_len=2048, pos_embed="learned", norm_type="layernorm", act_fn="relu",
+        tie_word_embeddings=True,
+    ),
+    "opt-1.3b": ModelConfig(
+        use_bias=True,
+        vocab_size=50272, hidden_size=2048, num_layers=24, num_heads=32,
+        max_seq_len=2048, pos_embed="learned", norm_type="layernorm", act_fn="relu",
+        tie_word_embeddings=True,
+    ),
+    "opt-6.7b": ModelConfig(
+        use_bias=True,
+        vocab_size=50272, hidden_size=4096, num_layers=32, num_heads=32,
+        max_seq_len=2048, pos_embed="learned", norm_type="layernorm", act_fn="relu",
+        tie_word_embeddings=True,
+    ),
+    "opt-13b": ModelConfig(
+        use_bias=True,
+        vocab_size=50272, hidden_size=5120, num_layers=40, num_heads=40,
+        max_seq_len=2048, pos_embed="learned", norm_type="layernorm", act_fn="relu",
+        tie_word_embeddings=True,
+    ),
+    "opt-30b": ModelConfig(
+        use_bias=True,
+        vocab_size=50272, hidden_size=7168, num_layers=48, num_heads=56,
+        max_seq_len=2048, pos_embed="learned", norm_type="layernorm", act_fn="relu",
         tie_word_embeddings=True,
     ),
     # encoder families (reference legacy bert support: core/parallel.py:64-89,

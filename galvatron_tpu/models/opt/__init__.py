@@ -1,0 +1,12 @@
+"""OPT family entry (decoder-only, ReLU MLPs, learned positions; HF import
+via models/convert.py — the gpt_hf-style HF-wrapping family pattern,
+reference: galvatron/models/gpt_hf/)."""
+
+DEFAULT_MODEL = "opt-1.3b"
+SIZES = ("opt-125m", "opt-1.3b", "opt-6.7b", "opt-13b", "opt-30b")
+
+
+def main(argv=None):
+    from galvatron_tpu.cli import main as cli_main
+
+    return cli_main(argv, model_default=DEFAULT_MODEL)

@@ -1,0 +1,3 @@
+from galvatron_tpu.models.opt import main
+
+raise SystemExit(main())
