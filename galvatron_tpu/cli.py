@@ -199,12 +199,6 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         cfg = model_config_from_args(ns)
         from galvatron_tpu.models.convert import to_hf_gpt2, to_hf_llama
 
-        params = _load_or_init_params(ns, cfg)  # validates shape vs config
-        # architecture by config shape: GPT-2-style (learned positions +
-        # biases + gelu) exports as GPT2LMHeadModel, else LlamaForCausalLM
-        gpt2_style = (
-            cfg.pos_embed == "learned" and cfg.use_bias and cfg.act_fn == "gelu"
-        )
         if cfg.act_fn == "relu":
             print(
                 "error: export-hf does not support the OPT family — the +2 "
@@ -212,6 +206,18 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
                 "for HF's padded-position rows"
             )
             return 2
+        if not cfg.causal or cfg.objective != "clm" or cfg.image_size:
+            print(
+                "error: export-hf exports causal LM decoders only "
+                "(encoder/vision families have no HF causal-LM counterpart)"
+            )
+            return 2
+        # architecture by config shape: GPT-2-style (learned positions +
+        # biases + gelu) exports as GPT2LMHeadModel, else LlamaForCausalLM
+        gpt2_style = (
+            cfg.pos_embed == "learned" and cfg.use_bias and cfg.act_fn == "gelu"
+        )
+        params = _load_or_init_params(ns, cfg)  # validates shape vs config
         sd = (to_hf_gpt2 if gpt2_style else to_hf_llama)(params, cfg)
         import numpy as _np
 

@@ -66,6 +66,10 @@ def _add_training_args(p: argparse.ArgumentParser):
     g.add_argument("--weight_decay", type=float, default=0.01)
     g.add_argument("--grad_clip", type=float, default=1.0)
     g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--num_slices", type=int, default=0,
+                   help="TPU multislice: order the mesh slice-major so pp "
+                   "and the major data axes cross the DCN boundary "
+                   "(0/1 = single slice)")
     g.add_argument("--multihost", type=int, default=0,
                    help="1 = jax.distributed.initialize() (TPU pod slices; "
                    "every host runs the same command)")

@@ -95,8 +95,16 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
                     f"{hp.chunks} (micro-batch gradient accumulation)"
                 )
     seq = cfg.sample_len
+    mesh = axes = None
+    if getattr(ns, "num_slices", 0) and ns.num_slices > 1:
+        # multislice: slice-major device order puts pp + the major data axes
+        # across the DCN boundary (parallel/mesh.build_mesh)
+        from galvatron_tpu.parallel.mesh import build_mesh
+
+        mesh, axes = build_mesh(pp=hp.pp, num_slices=ns.num_slices)
     rt = build_runtime(
-        cfg, hp, adam=adam, global_batch_size=ns.global_train_batch_size, seq_len=seq
+        cfg, hp, mesh=mesh, axes=axes, adam=adam,
+        global_batch_size=ns.global_train_batch_size, seq_len=seq,
     )
 
     start_step = 0

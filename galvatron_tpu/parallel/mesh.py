@@ -90,6 +90,7 @@ def build_mesh(
     pp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
     axis_prefix: str = "x",
+    num_slices: Optional[int] = None,
 ) -> Tuple[Mesh, MeshAxes]:
     """Build the factored mesh over all (or given) devices.
 
@@ -97,18 +98,52 @@ def build_mesh(
     devices in torus-major order so minor mesh axes correspond to
     ICI-adjacent chips, matching the 'consecutive ranks = intra-node NVLink'
     empirical layout the reference profiles (SURVEY §5, hardware_configs).
+
+    Multislice (DCN-connected slices; the reference's 2-node×8-GPU IB
+    topology class): devices are ordered slice-major so the OUTERMOST mesh
+    dims span slices — pipeline stages (which tolerate low-bandwidth p2p)
+    and the major/'strided' data axes cross the DCN boundary, while
+    minor/'consecutive' axes stay on ICI; the hardware profiler then
+    measures DCN bandwidth for exactly the axis combinations that pay it.
+    ``num_slices`` defaults to the distinct ``slice_index`` values on the
+    devices (1 on single-slice systems and the CPU sim).
     """
     if devices is None:
         devices = jax.devices()
     world = len(devices)
     if world % pp != 0:
         raise ValueError(f"pp={pp} must divide world size {world}")
+    if num_slices:
+        # explicit request: invalid values are hard errors
+        if not _is_pow2_int(num_slices):
+            raise ValueError(f"num_slices must be a power of two, got {num_slices}")
+        if world % num_slices:
+            raise ValueError(
+                f"{num_slices} slices must evenly divide the {world} devices"
+            )
+        devices = sorted(devices, key=_slice_key)
+    else:
+        # inference: reorder only when the detected slice structure is a
+        # clean binary factor — otherwise keep jax's device order (device
+        # subsets or exotic topologies must not break single-slice callers)
+        n = len({_slice_key(d)[0] for d in devices})
+        if n > 1 and _is_pow2_int(n) and world % n == 0:
+            devices = sorted(devices, key=_slice_key)
     m = _log2(world // pp)
     shape = (pp,) + (2,) * m
     dev_array = np.asarray(devices).reshape(shape)
     names = ("pp",) + tuple(f"{axis_prefix}{i}" for i in range(m))
     mesh = Mesh(dev_array, names)
     return mesh, MeshAxes(pp="pp", data_axes=names[1:])
+
+
+def _is_pow2_int(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+def _slice_key(d) -> Tuple[int, int]:
+    """Slice-major device ordering key (slice_index absent → one slice)."""
+    return (getattr(d, "slice_index", 0), d.id)
 
 
 def data_parallel_degree(axes: MeshAxes, s: LayerStrategy) -> int:

@@ -29,7 +29,14 @@ Layout constraints under SPMD (documented deviations from the reference):
   per-device memory is bounded by the heaviest stage regardless);
 - layers at the same position within their stage share one strategy (stacked
   arrays have a single sharding). Per-position heterogeneity is retained;
-  arbitrary per-layer heterogeneity is available at pp=1.
+  arbitrary per-layer heterogeneity is available at pp=1. Full cross-stage
+  heterogeneity at pp>1 is a PRINCIPLED boundary of single-program SPMD, not
+  an omission: a (pp, ...)-stacked parameter has exactly one sharding, and
+  stage-varying shardings would need stage-varying GSPMD collectives inside
+  the lockstep schedule — verified to deadlock (see pipeline_encdec.py,
+  whose coupled-sub-pipeline design exists precisely to avoid it). Uneven
+  divisions + per-position patterns recover most of the searched configs the
+  reference emits (its per-layer choices cluster by stage position).
 - embedding / final norm / LM head compute outside the pipelined section,
   sharded over the full mesh (pp included) on the batch dim; their params are
   replicated over pp (vocab-TP/ZeRO sharded per vocab strategy).

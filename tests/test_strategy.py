@@ -139,3 +139,39 @@ def test_param_spec_rules():
     )
     # non-divisible dims stay unsharded
     assert param_spec((3, 64), ("fsdp", None), axes, s) == P(None, None)
+
+
+def test_multislice_mesh_ordering():
+    """build_mesh(num_slices=N) orders devices slice-major so the outermost
+    mesh dims (pp + major data axes) span the DCN boundary; validation
+    rejects non-dividing or non-power-of-two slice counts."""
+    import jax
+    import pytest as _pytest
+
+    from galvatron_tpu.parallel.mesh import build_mesh
+
+    # CPU-sim devices carry no slice_index (treated as one slice): the sort
+    # is identity and the mesh still builds with an explicit num_slices
+    mesh, axes = build_mesh(pp=2, num_slices=2)
+    assert mesh.devices.shape == (2, 2, 2)
+    # stage boundary == slice boundary under slice-major order
+    assert [d.id for d in mesh.devices.reshape(2, -1)[0]] == [0, 1, 2, 3]
+    with _pytest.raises(ValueError, match="power of two"):
+        build_mesh(pp=1, num_slices=3)
+    with _pytest.raises(ValueError, match="evenly divide"):
+        build_mesh(pp=1, devices=jax.devices()[:4], num_slices=8)
+
+
+def test_multislice_slice_major_sort():
+    """The slice-major key groups devices of a slice together regardless of
+    enumeration order (real multislice: jax.devices() interleaves slices)."""
+    from types import SimpleNamespace
+
+    from galvatron_tpu.parallel.mesh import _slice_key
+
+    devs = [
+        SimpleNamespace(id=i, slice_index=i % 2) for i in range(8)
+    ]  # interleaved slices 0/1
+    ordered = sorted(devs, key=_slice_key)
+    assert [d.slice_index for d in ordered] == [0] * 4 + [1] * 4
+    assert [d.id for d in ordered] == [0, 2, 4, 6, 1, 3, 5, 7]
