@@ -1,0 +1,34 @@
+# Build/test/bench entry points (reference parity: /root/reference/Makefile
+# builds csrc/dp_core; here the native pieces build on demand via ctypes
+# loaders, and this Makefile wraps the common workflows).
+
+PY ?= python
+
+.PHONY: all native test test-all bench dryrun clean
+
+all: native
+
+# native components: DP search core + data helpers (C ABI shared objects)
+native:
+	$(PY) -c "from galvatron_tpu.search.native import get_dp_core; assert get_dp_core() is not None, 'dp_core build failed'; print('dp_core ok')"
+	$(PY) -c "from galvatron_tpu.core.data_native import get_data_helpers; print('data_helpers', 'ok' if get_data_helpers() is not None else 'unavailable (NumPy fallback)')"
+
+# CI-budget suite (heavyweight matrices deselected; see pyproject addopts)
+test:
+	$(PY) -m pytest tests/ -q
+
+# everything, including the @slow compile-bound matrices
+test-all:
+	$(PY) -m pytest tests/ -q -m ""
+
+# headline metric on the real chip — prints one JSON line
+bench:
+	$(PY) bench.py
+
+# multi-chip sharding validation on a virtual 8-device CPU mesh
+dryrun:
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean:
+	rm -rf build .jax_cache .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

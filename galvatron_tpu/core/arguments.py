@@ -85,6 +85,9 @@ def _add_training_args(p: argparse.ArgumentParser):
                    "the torch.profiler/CUDA-events counterpart, SURVEY §5)")
     # hybrid-parallel GLOBAL flags (used when no galvatron_config_path)
     g.add_argument("--pp_deg", type=int, default=1)
+    g.add_argument("--pp_division", type=_int_list, default=None,
+                   help="comma-separated layers per pipeline stage (uneven "
+                   "divisions supported; default: balanced split)")
     g.add_argument("--vpp_deg", type=int, default=1,
                    help="virtual pipeline chunks per device (interleaved "
                    "schedule; needs layers % (pp*vpp) == 0 and chunks % pp == 0)")
@@ -305,6 +308,14 @@ def resolve_execution_config(cfg, ns: argparse.Namespace):
     return cfg
 
 
+def _int_list(text: str):
+    """argparse type for comma-separated ints (empty tokens tolerated)."""
+    try:
+        return [int(x) for x in text.split(",") if x.strip()]
+    except ValueError:
+        raise argparse.ArgumentTypeError(f"expected comma-separated ints, got {text!r}")
+
+
 def hybrid_config_from_args(ns: argparse.Namespace, num_layers: int, world: int):
     """GLOBAL-flags → uniform strategy, or JSON file → per-layer strategies
     (reference: the two config modes of get_hybrid_parallel_configs_api,
@@ -339,6 +350,8 @@ def hybrid_config_from_args(ns: argparse.Namespace, num_layers: int, world: int)
             embed_dp_type="zero3" if ns.embed_sdp else "ddp",
             mixed_precision=ns.mixed_precision,
         )
+        if getattr(ns, "pp_division", None):
+            hp.pp_division = ns.pp_division
     return hp
 
 
