@@ -309,11 +309,14 @@ def resolve_execution_config(cfg, ns: argparse.Namespace):
 
 
 def _int_list(text: str):
-    """argparse type for comma-separated ints (empty tokens tolerated)."""
+    """argparse type for comma-separated ints (trailing commas tolerated)."""
     try:
-        return [int(x) for x in text.split(",") if x.strip()]
+        out = [int(x) for x in text.split(",") if x.strip()]
     except ValueError:
         raise argparse.ArgumentTypeError(f"expected comma-separated ints, got {text!r}")
+    if not out:
+        raise argparse.ArgumentTypeError(f"expected comma-separated ints, got {text!r}")
+    return out
 
 
 def hybrid_config_from_args(ns: argparse.Namespace, num_layers: int, world: int):

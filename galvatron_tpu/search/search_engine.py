@@ -472,23 +472,32 @@ class SearchEngine:
         Returns the formatted table (also useful in tests)."""
         world = self.space.world_size
         cands = list(strategies) if strategies else generate_layer_strategies(self.space, pp)
-        lt = self._layer_type(0)
         lines = [
             f"check_cost_model: bsz={global_bsz} chunks={chunks} pp={pp} "
             f"{pipeline_type} world={world}",
-            f"{'strategy':>16} | {'states MB':>9} | {'act MB':>8} | {'total MB':>8} | {'time ms':>8}",
         ]
-        for s in cands:
-            dp = world // (pp * s.tp * s.cp)
-            mc = layer_memory_cost(
-                lt, s, world, pp, global_bsz, chunks, stage_idx=0,
-                pipeline_type=pipeline_type, mixed_precision=self.mp,
-            )
-            t = layer_time_cost(lt, s, self.hw, world, pp, global_bsz, mixed_precision=self.mp)
+        # one per-strategy table per layer type (enc-dec models carry two)
+        groups = self._type_groups()
+        for gi, (start, cnt, lt) in enumerate(groups):
+            if len(groups) > 1:
+                lines.append(f"layer type {gi} (layers {start}..{start + cnt - 1}):")
             lines.append(
-                f"{form_strategy(s, pp, dp):>16} | {mc.states_mb:9.1f} | "
-                f"{mc.activation_mb:8.1f} | {mc.total_mb:8.1f} | {t:8.2f}"
+                f"{'strategy':>16} | {'states MB':>9} | {'act MB':>8} | "
+                f"{'total MB':>8} | {'time ms':>8}"
             )
+            for s in cands:
+                dp = world // (pp * s.tp * s.cp)
+                mc = layer_memory_cost(
+                    lt, s, world, pp, global_bsz, chunks, stage_idx=0,
+                    pipeline_type=pipeline_type, mixed_precision=self.mp,
+                )
+                t = layer_time_cost(
+                    lt, s, self.hw, world, pp, global_bsz, mixed_precision=self.mp
+                )
+                lines.append(
+                    f"{form_strategy(s, pp, dp):>16} | {mc.states_mb:9.1f} | "
+                    f"{mc.activation_mb:8.1f} | {mc.total_mb:8.1f} | {t:8.2f}"
+                )
         # vocab/embedding strategy tradeoff (searched dimension)
         lines.append(
             f"{'vocab strategy':>16} | {'other MB':>9} | {'other ms':>8}"
