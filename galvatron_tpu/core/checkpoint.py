@@ -115,6 +115,97 @@ def _legacy_layout_message(abstract_state: Any, err: str) -> Optional[str]:
     return None
 
 
+def save_checkpoint_portable(ckpt_dir: str, state: Any, step: int, runtime) -> str:
+    """Save in the PORTABLE (flat-layers) layout: pipeline engines unstack
+    their stage/virtual-stage parameter stacks first, so a checkpoint saved
+    at any (pp, vpp, schedule, division) restores into any other — the
+    cross-layout resume the reference cannot express (its trainer never
+    saves at all, SURVEY §5)."""
+    f = runtime.flatten_params
+    if f is None:
+        return save_checkpoint(ckpt_dir, state, step)
+
+    def flatten_state(st):
+        out = dict(st)
+        out["params"] = f(st["params"])
+        out["opt"] = {**st["opt"], "mu": f(st["opt"]["mu"]), "nu": f(st["opt"]["nu"])}
+        return out
+
+    # one compiled program instead of per-leaf eager slice dispatches
+    flat = jax.jit(flatten_state)(state)
+    return save_checkpoint(ckpt_dir, flat, step)
+
+
+def restore_checkpoint_portable(ckpt_dir: str, runtime, step: Optional[int] = None) -> Any:
+    """Restore a portable (flat-layout) checkpoint into the runtime's own
+    layout, resharding as needed. Flat leaves restore under the per-layer
+    GSPMD specs of the runtime's strategies (sharded over tp/dp, replicated
+    over pp — a transient pp-fold duplication of each device's stage share),
+    then a jitted restack lands them on the engine's stage stacks."""
+    if runtime.restack_params is None:
+        return restore_checkpoint(ckpt_dir, abstract_state_of(runtime), step)
+    flat_abstract = flat_abstract_state_of(runtime)
+    try:
+        flat = restore_checkpoint(ckpt_dir, flat_abstract, step)
+    except Exception as flat_err:
+        # pre-portable checkpoints carry the engine's STACKED layout; fall
+        # back to a direct same-layout restore before giving up
+        try:
+            return restore_checkpoint(ckpt_dir, abstract_state_of(runtime), step)
+        except Exception:
+            raise ValueError(
+                "checkpoint matches neither the portable flat-layers layout "
+                "nor this runtime's stacked layout — it was likely saved "
+                "under a different pipeline configuration by a pre-portable "
+                "revision; resume it once with its original configuration to "
+                f"re-save portably. Flat-restore error: {str(flat_err)[:500]}"
+            ) from flat_err
+    r = runtime.restack_params
+
+    def restack_state(st):
+        out = dict(st)
+        out["params"] = r(st["params"])
+        out["opt"] = {**st["opt"], "mu": r(st["opt"]["mu"]), "nu": r(st["opt"]["nu"])}
+        return out
+
+    return jax.jit(restack_state, out_shardings=runtime.state_shardings)(flat)
+
+
+def flat_abstract_state_of(runtime) -> Any:
+    """Abstract flat-layout train state (the portable checkpoint schema):
+    shapes from the flat model init + Adam moments, shardings from the
+    per-layer GSPMD specs over the runtime's mesh."""
+    import jax.numpy as jnp
+
+    from galvatron_tpu.core.optim import init_opt_state
+    from galvatron_tpu.models import modeling
+    from galvatron_tpu.parallel.hybrid import state_specs
+    from galvatron_tpu.parallel.sharding import sharding_tree
+
+    def flat_init(key):
+        params = modeling.init_model_params(key, runtime.cfg)
+        st = {
+            "params": params,
+            "opt": init_opt_state(params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+        if "scaler" in runtime.state_shardings:
+            from galvatron_tpu.core.schedules import LossScalerConfig, init_scaler_state
+
+            st["scaler"] = init_scaler_state(LossScalerConfig())
+        return st
+
+    shapes = jax.eval_shape(flat_init, jax.random.key(0))
+    specs = state_specs(shapes, runtime.cfg, runtime.hp, runtime.axes)
+    shardings = sharding_tree(runtime.mesh, specs)
+    return jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes,
+        shardings,
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+    )
+
+
 def abstract_state_of(runtime, init_key=None) -> Any:
     """Abstract (shape+sharding) pytree for the runtime's train state."""
     import jax.numpy as jnp

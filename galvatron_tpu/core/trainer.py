@@ -14,10 +14,9 @@ import numpy as np
 
 from galvatron_tpu.core.arguments import hybrid_config_from_args, model_config_from_args
 from galvatron_tpu.core.checkpoint import (
-    abstract_state_of,
     latest_step,
-    restore_checkpoint,
-    save_checkpoint,
+    restore_checkpoint_portable,
+    save_checkpoint_portable,
 )
 from galvatron_tpu.core.dataloader import build_dataloader
 from galvatron_tpu.core.optim import AdamConfig
@@ -109,7 +108,7 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
 
     start_step = 0
     if ns.load and latest_step(ns.load) is not None:
-        state = restore_checkpoint(ns.load, abstract_state_of(rt))
+        state = restore_checkpoint_portable(ns.load, rt)
         start_step = int(np.asarray(state["step"]))
         if verbose:
             print(f"resumed from {ns.load} at step {start_step}")
@@ -203,7 +202,7 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
                         iter_ms=(prof.iter_times_ms[-1] if prof.iter_times_ms else None),
                     )
                 if ns.save and ns.save_interval and (it + 1) % ns.save_interval == 0:
-                    save_checkpoint(ns.save, state, it + 1)
+                    save_checkpoint_portable(ns.save, state, it + 1, rt)
                     if verbose:
                         print(f"saved step {it + 1} → {ns.save}")
         prof.finish(loss if iters_run else None)
@@ -219,7 +218,7 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
     if ns.save:
         final_step = int(np.asarray(state["step"]))
         if latest_step(ns.save) != final_step:
-            save_checkpoint(ns.save, state, final_step)
+            save_checkpoint_portable(ns.save, state, final_step, rt)
     metrics.close()
     # throughput from actual samples processed (rampup runs at smaller sizes)
     avg_bs = (consumed - consumed_at_start) / iters_run if iters_run else 0

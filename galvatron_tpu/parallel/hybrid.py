@@ -137,6 +137,12 @@ class HybridParallelRuntime:
     # pretrained-weight entry point (e.g. models/convert.py HF import). The
     # pipeline runtime restacks transformer layers per stage first.
     init_state_from: Callable = None
+    # portable-checkpoint layout transforms (None = params are already flat):
+    # flatten_params: engine layout -> flat {layers: [...]} tree;
+    # restack_params: the inverse. Checkpoints are always SAVED flat so
+    # resume works across pipeline degrees/schedules (core/checkpoint.py).
+    flatten_params: Callable = None
+    restack_params: Callable = None
 
     def shard_batch(self, batch_np):
         """Global on-device batch from a (host-replicated) numpy batch.

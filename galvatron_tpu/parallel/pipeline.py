@@ -205,6 +205,23 @@ def restack_flat_layers(flat_params, cfg: ModelConfig, hp: HybridParallelConfig)
     return params
 
 
+def flatten_stacked_layers(params, cfg: ModelConfig, hp: HybridParallelConfig):
+    """Inverse of restack_flat_layers: ``stages[j]`` stacks → the flat
+    ``layers`` list (padded slots dropped). Portable-checkpoint layout
+    (core/checkpoint.py): checkpoints are always saved flat so resume works
+    across pipeline degrees and schedules."""
+    div, offsets, pos = stage_layout(cfg, hp)
+    flat = {k: v for k, v in params.items() if k != "stages"}
+    layers = [None] * cfg.num_layers
+    for s_ in range(hp.pp):
+        for j in range(div[s_]):
+            layers[offsets[s_] + j] = jax.tree.map(
+                lambda a, s__=s_: a[s__], params["stages"][j]
+            )
+    flat["layers"] = layers
+    return flat
+
+
 def init_pipeline_params(key, cfg: ModelConfig, hp: HybridParallelConfig):
     """Param tree for pp>1: embed/final_norm/head as usual (replicated over pp);
     transformer layers as ``stages[j]`` — position-j layer params stacked over
@@ -428,9 +445,11 @@ def build_pipeline_runtime(
     interleaved = hp.vpp > 1
     if interleaved:
         from galvatron_tpu.parallel.pipeline_interleaved import (
+            flatten_vstages,
             init_interleaved_params,
             interleaved_param_specs,
             interleaved_pipeline,
+            restack_flat_vstages,
             validate_interleaved_strategies,
         )
 
@@ -510,29 +529,20 @@ def build_pipeline_runtime(
             state["scaler"] = init_scaler_state(scaler_cfg)
         return state
 
+    restack = (
+        (lambda fp: restack_flat_vstages(fp, cfg, hp))
+        if interleaved
+        else (lambda fp: restack_flat_layers(fp, cfg, hp))
+    )
+    flatten = (
+        (lambda sp: flatten_vstages(sp, cfg, hp))
+        if interleaved
+        else (lambda sp: flatten_stacked_layers(sp, cfg, hp))
+    )
+
     def state_from(flat_params):
-        # flat model tree → the schedule's stacked layout: restack_flat_layers
-        # for plain stages; interleaved vstages[q][leaf] = (pp, vpp) stack
-        # with [s, j] = layer (s + j*pp)*lpvs + q (init_interleaved_params)
-        if interleaved:
-            layers = flat_params["layers"]
-            params = {k: v for k, v in flat_params.items() if k != "layers"}
-            lpvs = cfg.num_layers // (hp.pp * hp.vpp)
-            params["vstages"] = [
-                jax.tree.map(
-                    lambda *per_s: jnp.stack(per_s),
-                    *[
-                        jax.tree.map(
-                            lambda *per_j: jnp.stack(per_j),
-                            *[layers[(s + j * hp.pp) * lpvs + q] for j in range(hp.vpp)],
-                        )
-                        for s in range(hp.pp)
-                    ],
-                )
-                for q in range(lpvs)
-            ]
-        else:
-            params = restack_flat_layers(flat_params, cfg, hp)
+        # flat model tree → the schedule's stacked layout
+        params = restack(flat_params)
         state = {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
         if fp16:
             state["scaler"] = init_scaler_state(scaler_cfg)
@@ -575,4 +585,5 @@ def build_pipeline_runtime(
         train_step=jit_train, eval_loss=jit_eval, init_state=jit_init,
         state_shardings=shardings, batch_sharding=batch_sharding,
         init_state_from=jit_state_from,
+        flatten_params=flatten, restack_params=restack,
     )

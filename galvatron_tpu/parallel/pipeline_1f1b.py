@@ -76,8 +76,10 @@ def make_1f1b_train_step(
 ):
     from galvatron_tpu.parallel.hybrid import HybridParallelRuntime
     from galvatron_tpu.parallel.pipeline import (
+        flatten_stacked_layers,
         init_pipeline_params,
         pipeline_param_specs,
+        restack_flat_layers,
     )
 
     pp, chunks = hp.pp, max(1, hp.chunks)
@@ -274,8 +276,6 @@ def make_1f1b_train_step(
         return state
 
     def state_from(flat_params):
-        from galvatron_tpu.parallel.pipeline import restack_flat_layers
-
         params = restack_flat_layers(flat_params, cfg, hp)
         state = {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
         if fp16:
@@ -319,4 +319,6 @@ def make_1f1b_train_step(
         train_step=jit_train, eval_loss=jit_eval, init_state=jit_init,
         state_shardings=shardings, batch_sharding=batch_sharding,
         init_state_from=jit_state_from,
+        flatten_params=lambda sp: flatten_stacked_layers(sp, cfg, hp),
+        restack_params=lambda fp: restack_flat_layers(fp, cfg, hp),
     )

@@ -138,6 +138,51 @@ def init_encdec_pipeline_params(key, cfg: ModelConfig, hp: HybridParallelConfig)
     return base
 
 
+def restack_flat_encdec(flat_params, cfg: ModelConfig, hp: HybridParallelConfig):
+    """Flat ``enc_layers``/``layers`` lists → the enc/dec virtual-stage
+    stacks (portable-checkpoint layout)."""
+    pp = hp.pp
+    lpe, lpd = cfg.enc_layers // pp, cfg.num_layers // pp
+    params = {
+        k: v for k, v in flat_params.items() if k not in ("enc_layers", "layers")
+    }
+    params["enc_stages"] = [
+        jax.tree.map(
+            lambda *ls: jnp.stack(ls),
+            *[flat_params["enc_layers"][s * lpe + q] for s in range(pp)],
+        )
+        for q in range(lpe)
+    ]
+    params["dec_stages"] = [
+        jax.tree.map(
+            lambda *ls: jnp.stack(ls),
+            *[flat_params["layers"][s * lpd + q] for s in range(pp)],
+        )
+        for q in range(lpd)
+    ]
+    return params
+
+
+def flatten_encdec(params, cfg: ModelConfig, hp: HybridParallelConfig):
+    """Inverse of restack_flat_encdec."""
+    pp = hp.pp
+    lpe, lpd = cfg.enc_layers // pp, cfg.num_layers // pp
+    flat = {
+        k: v for k, v in params.items() if k not in ("enc_stages", "dec_stages")
+    }
+    flat["enc_layers"] = [
+        jax.tree.map(lambda a, s_=s: a[s_], params["enc_stages"][q])
+        for s in range(pp)
+        for q in range(lpe)
+    ]
+    flat["layers"] = [
+        jax.tree.map(lambda a, s_=s: a[s_], params["dec_stages"][q])
+        for s in range(pp)
+        for q in range(lpd)
+    ]
+    return flat
+
+
 def encdec_param_specs(
     params_shape, cfg: ModelConfig, hp: HybridParallelConfig, axes: MeshAxes,
     *, for_opt_state: bool = False,
@@ -362,27 +407,7 @@ def build_encdec_pipeline_runtime(
         return {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
 
     def state_from(flat_params):
-        # flat enc_layers / layers lists → the (pp, ...) virtual-stage stacks
-        pp_ = hp.pp
-        params = {
-            k: v
-            for k, v in flat_params.items()
-            if k not in ("enc_layers", "layers")
-        }
-        params["enc_stages"] = [
-            jax.tree.map(
-                lambda *ls: jnp.stack(ls),
-                *[flat_params["enc_layers"][s * lpe + q] for s in range(pp_)],
-            )
-            for q in range(lpe)
-        ]
-        params["dec_stages"] = [
-            jax.tree.map(
-                lambda *ls: jnp.stack(ls),
-                *[flat_params["layers"][s * lpd + q] for s in range(pp_)],
-            )
-            for q in range(lpd)
-        ]
+        params = restack_flat_encdec(flat_params, cfg, hp)
         return {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
 
     state_shape = jax.eval_shape(init_state, jax.random.key(0))
@@ -418,4 +443,6 @@ def build_encdec_pipeline_runtime(
         train_step=jit_train, eval_loss=jit_eval, init_state=jit_init,
         state_shardings=shardings, batch_sharding=batch_sharding,
         init_state_from=jit_state_from,
+        flatten_params=lambda sp: flatten_encdec(sp, cfg, hp),
+        restack_params=lambda fp: restack_flat_encdec(fp, cfg, hp),
     )

@@ -90,6 +90,46 @@ def init_interleaved_params(key, cfg: ModelConfig, hp: HybridParallelConfig):
     return base
 
 
+def restack_flat_vstages(flat_params, cfg: ModelConfig, hp: HybridParallelConfig):
+    """Flat ``layers`` list → the ``vstages[q]`` (pp, vpp) stacks; entry
+    [s, j] is layer (s + j·pp)·lpvs + q (shared by the gpipe-ordered and
+    1F1B interleaved engines)."""
+    pp, vpp = hp.pp, hp.vpp
+    lpvs = cfg.num_layers // (pp * vpp)
+    layers = flat_params["layers"]
+    params = {k: v for k, v in flat_params.items() if k != "layers"}
+    params["vstages"] = [
+        jax.tree.map(
+            lambda *per_s: jnp.stack(per_s),
+            *[
+                jax.tree.map(
+                    lambda *per_j: jnp.stack(per_j),
+                    *[layers[(s + j * pp) * lpvs + q] for j in range(vpp)],
+                )
+                for s in range(pp)
+            ],
+        )
+        for q in range(lpvs)
+    ]
+    return params
+
+
+def flatten_vstages(params, cfg: ModelConfig, hp: HybridParallelConfig):
+    """Inverse of restack_flat_vstages (portable-checkpoint layout)."""
+    pp, vpp = hp.pp, hp.vpp
+    lpvs = cfg.num_layers // (pp * vpp)
+    flat = {k: v for k, v in params.items() if k != "vstages"}
+    layers = [None] * cfg.num_layers
+    for q in range(lpvs):
+        for s in range(pp):
+            for j in range(vpp):
+                layers[(s + j * pp) * lpvs + q] = jax.tree.map(
+                    lambda a, s_=s, j_=j: a[s_, j_], params["vstages"][q]
+                )
+    flat["layers"] = layers
+    return flat
+
+
 def interleaved_param_specs(
     params_shape, cfg: ModelConfig, hp: HybridParallelConfig, axes: MeshAxes,
     *, for_opt_state: bool = False,
@@ -416,22 +456,7 @@ def make_interleaved_1f1b_train_step(
         return state
 
     def state_from(flat_params):
-        lpvs = cfg.num_layers // (pp * vpp)
-        layers = flat_params["layers"]
-        params = {k: v for k, v in flat_params.items() if k != "layers"}
-        params["vstages"] = [
-            jax.tree.map(
-                lambda *per_s: jnp.stack(per_s),
-                *[
-                    jax.tree.map(
-                        lambda *per_j: jnp.stack(per_j),
-                        *[layers[(s_ + j * pp) * lpvs + q] for j in range(vpp)],
-                    )
-                    for s_ in range(pp)
-                ],
-            )
-            for q in range(lpvs)
-        ]
+        params = restack_flat_vstages(flat_params, cfg, hp)
         state = {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
         if fp16:
             state["scaler"] = init_scaler_state(scaler_cfg)
@@ -472,4 +497,6 @@ def make_interleaved_1f1b_train_step(
         train_step=jit_train, eval_loss=jit_eval, init_state=jit_init,
         state_shardings=shardings, batch_sharding=batch_sharding,
         init_state_from=jit_state_from,
+        flatten_params=lambda sp: flatten_vstages(sp, cfg, hp),
+        restack_params=lambda fp: restack_flat_vstages(fp, cfg, hp),
     )
