@@ -25,15 +25,15 @@ import numpy as np
 REF_MS_PER_LAYER_PER_SAMPLE = 4.64
 
 
-def measure(cfg, bsz, seq, iters=6, reps=5):
-    """Best-of-``reps`` timing windows of ``iters`` chained forwards.
+def make_window(cfg, bsz, seq, iters=6):
+    """One-dispatch timing window of ``iters`` chained forwards.
 
     The whole window runs as ONE dispatch (a ``lax.scan`` whose carry makes
     every iteration data-dependent on the last — XLA cannot fold or reorder
     it), so a busy host cannot starve the device between iterations: per-iter
     Python dispatch through the remote tunnel is exactly the contention
     artifact that inflated driver-captured numbers by ~0.4 ms/layer/sample.
-    Min over windows is the standard noise-robust estimator."""
+    Returns a zero-arg callable: one timed window in ms/iteration."""
     from galvatron_tpu.models import modeling
 
     params = modeling.init_model_params(jax.random.key(0), cfg)
@@ -59,12 +59,13 @@ def measure(cfg, bsz, seq, iters=6, reps=5):
         return c
 
     _ = float(window(params, tokens))  # compile + sync
-    best = float("inf")
-    for _ in range(reps):
+
+    def run():
         t0 = time.perf_counter()
         _ = float(window(params, tokens))
-        best = min(best, (time.perf_counter() - t0) / iters * 1000.0)
-    return best
+        return (time.perf_counter() - t0) / iters * 1000.0
+
+    return run
 
 
 def main():
@@ -83,9 +84,21 @@ def main():
         attn_impl="flash" if jax.default_backend() != "cpu" else "xla",
     )
     l1, l2 = 2, 6
-    t1 = measure(base.replace(num_layers=l1), bsz, seq)
-    t2 = measure(base.replace(num_layers=l2), bsz, seq)
-    ms_per_layer_per_sample = (t2 - t1) / (l2 - l1) / bsz
+    # PAIRED rounds: each round times an adjacent (L1, L2) window pair, so
+    # chip-state drift over the run cannot bias the layer difference (the
+    # chip drifts on minutes-to-hours scales; an unpaired all-L1-then-all-L2
+    # ordering folds that drift straight into t2 - t1). MEDIAN over the
+    # per-round differences is robust to both drift (the pairing) and
+    # asymmetric contention spikes (a positive spike on the small window
+    # SHRINKS that round's diff, so a min would seek corrupted rounds).
+    w1 = make_window(base.replace(num_layers=l1), bsz, seq)
+    w2 = make_window(base.replace(num_layers=l2), bsz, seq)
+    diffs = []
+    for _ in range(5):
+        t1 = w1()
+        t2 = w2()
+        diffs.append((t2 - t1) / (l2 - l1) / bsz)
+    ms_per_layer_per_sample = float(np.median(diffs))
     print(
         json.dumps(
             {
