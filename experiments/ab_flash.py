@@ -1,0 +1,557 @@
+"""A/B flash-attention forward variants in-context (paired layer-diff).
+
+Variants (all forward-only; bench never differentiates):
+  base : current galvatron_tpu.ops.flash_attention
+  v1b  : same grid, softmax scale folded into the q-side rope tables
+  v2c  : per-q-block specialized pallas calls, statically unrolled k loop,
+         value-carried (m, l, acc), additive triangular bias on the diagonal
+         block, scale folded into rope.
+
+Usage: python experiments/ab_flash.py [--variants base,v1b,v2c] [--rounds 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+
+from galvatron_tpu.ops import flash_attention as fa
+
+NEG_INF = -1e30
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
+
+
+def _rope_rows(x, c, s):
+    xf = x.astype(jnp.float32)
+    d2 = xf.shape[-1] // 2
+    x1, x2 = xf[:, :d2], xf[:, d2:]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# v1b: current structure, scale folded into q rope tables
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel_v1b(*refs, causal, block_q, block_k, num_k_blocks):
+    q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref = refs[:7]
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = refs[7:]
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    if causal:
+        last_j = jnp.minimum(((i + 1) * block_q - 1) // block_k, num_k_blocks - 1)
+        contributes = ((i + 1) * block_q - 1) >= j * block_k
+        fully_below = (i * block_q) >= ((j + 1) * block_k - 1)
+    else:
+        last_j = num_k_blocks - 1
+        contributes = fully_below = None
+
+    def _accum(masked):
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        # cq/sq pre-scaled by sm_scale*LOG2E: s comes out in base-2 units
+        q = _rope_rows(q, cq_ref[...], sq_ref[...]).astype(q_ref.dtype)
+        k = _rope_rows(k, ck_ref[...], sk_ref[...]).astype(k_ref.dtype)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if masked:
+            rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_old = m_scr[:, :1]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m_old - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = alpha * acc_scr[:] + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    fa._dispatch_causal(causal, contributes, fully_below, _accum)
+
+    @pl.when(j == last_j)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0, 0] = (
+            m_scr[:, :1] * LN2 + jnp.log(jnp.maximum(l, 1e-30))
+        ).astype(jnp.float32)
+
+
+def flash_v1b(q, k, v, causal=True, sm_scale=None, block_q=1024, block_k=1024, rope=None):
+    b, s, n, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    assert rope is not None and s % block_q == 0 and s % block_k == 0
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    nq, nk = s // block_q, s // block_k
+    lam = sm_scale * LOG2E
+    cos, sin = rope
+    cqs, sqs = cos * lam, sin * lam
+    grid = (b, n, nq, nk)
+    qrow = pl.BlockSpec((block_q, d // 2), lambda b_, h_, i, j: (i, 0))
+    krow = pl.BlockSpec((block_k, d // 2), lambda b_, h_, i, j: (j, 0))
+    out, _lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel_v1b, causal=causal, block_q=block_q, block_k=block_k,
+            num_k_blocks=nk,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+            qrow, qrow, krow, krow,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, n, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+        ),
+    )(qt, kt, vt, cqs, sqs, cos, sin)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+# ---------------------------------------------------------------------------
+# v2c: per-q-block specialized calls, unrolled k loop, value accumulation
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel_v2c(*refs, nkb, diag, block_q, block_k, d):
+    if diag:
+        q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref, tri_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref, o_ref, lse_ref = refs
+    q = _rope_rows(q_ref[0, 0], cq_ref[...], sq_ref[...]).astype(q_ref.dtype)
+    kf = _rope_rows(k_ref[0, 0], ck_ref[...], sk_ref[...]).astype(k_ref.dtype)
+    vf = v_ref[0, 0]
+    m = l = acc = None
+    for j in range(nkb):
+        kj = kf[j * block_k:(j + 1) * block_k]
+        s = jax.lax.dot_general(
+            q, kj, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if diag and j == nkb - 1:
+            s = s + tri_ref[...].astype(jnp.float32)
+        pv_j = None
+        if j == 0:
+            m = jnp.max(s, axis=1, keepdims=True)
+            p = jnp.exp2(s - m)
+            l = jnp.sum(p, axis=1, keepdims=True)
+            acc = jax.lax.dot(
+                p.astype(vf.dtype), vf[:block_k], preferred_element_type=jnp.float32
+            )
+        else:
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp2(s - m_new)
+            alpha = jnp.exp2(m - m_new)
+            l = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+            acc = alpha * acc + jax.lax.dot(
+                p.astype(vf.dtype), vf[j * block_k:(j + 1) * block_k],
+                preferred_element_type=jnp.float32,
+            )
+            m = m_new
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m * LN2 + jnp.log(jnp.maximum(l, 1e-30))).astype(jnp.float32)
+
+
+def flash_v2c(q, k, v, causal=True, sm_scale=None, block_q=1024, block_k=1024, rope=None):
+    b, s, n, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    assert rope is not None and causal and block_q == block_k and s % block_q == 0
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    nq = s // block_q
+    lam = sm_scale * LOG2E
+    cos, sin = rope
+    cqs, sqs = cos * lam, sin * lam
+    r = np.arange(block_q)
+    tri = jnp.asarray(
+        np.where(r[:, None] >= r[None, :], 0.0, NEG_INF), jnp.bfloat16
+    )
+    outs = []
+    for i in range(nq):
+        nkb = i + 1
+        kl = nkb * block_k
+        out_i, _lse_i = pl.pallas_call(
+            functools.partial(
+                _fwd_kernel_v2c, nkb=nkb, diag=True, block_q=block_q,
+                block_k=block_k, d=d,
+            ),
+            grid=(b, n),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i=i: (b_, h_, i, 0)),
+                pl.BlockSpec((1, 1, kl, d), lambda b_, h_: (b_, h_, 0, 0)),
+                pl.BlockSpec((1, 1, kl, d), lambda b_, h_: (b_, h_, 0, 0)),
+                pl.BlockSpec((block_q, d // 2), lambda b_, h_, i=i: (i, 0)),
+                pl.BlockSpec((block_q, d // 2), lambda b_, h_, i=i: (i, 0)),
+                pl.BlockSpec((kl, d // 2), lambda b_, h_: (0, 0)),
+                pl.BlockSpec((kl, d // 2), lambda b_, h_: (0, 0)),
+                pl.BlockSpec((block_q, block_k), lambda b_, h_: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, d), lambda b_, h_: (b_, h_, 0, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_: (b_, h_, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, n, block_q, d), q.dtype),
+                jax.ShapeDtypeStruct((b, n, block_q, 1), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")
+            ),
+        )(qt, kt, vt, cqs, sqs, cos, sin, tri)
+        outs.append(out_i)
+    out = jnp.concatenate(outs, axis=2) if nq > 1 else outs[0]
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+# ---------------------------------------------------------------------------
+# v2d: ONE call, both q blocks unrolled in-kernel (no output concat)
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel_v2d(*refs, nq, nk, block_q, block_k, d):
+    q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref, tri_ref, o_ref, lse_ref = refs
+    qf = _rope_rows(q_ref[0, 0], cq_ref[...], sq_ref[...]).astype(q_ref.dtype)
+    kf = _rope_rows(k_ref[0, 0], ck_ref[...], sk_ref[...]).astype(k_ref.dtype)
+    vf = v_ref[0, 0]
+    for i in range(nq):
+        q = qf[i * block_q:(i + 1) * block_q]
+        m = l = acc = None
+        # causal, bq == bk: exactly blocks j <= i contribute; j == i is diagonal
+        for j in range(i + 1):
+            kj = kf[j * block_k:(j + 1) * block_k]
+            s = jax.lax.dot_general(
+                q, kj, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            if j == i:
+                s = s + tri_ref[...].astype(jnp.float32)
+            if j == 0:
+                m = jnp.max(s, axis=1, keepdims=True)
+                p = jnp.exp2(s - m)
+                l = jnp.sum(p, axis=1, keepdims=True)
+                acc = jax.lax.dot(
+                    p.astype(vf.dtype), vf[:block_k], preferred_element_type=jnp.float32
+                )
+            else:
+                m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+                p = jnp.exp2(s - m_new)
+                alpha = jnp.exp2(m - m_new)
+                l = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+                acc = alpha * acc + jax.lax.dot(
+                    p.astype(vf.dtype), vf[j * block_k:(j + 1) * block_k],
+                    preferred_element_type=jnp.float32,
+                )
+                m = m_new
+        o_ref[0, 0, i * block_q:(i + 1) * block_q] = (
+            acc / jnp.maximum(l, 1e-30)
+        ).astype(o_ref.dtype)
+        lse_ref[0, 0, i * block_q:(i + 1) * block_q] = (
+            m * LN2 + jnp.log(jnp.maximum(l, 1e-30))
+        ).astype(jnp.float32)
+
+
+def make_flash_v2d(block=1024):
+    def flash_v2d(q, k, v, causal=True, sm_scale=None, block_q=None, block_k=None, rope=None):
+        b, s, n, d = q.shape
+        bq = bk = block
+        if sm_scale is None:
+            sm_scale = 1.0 / float(np.sqrt(d))
+        assert rope is not None and causal and s % bq == 0
+        qt = jnp.transpose(q, (0, 2, 1, 3))
+        kt = jnp.transpose(k, (0, 2, 1, 3))
+        vt = jnp.transpose(v, (0, 2, 1, 3))
+        nq = s // bq
+        lam = sm_scale * LOG2E
+        cos, sin = rope
+        cqs, sqs = cos * lam, sin * lam
+        r = np.arange(bq)
+        tri = jnp.asarray(np.where(r[:, None] >= r[None, :], 0.0, NEG_INF), jnp.bfloat16)
+        full = pl.BlockSpec((1, 1, s, d), lambda b_, h_: (b_, h_, 0, 0))
+        rows = pl.BlockSpec((s, d // 2), lambda b_, h_: (0, 0))
+        out, _lse = pl.pallas_call(
+            functools.partial(_fwd_kernel_v2d, nq=nq, nk=nq, block_q=bq, block_k=bk, d=d),
+            grid=(b, n),
+            in_specs=[full, full, full, rows, rows, rows, rows,
+                      pl.BlockSpec((bq, bk), lambda b_, h_: (0, 0))],
+            out_specs=[full, pl.BlockSpec((1, 1, s, 1), lambda b_, h_: (b_, h_, 0, 0))],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
+                jax.ShapeDtypeStruct((b, n, s, 1), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")
+            ),
+        )(qt, kt, vt, cqs, sqs, cos, sin, tri)
+        return jnp.transpose(out, (0, 2, 1, 3))
+
+    return flash_v2d
+
+
+def make_flash_v2c(block):
+    return functools.partial(flash_v2c, block_q=block, block_k=block)
+
+
+# ---------------------------------------------------------------------------
+# v2e: per-q-block calls, bq=1024 / bk=512, explicit 2-deep dot pipeline
+# (next block's MXU dot issued before current block's VPU softmax);
+# v2f: same but ALL dots hoisted up front.
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel_v2e(*refs, i, nkb, block_q, block_k, d, hoist_all):
+    (q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref,
+     tri0_ref, tri1_ref, o_ref, lse_ref) = refs
+    q = _rope_rows(q_ref[0, 0], cq_ref[...], sq_ref[...]).astype(q_ref.dtype)
+    kf = _rope_rows(k_ref[0, 0], ck_ref[...], sk_ref[...]).astype(k_ref.dtype)
+    vf = v_ref[0, 0]
+    ratio = block_q // block_k  # k blocks per q block
+
+    def dot_j(j):
+        kj = kf[j * block_k:(j + 1) * block_k]
+        s = jax.lax.dot_general(
+            q, kj, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        # rows are i*block_q + r, cols j*block_k + c; the last `ratio` blocks
+        # straddle the diagonal with static offsets 0, block_k, ...
+        off = j * block_k - i * block_q
+        if off >= 0:
+            tri = tri0_ref if off == 0 else tri1_ref
+            s = s + tri[...].astype(jnp.float32)
+        return s
+
+    if hoist_all:
+        ss = [dot_j(j) for j in range(nkb)]
+    else:
+        ss = None
+    m = l = acc = None
+    s_cur = dot_j(0) if not hoist_all else None
+    for j in range(nkb):
+        s = ss[j] if hoist_all else s_cur
+        if not hoist_all and j + 1 < nkb:
+            s_cur = dot_j(j + 1)  # issue next dot before this block's softmax
+        if j == 0:
+            m = jnp.max(s, axis=1, keepdims=True)
+            p = jnp.exp2(s - m)
+            l = jnp.sum(p, axis=1, keepdims=True)
+            acc = jax.lax.dot(
+                p.astype(vf.dtype), vf[:block_k], preferred_element_type=jnp.float32
+            )
+        else:
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp2(s - m_new)
+            alpha = jnp.exp2(m - m_new)
+            l = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+            acc = alpha * acc + jax.lax.dot(
+                p.astype(vf.dtype), vf[j * block_k:(j + 1) * block_k],
+                preferred_element_type=jnp.float32,
+            )
+            m = m_new
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m * LN2 + jnp.log(jnp.maximum(l, 1e-30))).astype(jnp.float32)
+
+
+def make_flash_v2e(block_q=1024, block_k=512, hoist_all=False):
+    def flash_v2e(q, k, v, causal=True, sm_scale=None, rope=None, **_):
+        b, s, n, d = q.shape
+        bq, bk = block_q, block_k
+        if sm_scale is None:
+            sm_scale = 1.0 / float(np.sqrt(d))
+        assert rope is not None and causal and s % bq == 0 and bq % bk == 0
+        qt = jnp.transpose(q, (0, 2, 1, 3))
+        kt = jnp.transpose(k, (0, 2, 1, 3))
+        vt = jnp.transpose(v, (0, 2, 1, 3))
+        nq = s // bq
+        lam = sm_scale * LOG2E
+        cos, sin = rope
+        cqs, sqs = cos * lam, sin * lam
+        r = np.arange(bq)[:, None]
+        c = np.arange(bk)[None, :]
+        tri0 = jnp.asarray(np.where(r >= c, 0.0, NEG_INF), jnp.bfloat16)
+        tri1 = jnp.asarray(np.where(r >= c + bk, 0.0, NEG_INF), jnp.bfloat16)
+        outs = []
+        for i in range(nq):
+            nkb = (i + 1) * (bq // bk)
+            kl = nkb * bk
+            out_i, _lse_i = pl.pallas_call(
+                functools.partial(
+                    _fwd_kernel_v2e, i=i, nkb=nkb, block_q=bq, block_k=bk, d=d,
+                    hoist_all=hoist_all,
+                ),
+                grid=(b, n),
+                in_specs=[
+                    pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i=i: (b_, h_, i, 0)),
+                    pl.BlockSpec((1, 1, kl, d), lambda b_, h_: (b_, h_, 0, 0)),
+                    pl.BlockSpec((1, 1, kl, d), lambda b_, h_: (b_, h_, 0, 0)),
+                    pl.BlockSpec((bq, d // 2), lambda b_, h_, i=i: (i, 0)),
+                    pl.BlockSpec((bq, d // 2), lambda b_, h_, i=i: (i, 0)),
+                    pl.BlockSpec((kl, d // 2), lambda b_, h_: (0, 0)),
+                    pl.BlockSpec((kl, d // 2), lambda b_, h_: (0, 0)),
+                    pl.BlockSpec((bq, bk), lambda b_, h_: (0, 0)),
+                    pl.BlockSpec((bq, bk), lambda b_, h_: (0, 0)),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, 1, bq, d), lambda b_, h_: (b_, h_, 0, 0)),
+                    pl.BlockSpec((1, 1, bq, 1), lambda b_, h_: (b_, h_, 0, 0)),
+                ],
+                out_shape=[
+                    jax.ShapeDtypeStruct((b, n, bq, d), q.dtype),
+                    jax.ShapeDtypeStruct((b, n, bq, 1), jnp.float32),
+                ],
+                compiler_params=pltpu.CompilerParams(
+                    dimension_semantics=("parallel", "parallel")
+                ),
+            )(qt, kt, vt, cqs, sqs, cos, sin, tri0, tri1)
+            outs.append(out_i)
+        out = jnp.concatenate(outs, axis=2) if nq > 1 else outs[0]
+        return jnp.transpose(out, (0, 2, 1, 3))
+
+    return flash_v2e
+
+
+VARIANTS = {
+    "base": fa.flash_attention,
+    "v1b": flash_v1b,
+    "v2c": flash_v2c,
+    "v2c512": make_flash_v2c(512),
+    "v2d": make_flash_v2d(1024),
+    "v2d512": make_flash_v2d(512),
+    "v2e": make_flash_v2e(1024, 512, hoist_all=False),
+    "v2f": make_flash_v2e(1024, 512, hoist_all=True),
+    "v2e1024": make_flash_v2e(1024, 1024, hoist_all=False),
+}
+
+
+def check_numerics(names=None):
+    key = jax.random.key(0)
+    b, s, n, d = 2, 2048, 4, 128
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, n, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, s, n, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, s, n, d), jnp.bfloat16)
+    pos = np.arange(s)
+    inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+    fr = np.outer(pos, inv)
+    rope = (jnp.asarray(np.cos(fr), jnp.float32), jnp.asarray(np.sin(fr), jnp.float32))
+    ref = jax.jit(lambda q, k, v: fa.flash_attention(q, k, v, rope=rope))(q, k, v)
+    for name, fn in VARIANTS.items():
+        if name == "base" or (names is not None and name not in names):
+            continue
+        got = jax.jit(lambda q, k, v, fn=fn: fn(q, k, v, rope=rope))(q, k, v)
+        err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32))))
+        print(f"numerics {name}: max abs err vs base = {err:.4f}", flush=True)
+        assert err < 0.05, (name, err)
+
+
+def make_window(variant_fn, num_layers, bsz=8, seq=2048, iters=6):
+    import galvatron_tpu.ops.flash_attention as famod
+    from galvatron_tpu.models import modeling
+
+    famod_orig = famod.flash_attention
+    famod.flash_attention = variant_fn
+    try:
+        cfg = modeling.ModelConfig(
+            vocab_size=32000, hidden_size=4096, num_layers=num_layers,
+            num_heads=32, ffn_dim=11008, max_seq_len=seq,
+            dtype=jnp.bfloat16, param_dtype=jnp.bfloat16, attn_impl="flash",
+        )
+        params = modeling.init_model_params(jax.random.key(0), cfg)
+        tokens = jnp.zeros((bsz, seq), jnp.int32)
+
+        def fwd(params, tokens, c):
+            x = modeling.embed(tokens, params, cfg)
+            x = x + c.astype(x.dtype)
+            cos_sin = modeling.rope_tables(cfg, seq)
+            for lp in params["layers"]:
+                x = modeling.decoder_layer(x, lp, cfg, cos_sin, None)
+            return jnp.sum(x.astype(jnp.float32))
+
+        @jax.jit
+        def window(params, tokens):
+            def body(c, _):
+                out = fwd(params, tokens, c * 1e-30)
+                return out * 1e-30, None
+
+            c, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=iters)
+            return c
+
+        _ = float(window(params, tokens))
+    finally:
+        famod.flash_attention = famod_orig
+
+    def run():
+        t0 = time.perf_counter()
+        _ = float(window(params, tokens))
+        return (time.perf_counter() - t0) / iters * 1000.0
+
+    return run
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variants", default="base,v1b,v2c")
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--skip_numerics", action="store_true")
+    args = ap.parse_args()
+    names = args.variants.split(",")
+    if not args.skip_numerics:
+        check_numerics(names)
+    l1, l2 = 2, 6
+    wins = {}
+    for nm in names:
+        print(f"compiling {nm}...", flush=True)
+        wins[nm] = (make_window(VARIANTS[nm], l1), make_window(VARIANTS[nm], l2))
+    results = {nm: [] for nm in names}
+    for r in range(args.rounds):
+        for nm in names:
+            w1, w2 = wins[nm]
+            t1 = w1()
+            t2 = w2()
+            diff = (t2 - t1) / (l2 - l1) / 8
+            results[nm].append(diff)
+            print(f"round {r} {nm}: {diff:.4f} ms/layer/sample", flush=True)
+    print("---")
+    for nm in names:
+        print(f"{nm}: median {np.median(results[nm]):.4f}  all={['%.4f' % x for x in results[nm]]}")
+
+
+if __name__ == "__main__":
+    main()

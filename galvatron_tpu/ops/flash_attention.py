@@ -211,6 +211,130 @@ def _flash_fwd(q, k, v, rope, sm_scale, causal, block_q, block_k, interpret,
 
 
 # ---------------------------------------------------------------------------
+# Blocked-causal forward: one pallas call per q row block, statically
+# unrolled k loop, value-carried (m, l, acc)
+# ---------------------------------------------------------------------------
+#
+# For the common causal+rope case the grid-scan kernel above leaves real time
+# on the table (measured on v5e, LLaMA-7B shape: ~0.14 ms/layer/sample):
+# every (i, j) grid step re-ropes q, pays scratch init/finalize bookkeeping,
+# and diagonal blocks run an iota+compare+select mask over the full score
+# block. Specializing ONE pallas call per q row block makes the causal
+# structure static — call i unrolls exactly the j <= i contributing k blocks,
+# the diagonal block applies a precomputed additive triangular bias, q is
+# roped once, and (m, l, acc) stay SSA values so Mosaic sees the whole
+# dependence graph. The softmax scale (and the exp->exp2 base change) is
+# folded into the q-side rope tables at trace time: the fp32 rotation output
+# is cast to bf16 regardless, so the scale costs nothing and the score block
+# needs no post-matmul multiply.
+
+
+def _fwd_kernel_blocked(*refs, nkb, block_q, block_k):
+    (q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref, tri_ref,
+     o_ref, lse_ref) = refs
+    # cq/sq pre-scaled by sm_scale*LOG2E: scores come out in base-2 units
+    q = _rope_rows(q_ref[0, 0], cq_ref[...], sq_ref[...]).astype(q_ref.dtype)
+    kf = _rope_rows(k_ref[0, 0], ck_ref[...], sk_ref[...]).astype(k_ref.dtype)
+    vf = v_ref[0, 0]
+    m = l = acc = None
+    for j in range(nkb):
+        kj = kf[j * block_k:(j + 1) * block_k]
+        s = jax.lax.dot_general(
+            q, kj, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if j == nkb - 1:  # bq == bk: only the last block straddles the diagonal
+            s = s + tri_ref[...].astype(jnp.float32)
+        if j == 0:
+            m = jnp.max(s, axis=1, keepdims=True)
+            p = jnp.exp2(s - m)
+            l = jnp.sum(p, axis=1, keepdims=True)
+            acc = jax.lax.dot(
+                p.astype(vf.dtype), vf[:block_k], preferred_element_type=jnp.float32
+            )
+        else:
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp2(s - m_new)
+            alpha = jnp.exp2(m - m_new)
+            l = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+            acc = alpha * acc + jax.lax.dot(
+                p.astype(vf.dtype), vf[j * block_k:(j + 1) * block_k],
+                preferred_element_type=jnp.float32,
+            )
+            m = m_new
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m * LN2 + jnp.log(jnp.maximum(l, 1e-30))).astype(jnp.float32)
+
+
+# The last q-block call keeps the full k prefix resident in VMEM (k, v, rope
+# rows, fp32 rope intermediates scale with s*d) and statically unrolls nq k
+# iterations; both must stay bounded. 4096*128 is the measured v5e budget at
+# the 1024-block default.
+_BLOCKED_MAX_SEQ_X_DIM = 4096 * 128
+_BLOCKED_MAX_UNROLL = 8
+
+
+def _use_blocked(s, d, causal, rope, block_q, block_k):
+    return (
+        causal
+        and rope is not None
+        and block_q == block_k
+        and s % block_q == 0
+        and s * d <= _BLOCKED_MAX_SEQ_X_DIM
+        and s // block_q <= _BLOCKED_MAX_UNROLL
+    )
+
+
+def _flash_fwd_blocked(q, k, v, rope, sm_scale, block_q, interpret, out_dtype=None):
+    """Blocked-causal forward. q/k/v: (b, h, s, d). Returns (out, lse)."""
+    b, h, s, d = q.shape
+    nq = s // block_q
+    lam = sm_scale * LOG2E
+    cos, sin = rope
+    cqs, sqs = cos * lam, sin * lam
+    r = np.arange(block_q)
+    tri = jnp.asarray(
+        np.where(r[:, None] >= r[None, :], 0.0, NEG_INF), jnp.bfloat16
+    )
+    outs, lses = [], []
+    for i in range(nq):
+        nkb = i + 1
+        kl = nkb * block_q
+        out_i, lse_i = pl.pallas_call(
+            functools.partial(
+                _fwd_kernel_blocked, nkb=nkb, block_q=block_q, block_k=block_q
+            ),
+            grid=(b, h),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i=i: (b_, h_, i, 0)),
+                pl.BlockSpec((1, 1, kl, d), lambda b_, h_: (b_, h_, 0, 0)),
+                pl.BlockSpec((1, 1, kl, d), lambda b_, h_: (b_, h_, 0, 0)),
+                pl.BlockSpec((block_q, d // 2), lambda b_, h_, i=i: (i, 0)),
+                pl.BlockSpec((block_q, d // 2), lambda b_, h_, i=i: (i, 0)),
+                pl.BlockSpec((kl, d // 2), lambda b_, h_: (0, 0)),
+                pl.BlockSpec((kl, d // 2), lambda b_, h_: (0, 0)),
+                pl.BlockSpec((block_q, block_q), lambda b_, h_: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, d), lambda b_, h_: (b_, h_, 0, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_: (b_, h_, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, h, block_q, d), out_dtype or q.dtype),
+                jax.ShapeDtypeStruct((b, h, block_q, 1), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")
+            ),
+            interpret=interpret,
+        )(q, k, v, cqs, sqs, cos, sin, tri)
+        outs.append(out_i)
+        lses.append(lse_i)
+    if nq == 1:
+        return outs[0], lses[0]
+    return jnp.concatenate(outs, axis=2), jnp.concatenate(lses, axis=2)
+
+
+# ---------------------------------------------------------------------------
 # Backward kernels
 # ---------------------------------------------------------------------------
 
@@ -430,14 +554,20 @@ def _flash_bwd_parts(
 # ---------------------------------------------------------------------------
 
 
+def _fwd_dispatch(q, k, v, rope, sm_scale, causal, block_q, block_k, interpret):
+    if _use_blocked(q.shape[2], q.shape[3], causal, rope, block_q, block_k):
+        return _flash_fwd_blocked(q, k, v, rope, sm_scale, block_q, interpret)
+    return _flash_fwd(q, k, v, rope, sm_scale, causal, block_q, block_k, interpret)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, rope, sm_scale, causal, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, rope, sm_scale, causal, block_q, block_k, _use_interpret())
+    out, _ = _fwd_dispatch(q, k, v, rope, sm_scale, causal, block_q, block_k, _use_interpret())
     return out
 
 
 def _flash_fwd_rule(q, k, v, rope, sm_scale, causal, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, rope, sm_scale, causal, block_q, block_k, _use_interpret())
+    out, lse = _fwd_dispatch(q, k, v, rope, sm_scale, causal, block_q, block_k, _use_interpret())
     return out, (q, k, v, out, lse, rope)
 
 

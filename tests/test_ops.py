@@ -89,6 +89,41 @@ def test_flash_fused_rope_matches_external_rope():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4)
 
 
+def test_flash_blocked_causal_path_matches_reference():
+    """The blocked-causal forward (one pallas call per q block, scale folded
+    into the q-side rope tables, additive triangular bias) is the production
+    path for causal+rope with equal tileable blocks — pin it against the
+    materialized-rope reference, forward AND gradients (the backward runs the
+    grid kernels from the blocked forward's saved LSE)."""
+    from galvatron_tpu.ops import flash_attention as fa
+
+    s, d = 128, 32
+    q, k, v = rand_qkv(jax.random.key(7), s=s, d=d)
+    cos, sin = _rope_tables(s, d)
+    assert fa._use_blocked(s, d, True, (cos, sin), 32, 32)
+
+    def f_blocked(q, k, v):
+        return (
+            flash_attention(q, k, v, causal=True, block_q=32, block_k=32, rope=(cos, sin)) ** 2
+        ).sum()
+
+    def f_ref(q, k, v):
+        qr = modeling.apply_rope(q, cos, sin)
+        kr = modeling.apply_rope(k, cos, sin)
+        return (ref_attention(qr, kr, v) ** 2).sum()
+
+    np.testing.assert_allclose(float(f_blocked(q, k, v)), float(f_ref(q, k, v)), rtol=2e-5)
+    g_blocked = jax.grad(f_blocked, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_blocked, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4)
+    # the gate scales with head_dim and unroll count, not bare seq length
+    assert not fa._use_blocked(8192, 128, True, (cos, sin), 1024, 1024)
+    assert not fa._use_blocked(4096, 256, True, (cos, sin), 1024, 1024)
+    assert not fa._use_blocked(4096, 128, True, (cos, sin), 128, 128)
+    assert fa._use_blocked(2048, 128, True, (cos, sin), 1024, 1024)
+
+
 def test_flash_fallback_preserves_causal_and_scale():
     """The untileable-shape fallback must honor causal=False (encoder models)
     and a caller-supplied sm_scale — regression: it used to rebuild a default
