@@ -124,6 +124,37 @@ def test_flash_blocked_causal_path_matches_reference():
     assert fa._use_blocked(2048, 128, True, (cos, sin), 1024, 1024)
 
 
+def test_headmajor_attn_block_matches_legacy_path():
+    """The head-major wiring (einsum projections + flash_attention_hm) is the
+    default production path for flash models — pin it against the legacy
+    project->transpose->flash path for (a) MHA blocked layout with qkv/wo
+    biases, (b) GQA interleaved layout."""
+    for kvh, bias in [(None, True), (2, False)]:
+        cfg = ModelConfig(
+            vocab_size=64, hidden_size=64, num_heads=4, num_kv_heads=kvh,
+            ffn_dim=128, max_seq_len=64, attn_impl="flash", use_bias=bias,
+        )
+        key = jax.random.key(10 if bias else 11)
+        p = modeling.init_layer_params(key, cfg)["attn"]
+        if bias:  # init zeros them; randomize so the broadcast is exercised
+            p = dict(p)
+            p["wqkv_b"] = jax.random.normal(jax.random.key(13), p["wqkv_b"].shape)
+            p["wo_b"] = jax.random.normal(jax.random.key(14), p["wo_b"].shape)
+        x = jax.random.normal(jax.random.key(12), (2, 64, 64), jnp.float32)
+        cos_sin = modeling.rope_tables(cfg, 64)
+        assert modeling.FLASH_HEADMAJOR
+        got = modeling.attn_block(x, p, cfg, cos_sin)
+        try:
+            modeling.FLASH_HEADMAJOR = False
+            ref = modeling.attn_block(x, p, cfg, cos_sin)
+        finally:
+            modeling.FLASH_HEADMAJOR = True
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg=f"kvh={kvh} bias={bias}",
+        )
+
+
 def test_flash_fallback_preserves_causal_and_scale():
     """The untileable-shape fallback must honor causal=False (encoder models)
     and a caller-supplied sm_scale — regression: it used to rebuild a default
