@@ -147,9 +147,17 @@ def restore_checkpoint_portable(ckpt_dir: str, runtime, step: Optional[int] = No
     flat_abstract = flat_abstract_state_of(runtime)
     try:
         flat = restore_checkpoint(ckpt_dir, flat_abstract, step)
+    except FileNotFoundError:
+        raise
     except Exception as flat_err:
         # pre-portable checkpoints carry the engine's STACKED layout; fall
-        # back to a direct same-layout restore before giving up
+        # back to a direct same-layout restore — but only on evidence of a
+        # layout/structure mismatch (orbax names missing/mismatched paths).
+        # A transient I/O or deserialization failure on a genuinely flat
+        # checkpoint must surface verbatim, not as "matches neither layout".
+        low = str(flat_err).lower()
+        if not any(w in low for w in ("missing", "mismatch", "structure", "rank", "shape")):
+            raise
         try:
             return restore_checkpoint(ckpt_dir, abstract_state_of(runtime), step)
         except Exception:

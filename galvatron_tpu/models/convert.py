@@ -425,11 +425,13 @@ def load_hf_checkpoint(path_or_model: Any) -> tuple:
         from transformers import AutoConfig, AutoModelForCausalLM
 
         hf_cfg = AutoConfig.from_pretrained(path_or_model)
-        name = type(hf_cfg).__name__.lower()
-        if "llama" not in name and "gpt2" not in name and "opt" not in name:
+        # exact model_type match — class-name substrings would misroute any
+        # future config class whose lowercase name happens to contain 'opt'
+        if getattr(hf_cfg, "model_type", None) not in ("llama", "gpt2", "opt"):
             raise ValueError(
                 f"--load_hf supports LLaMA-architecture, GPT-2 and OPT "
-                f"checkpoints; got {type(hf_cfg).__name__}"
+                f"checkpoints; got {type(hf_cfg).__name__} "
+                f"(model_type={getattr(hf_cfg, 'model_type', None)!r})"
             )
         # low_cpu_mem_usage streams weights instead of materializing a full
         # randomly-initialized module first (~halves host peak for 7B+)
@@ -439,11 +441,11 @@ def load_hf_checkpoint(path_or_model: Any) -> tuple:
     else:
         model = path_or_model
         hf_cfg = model.config
-    arch = type(hf_cfg).__name__.lower()
-    if "gpt2" in arch:
+    arch = getattr(hf_cfg, "model_type", "")
+    if arch == "gpt2":
         cfg = config_from_hf_gpt2(hf_cfg)
         return from_hf_gpt2(model, cfg), cfg
-    if "opt" in arch:
+    if arch == "opt":
         cfg = config_from_hf_opt(hf_cfg)
         return from_hf_opt(model, cfg), cfg
     cfg = config_from_hf_llama(hf_cfg)

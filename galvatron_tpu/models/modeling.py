@@ -666,7 +666,7 @@ def _attn_block_headmajor(x, p, cfg: ModelConfig, rope, remat_attn: bool):
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
     else:
         kv, group = qkv_dims(cfg)
-        npg = n // cfg.kv_heads
+        npg = group // hd - 2  # query heads per kv group, per the stored layout
         r = jnp.einsum("bsh,hknd->bknsd", x, w.reshape(h, kv, npg + 2, hd))
         q = r[:, :, :npg].reshape(b, n, s, hd)
         k = _repeat_kv_hm(r[:, :, npg], npg)

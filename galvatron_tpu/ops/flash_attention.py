@@ -603,7 +603,7 @@ def flash_attention_hm(
         sm_scale = 1.0 / float(np.sqrt(d))
     block_q = min(block_q, s)
     block_k = min(block_k, s)
-    if s % block_q or s % block_k:
+    if not flash_tileable(s, block_q) or not flash_tileable(s, block_k):
         out = flash_attention(
             jnp.transpose(q, (0, 2, 1, 3)),
             jnp.transpose(k, (0, 2, 1, 3)),
@@ -617,7 +617,8 @@ def flash_attention_hm(
 
 def flash_tileable(s: int, block: int = 1024) -> bool:
     """True when a (…, s, …) shape takes the kernel path (no einsum
-    fallback) — the head-major wiring in modeling keys on this."""
+    fallback). The ONE tileability predicate: both wrappers and modeling's
+    head-major gate key on it, so they cannot drift apart."""
     return s % min(block, s) == 0
 
 
@@ -652,7 +653,7 @@ def flash_attention(
         sm_scale = 1.0 / float(np.sqrt(d))
     block_q = min(block_q, s)
     block_k = min(block_k, s)
-    if s % block_q or s % block_k:
+    if not flash_tileable(s, block_q) or not flash_tileable(s, block_k):
         from galvatron_tpu.models import modeling
 
         if rope is not None:

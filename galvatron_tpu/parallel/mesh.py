@@ -183,3 +183,16 @@ def moe_token_axes(axes: MeshAxes, s: LayerStrategy) -> Tuple[str, ...]:
 def global_batch_spec(axes: MeshAxes) -> P:
     """Sharding for the raw token batch: all data axes (dataloader layout)."""
     return P(axes.data_axes or None, None)
+
+
+def ambient_or(mesh):
+    """Mesh to hand a nested ``shard_map``: inside a manual region (the pp>1
+    pipeline runs stages under a manual-'pp' shard_map) a nested shard_map
+    must be given the ambient AbstractMesh — whose manual axes are marked
+    Manual — not the original concrete mesh, or tracing fails with an
+    axis-type mismatch. Load-bearing for every cp impl (ring/a2a) at pp>1."""
+    am = jax.sharding.get_abstract_mesh()
+    types = getattr(am, "axis_types", None) or ()
+    if any(t == jax.sharding.AxisType.Manual for t in types):
+        return am
+    return mesh

@@ -346,13 +346,15 @@ def make_block_fn(
             def run(x_, lp_):
                 if s.cp > 1:
                     cp_axes = axes.cp_axes(s.tp, s.tp_consec, s.cp)
+                    # layer_cfg (not cfg): an MoE layer with cp>1 must keep
+                    # its expert-dispatch sharding pins, as the pp=1 hook does
                     if s.cp_impl == "a2a":
                         from galvatron_tpu.parallel.ulysses import ulysses_decoder_layer
 
-                        return ulysses_decoder_layer(x_, lp_, cfg, mesh, cp_axes, cos_sin)
+                        return ulysses_decoder_layer(x_, lp_, layer_cfg, mesh, cp_axes, cos_sin)
                     from galvatron_tpu.parallel.ring import ring_decoder_layer
 
-                    return ring_decoder_layer(x_, lp_, cfg, mesh, cp_axes, cos_sin)
+                    return ring_decoder_layer(x_, lp_, layer_cfg, mesh, cp_axes, cos_sin)
                 return modeling.decoder_layer(
                     x_, lp_, layer_cfg, cos_sin, alibi,
                     remat_attn=(s.ckpt == "selective"),

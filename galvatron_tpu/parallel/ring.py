@@ -37,6 +37,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.parallel.mesh import ambient_or
 from galvatron_tpu.ops.flash_attention import (
     _flash_bwd_parts,
     _flash_fwd,
@@ -46,10 +47,11 @@ from galvatron_tpu.ops.flash_attention import (
 NEG_INF = -1e30
 
 
-def _ring_attn_local(q, k, v, axis_name: str, cp: int, sm_scale: float):
+def _ring_attn_local(q, k, v, idx_arr, axis_name: str, cp: int, sm_scale: float):
     """Runs inside shard_map with ``axis_name`` manual. q/k/v local:
-    (B, S/cp, n, d), sequence sharded in ring order."""
-    idx = jax.lax.axis_index(axis_name)
+    (B, S/cp, n, d), sequence sharded in ring order; ``idx_arr`` is this
+    shard's slice of arange(cp) (the ring position)."""
+    idx = idx_arr[0]
     s_local = q.shape[1]
     perm = [(i, (i + 1) % cp) for i in range(cp)]  # kv block i → device i+1
 
@@ -129,13 +131,13 @@ def _lse_combine(m, l, acc, o_b, lse_b):
     return m_new, l * alpha + w_b, acc * alpha + o_b * w_b
 
 
-def _ring_flash_fwd(q, k, v, axis_name, cp, sm_scale, block_q, block_k, interpret):
-    """q/k/v local (B, n, S/cp, d). Returns (out, global lse).
+def _ring_flash_fwd(q, k, v, idx, axis_name, cp, sm_scale, block_q, block_k, interpret):
+    """q/k/v local (B, n, S/cp, d); ``idx`` the ring position scalar.
+    Returns (out, global lse).
 
     Hop 0 (the diagonal, locally causal block) runs before the scan; each
     scan step permutes K/V first and then computes, so no hop rotates K/V
     only to discard the result."""
-    idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
     b, h, s, d = q.shape
 
@@ -167,15 +169,15 @@ def _ring_flash_fwd(q, k, v, axis_name, cp, sm_scale, block_q, block_k, interpre
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _ring_flash(q, k, v, axis_name, cp, sm_scale, block_q, block_k, interpret):
-    out, _ = _ring_flash_fwd(q, k, v, axis_name, cp, sm_scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _ring_flash(q, k, v, idx, axis_name, cp, sm_scale, block_q, block_k, interpret):
+    out, _ = _ring_flash_fwd(q, k, v, idx, axis_name, cp, sm_scale, block_q, block_k, interpret)
     return out
 
 
-def _ring_flash_fwd_rule(q, k, v, axis_name, cp, sm_scale, block_q, block_k, interpret):
-    out, lse = _ring_flash_fwd(q, k, v, axis_name, cp, sm_scale, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _ring_flash_fwd_rule(q, k, v, idx, axis_name, cp, sm_scale, block_q, block_k, interpret):
+    out, lse = _ring_flash_fwd(q, k, v, idx, axis_name, cp, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v, idx, out, lse)
 
 
 def _ring_flash_bwd_rule(axis_name, cp, sm_scale, block_q, block_k, interpret, res, do):
@@ -183,8 +185,7 @@ def _ring_flash_bwd_rule(axis_name, cp, sm_scale, block_q, block_k, interpret, r
     lse/delta. Hop 0 (diagonal) runs before the scan; scan steps permute
     first, then compute. dk/dv accumulators ride the ring with their K/V
     block — cp-1 hops inside the scan plus one final hop lands them home."""
-    q, k, v, out, lse = res
-    idx = jax.lax.axis_index(axis_name)
+    q, k, v, idx, out, lse = res
     perm = [(i, (i + 1) % cp) for i in range(cp)]
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
@@ -226,18 +227,21 @@ def _ring_flash_bwd_rule(axis_name, cp, sm_scale, block_q, block_k, interpret, r
     )
     dk = jax.lax.ppermute(dk, axis_name, perm)
     dv = jax.lax.ppermute(dv, axis_name, perm)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    didx = np.zeros(idx.shape, dtype=jax.dtypes.float0)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), didx
 
 
 _ring_flash.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
 
 
-def _ring_flash_local(q, k, v, axis_name: str, cp: int, sm_scale: float, block: int):
+def _ring_flash_local(q, k, v, idx_arr, axis_name: str, cp: int, sm_scale: float, block: int):
     """shard_map body for the flash path. q/k/v local (B, S/cp, n, d)."""
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    out = _ring_flash(qt, kt, vt, axis_name, cp, sm_scale, block, block, _use_interpret())
+    out = _ring_flash(
+        qt, kt, vt, idx_arr[0], axis_name, cp, sm_scale, block, block, _use_interpret()
+    )
     return jnp.transpose(out, (0, 2, 1, 3))
 
 
@@ -262,6 +266,7 @@ def ring_attention(
         sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
     axis = tuple(cp_axes)
     spec = P(None, axis, None, None)
+    mesh = ambient_or(mesh)
     block = _flash_block_size(q.shape[1] // cp)
     if block:
         local = functools.partial(
@@ -271,15 +276,21 @@ def ring_attention(
         local = functools.partial(
             _ring_attn_local, axis_name=axis, cp=cp, sm_scale=sm_scale
         )
+    # ring position fed as a sharded arange rather than lax.axis_index: when
+    # this shard_map nests inside the pipeline's manual-'pp' region, shardy
+    # cannot lower axis_index (it would re-bind the parent's manual axes),
+    # while plain data sharding over the cp axes works — same linearization
+    # as ppermute over the axis tuple
+    idx_arr = jnp.arange(cp, dtype=jnp.int32)
     fn = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, P(axis)),
         out_specs=spec,
         axis_names=set(cp_axes),
         check_vma=False,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, idx_arr)
 
 
 def ring_decoder_layer(x, p, cfg: ModelConfig, mesh, cp_axes, cos_sin):

@@ -57,8 +57,11 @@ def ulysses_attention(q, k, v, cfg: ModelConfig, mesh: Mesh, cp_axes: Sequence[s
         v = modeling._repeat_kv(v, q.shape[2] // v.shape[2])
     if cfg.attn_impl == "ring":  # never recurse into the ring dispatch
         cfg = cfg.replace(attn_impl="xla")
+    from galvatron_tpu.parallel.mesh import ambient_or
+
     axis = tuple(cp_axes)
     spec = P(None, axis, None, None)
+    mesh = ambient_or(mesh)
     fn = jax.shard_map(
         functools.partial(_a2a_attn_local, cfg=cfg, axis_name=axis, cp=cp),
         mesh=mesh,

@@ -236,6 +236,39 @@ def test_cp_layer_in_hybrid_runtime():
     np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-4)
 
 
+def test_cp_layer_under_pipeline_parallelism():
+    """cp>1 inside a pp>1 pipeline: the ring/a2a shard_maps nest inside the
+    pipeline's manual-'pp' region (regression: the nested shard_map used the
+    concrete mesh and lax.axis_index, both of which shardy rejects inside a
+    manual region — pp+cp combos failed to trace). Parity against the plain
+    pp=2 trajectory (same micro-batching; chunked loss differs from the
+    full-batch reference by averaging semantics, so cp must be compared at
+    equal chunking)."""
+    from galvatron_tpu.core.optim import AdamConfig
+    from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+    from galvatron_tpu.parallel.hybrid import build_runtime
+    from tests.test_hybrid_runtime import CFG, make_batches
+
+    batches = make_batches()
+
+    def run(ls):
+        hp = HybridParallelConfig(
+            pp=2, chunks=2, layer_strategies=ls, vocab_tp=1, mixed_precision="fp32"
+        )
+        rt = build_runtime(CFG, hp, adam=AdamConfig(lr=1e-3), global_batch_size=8, seq_len=32)
+        state = rt.init_state(jax.random.key(0))
+        losses = []
+        for b in batches:
+            state, loss = rt.train_step(state, b)
+            losses.append(float(loss))
+        return losses
+
+    ref = run([LayerStrategy()] * 4)
+    for impl in ("ring", "a2a"):
+        got = run([LayerStrategy(cp=2, cp_impl=impl)] * 4)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4, err_msg=impl)
+
+
 def test_ring_flash_block_size_selection():
     """Ring hops run the Pallas flash kernels whenever the local sequence
     tiles to a power of two; otherwise the einsum online-softmax fallback."""
