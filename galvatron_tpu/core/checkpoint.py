@@ -156,7 +156,13 @@ def restore_checkpoint_portable(ckpt_dir: str, runtime, step: Optional[int] = No
         # A transient I/O or deserialization failure on a genuinely flat
         # checkpoint must surface verbatim, not as "matches neither layout".
         low = str(flat_err).lower()
-        if not any(w in low for w in ("missing", "mismatch", "structure", "rank", "shape")):
+        mismatch_words = (
+            "missing", "mismatch", "structure", "rank", "shape", "not found",
+        )
+        # KeyError/TypeError are how pytree/dict structure mismatches surface
+        # when the message itself names only the offending key
+        structural = isinstance(flat_err, (KeyError, TypeError))
+        if not structural and not any(w in low for w in mismatch_words):
             raise
         try:
             return restore_checkpoint(ckpt_dir, abstract_state_of(runtime), step)

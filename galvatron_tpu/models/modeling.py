@@ -86,6 +86,14 @@ class ModelConfig:
     # expert all-to-all at the dispatch einsum instead of an SPMD
     # replicate-and-repartition). None → unconstrained (single-device paths).
     moe_shard_ctx: Optional[Any] = None
+    # (mesh, batch_axes) installed by the layer hooks for zero3+tp layers:
+    # attn_block pins the attention context o to batch-sharded/head-replicated
+    # before the output projection. Without it the dWo^T grad dot (output
+    # sharded fsdp x tp) finds no common axes with the batch-sharded dy and
+    # the SPMD partitioner falls back to an involuntary full rematerialization
+    # (world-wide replicate) of dy — XLA b/433785288. The pin trades that for
+    # a tp-wide gather of o in forward. None → unconstrained.
+    attn_out_shard_ctx: Optional[Any] = None
     # vision families (reference legacy vit/swin model_type branches,
     # galvatron/core/parallel.py:64-89, cost_model.py:76,87-106).
     # image_size > 0 switches the input pipeline from token ids to uint8
@@ -646,6 +654,20 @@ def _repeat_kv_hm(x, n_rep: int):
     )
 
 
+def _constrain_attn_out(o, cfg: ModelConfig):
+    """Pin the attention context to batch-sharded/head-replicated when the
+    layer hook installed attn_out_shard_ctx (zero3+tp layers) — see the
+    ModelConfig field comment. ``o``: (B, S, n, hd) or (B, n, S, hd)."""
+    if cfg.attn_out_shard_ctx is None:
+        return o
+    from jax.sharding import PartitionSpec as P
+
+    from galvatron_tpu.parallel.sharding import constrain
+
+    mesh, dp_ax = cfg.attn_out_shard_ctx
+    return constrain(o, mesh, P(dp_ax or None, *([None] * (o.ndim - 1))))
+
+
 def _attn_block_headmajor(x, p, cfg: ModelConfig, rope, remat_attn: bool):
     """Flash-path attention with head-major (b, h, s, d) dataflow end to end:
     the QKV projection einsums straight to (b, 3, n, s, hd) and the output
@@ -677,7 +699,7 @@ def _attn_block_headmajor(x, p, cfg: ModelConfig, rope, remat_attn: bool):
 
     if remat_attn:
         core = jax.checkpoint(core)
-    o = core(q, k, v)
+    o = _constrain_attn_out(core(q, k, v), cfg)
     y = jnp.einsum("bnsd,nde->bse", o, p["wo"].astype(x.dtype).reshape(n, hd, h))
     if "wo_b" in p:
         y = y + p["wo_b"].astype(x.dtype)
@@ -711,7 +733,7 @@ def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: boo
 
     if remat_attn:
         core = jax.checkpoint(core)
-    o = core(q, k, v, bias)
+    o = _constrain_attn_out(core(q, k, v, bias), cfg)
     return attn_output(o, p, cfg, x.dtype)
 
 

@@ -183,6 +183,13 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
                     moe_token_axes(axes, s),
                 )
             )
+        if s.dp_type == "zero3" and s.tp > 1:
+            # fsdp x tp wgrad shardings trip an SPMD partitioner fallback
+            # (involuntary full remat of dy) without this pin — see
+            # modeling._constrain_attn_out
+            layer_cfg = layer_cfg.replace(
+                attn_out_shard_ctx=(mesh, axes.dp_axes(s.tp, s.tp_consec, s.cp))
+            )
         cos_sin = (
             modeling.rope_tables(layer_cfg, x.shape[1]) if layer_cfg.pos_embed == "rope" else None
         )

@@ -342,6 +342,12 @@ def make_block_fn(
                         moe_token_axes(axes, s),
                     )
                 )
+            if s.dp_type == "zero3" and s.tp > 1:
+                # same fsdp x tp wgrad pin as the pp=1 hook — see
+                # modeling._constrain_attn_out
+                layer_cfg = layer_cfg.replace(
+                    attn_out_shard_ctx=(mesh, axes.dp_axes(s.tp, s.tp_consec, s.cp))
+                )
 
             def run(x_, lp_):
                 if s.cp > 1:

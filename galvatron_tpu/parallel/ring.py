@@ -307,7 +307,7 @@ def ring_decoder_layer(x, p, cfg: ModelConfig, mesh, cp_axes, cos_sin):
             k = modeling.apply_rope(k, cos, sin)
         k = modeling._repeat_kv(k, cfg.num_heads // k.shape[2])
         v = modeling._repeat_kv(v, cfg.num_heads // v.shape[2])
-        o = ring_attention(q, k, v, mesh, cp_axes)
+        o = modeling._constrain_attn_out(ring_attention(q, k, v, mesh, cp_axes), cfg)
         return modeling.attn_output(o, p["attn"], cfg, xn.dtype)
 
     x = x + attn(modeling.norm(x, p["attn_norm"], cfg))

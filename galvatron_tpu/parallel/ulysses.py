@@ -27,6 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.parallel.mesh import ambient_or
 
 
 def _a2a_attn_local(q, k, v, cfg: ModelConfig, axis_name, cp: int):
@@ -57,8 +58,6 @@ def ulysses_attention(q, k, v, cfg: ModelConfig, mesh: Mesh, cp_axes: Sequence[s
         v = modeling._repeat_kv(v, q.shape[2] // v.shape[2])
     if cfg.attn_impl == "ring":  # never recurse into the ring dispatch
         cfg = cfg.replace(attn_impl="xla")
-    from galvatron_tpu.parallel.mesh import ambient_or
-
     axis = tuple(cp_axes)
     spec = P(None, axis, None, None)
     mesh = ambient_or(mesh)
@@ -89,7 +88,7 @@ def ulysses_decoder_layer(x, p, cfg: ModelConfig, mesh, cp_axes, cos_sin):
             k = modeling.apply_rope(k, cos, sin)
         # K/V stay at kv_heads across the all-to-all (GQA repeat happens in
         # the local attention core) — group_factor× less CP traffic
-        o = ulysses_attention(q, k, v, cfg, mesh, cp_axes)
+        o = modeling._constrain_attn_out(ulysses_attention(q, k, v, cfg, mesh, cp_axes), cfg)
         return modeling.attn_output(o, p["attn"], cfg, xn.dtype)
 
     x = x + attn(modeling.norm(x, p["attn_norm"], cfg))
