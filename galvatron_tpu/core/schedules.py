@@ -166,7 +166,8 @@ def scaled_value_and_grad(loss_fn, scale):
     """``value_and_grad`` with the fp16 loss-scaling pattern: the backward
     runs on ``loss * scale``, gradients come back unscaled in fp32, the loss
     value is exact (un-scaled primal). One definition of the overflow-
-    sensitive numerics shared by the pp=1 and GPipe train steps; finiteness
+    sensitive numerics shared by the pp=1, GPipe and enc-dec pipeline train
+    steps; finiteness
     checking lives in ``optim.apply_update_with_scaler``."""
 
     def run(params, *args):

@@ -37,7 +37,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from galvatron_tpu.core.optim import AdamConfig, adamw_update, init_opt_state
+from galvatron_tpu.core.optim import (
+    AdamConfig,
+    adamw_update,
+    apply_update_with_scaler,
+    init_opt_state,
+)
+from galvatron_tpu.core.schedules import (
+    LossScalerConfig,
+    init_scaler_state,
+    scaled_value_and_grad,
+)
 from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
 from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
@@ -65,8 +75,6 @@ def validate_encdec_pipeline(
             f"enc-dec pipeline needs chunks ({hp.chunks}) divisible by "
             f"pp={pp} (micro-batches flow in groups of pp on the ring)"
         )
-    if hp.mixed_precision == "fp16":
-        raise ValueError("enc-dec pipeline supports fp32/bf16 (no fp16 scaler)")
     if hp.pipeline_type != "gpipe":
         raise ValueError(
             "enc-dec pipeline implements the gpipe-ordered coupled-sub-"
@@ -397,18 +405,32 @@ def build_encdec_pipeline_runtime(
         ssum, n = modeling.cross_entropy_sum(logits, labels)
         return ssum / jnp.maximum(n, 1)
 
+    fp16 = hp.mixed_precision == "fp16"
+    scaler_cfg = LossScalerConfig()
+
     def train_step(state, batch):
+        if fp16:
+            loss, grads = scaled_value_and_grad(loss_fn, state["scaler"]["scale"])(
+                state["params"], batch
+            )
+            return apply_update_with_scaler(state, loss, grads, adam, scaler_cfg)
         loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
         new_params, new_opt = adamw_update(state["params"], grads, state["opt"], adam)
         return {"params": new_params, "opt": new_opt, "step": state["step"] + 1}, loss
 
     def init_state(key):
         params = init_encdec_pipeline_params(key, cfg, hp)
-        return {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
+        state = {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
+        if fp16:
+            state["scaler"] = init_scaler_state(scaler_cfg)
+        return state
 
     def state_from(flat_params):
         params = restack_flat_encdec(flat_params, cfg, hp)
-        return {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
+        state = {"params": params, "opt": init_opt_state(params), "step": jnp.zeros((), jnp.int32)}
+        if fp16:
+            state["scaler"] = init_scaler_state(scaler_cfg)
+        return state
 
     state_shape = jax.eval_shape(init_state, jax.random.key(0))
     specs = {
@@ -420,6 +442,8 @@ def build_encdec_pipeline_runtime(
         },
         "step": P(),
     }
+    if "scaler" in state_shape:
+        specs["scaler"] = jax.tree.map(lambda _: P(), state_shape["scaler"])
     shardings = sharding_tree(mesh, specs)
     batch_sharding = NamedSharding(mesh, P(("pp",) + axes.data_axes, None))
     copts = cpu_sim_compiler_options()

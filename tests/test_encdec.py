@@ -114,6 +114,30 @@ def test_encdec_pp2_parity(tp, dp_type, ckpt):
     assert np.isfinite(float(loss2)) and float(loss2) < float(loss)
 
 
+def test_encdec_pp2_fp16_tracks_fp32():
+    """fp16 (dynamic loss scaling) through the enc-dec pipeline: losses track
+    the fp32 trajectory loosely, stay finite, and the scaler advances —
+    previously rejected outright."""
+    mk = lambda mp: HybridParallelConfig.uniform(
+        4, pp=2, tp=1, chunks=2, mixed_precision=mp
+    )
+    rt16 = build_runtime(T5, mk("fp16"), adam=AdamConfig(lr=1e-3), global_batch_size=8)
+    rt32 = build_runtime(T5, mk("fp32"), adam=AdamConfig(lr=1e-3), global_batch_size=8)
+    s16 = rt16.init_state(jax.random.key(0))
+    s32 = rt32.init_state(jax.random.key(0))
+    assert "scaler" in s16 and float(s16["scaler"]["scale"]) == 2.0**16
+    l16, l32 = [], []
+    for i in range(3):
+        b = batch(i)
+        s16, a = rt16.train_step(s16, b)
+        s32, c = rt32.train_step(s32, b)
+        l16.append(float(a))
+        l32.append(float(c))
+    assert np.isfinite(l16).all()
+    np.testing.assert_allclose(l16, l32, rtol=0.05, atol=0.05)
+    assert int(s16["scaler"]["good_steps"]) == 3
+
+
 def test_multi_layer_type_search():
     """Enc and dec layer types with different costs flow through the search
     (the reference's multi-layer-type DP) and the result trains."""

@@ -50,6 +50,22 @@ def test_1f1b_training_parity(pp, chunks, tp, dp_type, ckpt):
     np.testing.assert_allclose(pipe_losses, ref_losses, rtol=5e-5, atol=5e-5)
 
 
+@pytest.mark.parametrize("pp,chunks", [(2, 4), (4, 4)])
+def test_1f1b_eval_loss_parity(pp, chunks):
+    """The forward-only eval schedule (no vjp/stash machinery) must match the
+    flat single-path loss exactly on identical weights."""
+    hp = HybridParallelConfig.uniform(
+        4, pp=pp, tp=1, chunks=chunks, mixed_precision="fp32", vocab_tp=1,
+        pipeline_type="pipedream_flush",
+    )
+    rt = build_runtime(CFG, hp, adam=ADAM, global_batch_size=8, seq_len=32)
+    flat = modeling.init_model_params(jax.random.key(3), CFG)
+    state = rt.init_state_from(flat)
+    b = make_batch(seed=7)
+    ref = float(jax.jit(lambda p, bb: modeling.lm_loss(p, bb, CFG))(flat, b))
+    np.testing.assert_allclose(float(rt.eval_loss(state, b)), ref, rtol=3e-5, atol=3e-5)
+
+
 def test_1f1b_tied_embeddings():
     cfg = CFG.replace(
         pos_embed="learned", norm_type="layernorm", act_fn="gelu", tie_word_embeddings=True
