@@ -172,8 +172,10 @@ class HybridParallelConfig:
         if self.vocab_tp > per_stage:
             raise ValueError(f"vocab_tp={self.vocab_tp} exceeds per-stage devices")
         if self.pp_division is not None:
-            if len(self.pp_division) != self.pp:
-                raise ValueError("pp_division length must equal pp")
+            # length 2*pp is the enc-dec layout: [enc division ‖ dec division]
+            # (parallel/pipeline_encdec.EncDecLayout validates the split)
+            if len(self.pp_division) not in (self.pp, 2 * self.pp):
+                raise ValueError("pp_division length must equal pp (or 2*pp for enc-dec)")
             if sum(self.pp_division) != self.num_layers:
                 raise ValueError("pp_division must sum to the layer count")
             if any(n < 1 for n in self.pp_division):

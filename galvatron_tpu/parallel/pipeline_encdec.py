@@ -56,24 +56,90 @@ from galvatron_tpu.parallel.pipeline import cpu_sim_compiler_options
 from galvatron_tpu.parallel.sharding import constrain, param_spec, sharding_tree
 
 
+class EncDecLayout:
+    """Per-sub-stack stage layout: ragged encoder/decoder layer counts are
+    realized by PADDED stacking exactly like the decoder-only pipeline
+    (pipeline.stage_layout): each sub-stack carries max(division) positions,
+    stages with fewer real layers get zero-filled padding slots whose compute
+    is masked out in the section functions.
+
+    ``hp.pp_division`` of length 2*pp is read as [enc division ‖ dec
+    division]; anything else (including the auto-filled single-stack default
+    from HybridParallelConfig.__post_init__, which sums E+D) falls back to a
+    per-stack balanced division."""
+
+    def __init__(self, cfg: ModelConfig, hp: HybridParallelConfig):
+        from galvatron_tpu.core.strategy import balanced_division
+
+        E, D, pp = cfg.enc_layers, cfg.num_layers, hp.pp
+        if E < pp or D < pp:
+            raise ValueError(
+                f"enc-dec pipeline needs at least pp={pp} encoder and decoder "
+                f"layers (got {E} enc / {D} dec)"
+            )
+        div = hp.pp_division
+        if div is not None and len(div) == pp:
+            # HybridParallelConfig.__post_init__ auto-fills a length-pp
+            # balanced division over E+D, which is meaningless for the
+            # two-stack layout and ignored. Anything ELSE of length pp is
+            # provably user-provided — reject it instead of silently
+            # training under a different layout than the config states.
+            if div != balanced_division(E + D, pp):
+                raise ValueError(
+                    f"enc-dec models take a 2*pp pp_division "
+                    f"([enc ‖ dec] stage splits), got the single-stack "
+                    f"division {div}"
+                )
+            div = None
+        if div is not None and len(div) == 2 * pp and sum(div) == E + D:
+            self.div_e, self.div_d = list(div[:pp]), list(div[pp:])
+            if sum(self.div_e) != E or sum(self.div_d) != D or min(
+                self.div_e + self.div_d
+            ) < 1:
+                raise ValueError(
+                    f"enc-dec pp_division {div} must split as enc({E}) ‖ "
+                    f"dec({D}) with >=1 layers per stage per stack"
+                )
+        else:
+            self.div_e = balanced_division(E, pp)
+            self.div_d = balanced_division(D, pp)
+        self.off_e = list(np.cumsum([0] + self.div_e[:-1]))
+        self.off_d = list(np.cumsum([0] + self.div_d[:-1]))
+        self.lpe, self.lpd = max(self.div_e), max(self.div_d)
+        self.pp = pp
+
+        def positions(strats, div, off, lps, kind):
+            out = []
+            for q in range(lps):
+                stages_with_q = [s for s in range(pp) if div[s] > q]
+                ss = {strats[off[s] + q] for s in stages_with_q}
+                if len(ss) > 1:
+                    raise ValueError(
+                        f"{kind} layers at virtual-stage position {q} must "
+                        f"share one strategy across stages "
+                        f"(got {sorted(map(str, ss))})"
+                    )
+                out.append(next(iter(ss)))
+            return out
+
+        self.enc_pos = positions(
+            hp.layer_strategies[:E], self.div_e, self.off_e, self.lpe, "encoder"
+        )
+        self.dec_pos = positions(
+            hp.layer_strategies[E:], self.div_d, self.off_d, self.lpd, "decoder"
+        )
+
+
 def validate_encdec_pipeline(
     cfg: ModelConfig, hp: HybridParallelConfig
-) -> Tuple[int, int, List[LayerStrategy], List[LayerStrategy]]:
-    """(layers-per-enc-vstage, layers-per-dec-vstage, enc/dec position
-    strategies). Strategy order: encoder layers first, then decoder."""
-    E, D, pp = cfg.enc_layers, cfg.num_layers, hp.pp
-    if E % pp or D % pp:
-        raise ValueError(
-            f"enc-dec pipeline needs pp={pp} to divide both the encoder "
-            f"({E}) and decoder ({D}) layer counts (single-type virtual "
-            "stages)"
-        )
+) -> EncDecLayout:
+    """Schedule constraints + the per-sub-stack stage layout."""
     if hp.vpp > 1:
         raise ValueError("enc-dec pipeline does not compose with vpp>1")
-    if hp.chunks % pp:
+    if hp.chunks % hp.pp:
         raise ValueError(
             f"enc-dec pipeline needs chunks ({hp.chunks}) divisible by "
-            f"pp={pp} (micro-batches flow in groups of pp on the ring)"
+            f"pp={hp.pp} (micro-batches flow in groups of pp on the ring)"
         )
     if hp.pipeline_type != "gpipe":
         raise ValueError(
@@ -81,30 +147,26 @@ def validate_encdec_pipeline(
             "pipeline schedule only; set pipeline_type='gpipe' "
             f"(got {hp.pipeline_type!r})"
         )
-    lpe, lpd = E // pp, D // pp
+    return EncDecLayout(cfg, hp)
 
-    def positions(strats: List[LayerStrategy], lps: int, kind: str):
-        out = []
-        for q in range(lps):
-            ss = {strats[s * lps + q] for s in range(pp)}
-            if len(ss) > 1:
-                raise ValueError(
-                    f"{kind} layers at virtual-stage position {q} must share "
-                    f"one strategy across stages (got {sorted(map(str, ss))})"
-                )
-            out.append(next(iter(ss)))
-        return out
 
-    enc_pos = positions(hp.layer_strategies[:E], lpe, "encoder")
-    dec_pos = positions(hp.layer_strategies[E:], lpd, "decoder")
-    return lpe, lpd, enc_pos, dec_pos
+def _pad_stack(items, div, off, lps, pp, zeros):
+    """Per-position (pp, ...) stacks from a flat per-layer list; zero padding
+    where a stage has fewer real layers than the stack height."""
+    return [
+        jax.tree.map(
+            lambda *ls: jnp.stack(ls),
+            *[items[off[s] + q] if div[s] > q else zeros for s in range(pp)],
+        )
+        for q in range(lps)
+    ]
 
 
 def init_encdec_pipeline_params(key, cfg: ModelConfig, hp: HybridParallelConfig):
     """embed / norms / head replicated over pp; ``enc_stages[q]`` and
     ``dec_stages[q]`` are (pp, ...) stacks — device s's slice is its virtual
-    stage's q-th layer."""
-    lpe, lpd, _, _ = validate_encdec_pipeline(cfg, hp)
+    stage's q-th layer (zero-filled padding where the division is ragged)."""
+    lay = validate_encdec_pipeline(cfg, hp)
     pp = hp.pp
     ks = jax.random.split(key, 6)
     base: Dict[str, Any] = {
@@ -131,63 +193,55 @@ def init_encdec_pipeline_params(key, cfg: ModelConfig, hp: HybridParallelConfig)
         }
     enc_keys = jax.random.split(ks[3], cfg.enc_layers)
     dec_keys = jax.random.split(ks[4], cfg.num_layers)
-    base["enc_stages"] = [
-        jax.vmap(lambda k: modeling.init_layer_params(k, cfg))(
-            jnp.stack([enc_keys[s * lpe + q] for s in range(pp)])
-        )
-        for q in range(lpe)
-    ]
-    base["dec_stages"] = [
-        jax.vmap(lambda k: modeling.init_layer_params(k, cfg, cross=True))(
-            jnp.stack([dec_keys[s * lpd + q] for s in range(pp)])
-        )
-        for q in range(lpd)
-    ]
+    enc_layers = [modeling.init_layer_params(k, cfg) for k in enc_keys]
+    dec_layers = [modeling.init_layer_params(k, cfg, cross=True) for k in dec_keys]
+    base["enc_stages"] = _pad_stack(
+        enc_layers, lay.div_e, lay.off_e, lay.lpe, pp,
+        jax.tree.map(jnp.zeros_like, enc_layers[0]),
+    )
+    base["dec_stages"] = _pad_stack(
+        dec_layers, lay.div_d, lay.off_d, lay.lpd, pp,
+        jax.tree.map(jnp.zeros_like, dec_layers[0]),
+    )
     return base
 
 
 def restack_flat_encdec(flat_params, cfg: ModelConfig, hp: HybridParallelConfig):
     """Flat ``enc_layers``/``layers`` lists → the enc/dec virtual-stage
-    stacks (portable-checkpoint layout)."""
-    pp = hp.pp
-    lpe, lpd = cfg.enc_layers // pp, cfg.num_layers // pp
+    stacks (portable-checkpoint layout); zero padding on ragged divisions."""
+    lay = validate_encdec_pipeline(cfg, hp)
     params = {
         k: v for k, v in flat_params.items() if k not in ("enc_layers", "layers")
     }
-    params["enc_stages"] = [
-        jax.tree.map(
-            lambda *ls: jnp.stack(ls),
-            *[flat_params["enc_layers"][s * lpe + q] for s in range(pp)],
-        )
-        for q in range(lpe)
-    ]
-    params["dec_stages"] = [
-        jax.tree.map(
-            lambda *ls: jnp.stack(ls),
-            *[flat_params["layers"][s * lpd + q] for s in range(pp)],
-        )
-        for q in range(lpd)
-    ]
+    enc = flat_params["enc_layers"]
+    dec = flat_params["layers"]
+    params["enc_stages"] = _pad_stack(
+        enc, lay.div_e, lay.off_e, lay.lpe, hp.pp,
+        jax.tree.map(jnp.zeros_like, enc[0]),
+    )
+    params["dec_stages"] = _pad_stack(
+        dec, lay.div_d, lay.off_d, lay.lpd, hp.pp,
+        jax.tree.map(jnp.zeros_like, dec[0]),
+    )
     return params
 
 
 def flatten_encdec(params, cfg: ModelConfig, hp: HybridParallelConfig):
-    """Inverse of restack_flat_encdec."""
-    pp = hp.pp
-    lpe, lpd = cfg.enc_layers // pp, cfg.num_layers // pp
+    """Inverse of restack_flat_encdec (padded slots dropped)."""
+    lay = validate_encdec_pipeline(cfg, hp)
     flat = {
         k: v for k, v in params.items() if k not in ("enc_stages", "dec_stages")
     }
-    flat["enc_layers"] = [
-        jax.tree.map(lambda a, s_=s: a[s_], params["enc_stages"][q])
-        for s in range(pp)
-        for q in range(lpe)
-    ]
-    flat["layers"] = [
-        jax.tree.map(lambda a, s_=s: a[s_], params["dec_stages"][q])
-        for s in range(pp)
-        for q in range(lpd)
-    ]
+
+    def unstack(stacks, div, off, total):
+        out = [None] * total
+        for s in range(hp.pp):
+            for q in range(div[s]):
+                out[off[s] + q] = jax.tree.map(lambda a, s_=s: a[s_], stacks[q])
+        return out
+
+    flat["enc_layers"] = unstack(params["enc_stages"], lay.div_e, lay.off_e, cfg.enc_layers)
+    flat["layers"] = unstack(params["dec_stages"], lay.div_d, lay.off_d, cfg.num_layers)
     return flat
 
 
@@ -195,7 +249,8 @@ def encdec_param_specs(
     params_shape, cfg: ModelConfig, hp: HybridParallelConfig, axes: MeshAxes,
     *, for_opt_state: bool = False,
 ):
-    lpe, lpd, enc_pos, dec_pos = validate_encdec_pipeline(cfg, hp)
+    lay = validate_encdec_pipeline(cfg, hp)
+    enc_pos, dec_pos = lay.enc_pos, lay.dec_pos
     embed_strategy = LayerStrategy(
         tp=hp.vocab_tp, tp_consec=True, dp_type=hp.embed_dp_type, sp=hp.vocab_sp
     )
@@ -254,8 +309,13 @@ def encdec_param_specs(
 
 def _make_section_fns(cfg: ModelConfig, hp: HybridParallelConfig, mesh, axes):
     """(enc_section, dec_section): run one virtual stage's layers with
-    per-position sharding constraints + remat."""
-    _, _, enc_pos, dec_pos = validate_encdec_pipeline(cfg, hp)
+    per-position sharding constraints + remat. Ragged divisions mask padding
+    positions to identity (runs inside the manual-'pp' shard_map, so the
+    stage index comes from lax.axis_index)."""
+    lay = validate_encdec_pipeline(cfg, hp)
+    enc_pos, dec_pos = lay.enc_pos, lay.dec_pos
+    uneven_e = len(set(lay.div_e)) > 1
+    uneven_d = len(set(lay.div_d)) > 1
 
     def act_spec(s: LayerStrategy) -> P:
         bs = batch_spec(axes, s)
@@ -264,6 +324,9 @@ def _make_section_fns(cfg: ModelConfig, hp: HybridParallelConfig, mesh, axes):
     cos_e = modeling.rope_tables(cfg, cfg.enc_seq) if cfg.pos_embed == "rope" else None
 
     def enc_section(stage_params, x):
+        n_active = (
+            jnp.asarray(lay.div_e)[jax.lax.axis_index("pp")] if uneven_e else None
+        )
         for q, s in enumerate(enc_pos):
             x = constrain(x, mesh, act_spec(s))
             run = lambda x_, lp_: modeling.encoder_layer(
@@ -271,12 +334,16 @@ def _make_section_fns(cfg: ModelConfig, hp: HybridParallelConfig, mesh, axes):
             )
             if s.ckpt == "full":
                 run = jax.checkpoint(run)
-            x = run(x, stage_params[q])
+            out = run(x, stage_params[q])
+            x = out if n_active is None else jnp.where(q < n_active, out, x)
         return x
 
     def dec_section(stage_params, x, ctx):
         cos_d = (
             modeling.rope_tables(cfg, x.shape[1]) if cfg.pos_embed == "rope" else None
+        )
+        n_active = (
+            jnp.asarray(lay.div_d)[jax.lax.axis_index("pp")] if uneven_d else None
         )
         for q, s in enumerate(dec_pos):
             x = constrain(x, mesh, act_spec(s))
@@ -286,7 +353,8 @@ def _make_section_fns(cfg: ModelConfig, hp: HybridParallelConfig, mesh, axes):
             )
             if s.ckpt == "full":
                 run = jax.checkpoint(run)
-            x = run(x, stage_params[q])
+            out = run(x, stage_params[q])
+            x = out if n_active is None else jnp.where(q < n_active, out, x)
         return x
 
     return enc_section, dec_section
@@ -307,7 +375,7 @@ def build_encdec_pipeline_runtime(
     if global_batch_size % chunks:
         raise ValueError(f"global batch {global_batch_size} not divisible by chunks {chunks}")
     mb = global_batch_size // chunks
-    lpe, lpd, _, _ = validate_encdec_pipeline(cfg, hp)
+    validate_encdec_pipeline(cfg, hp)
     enc_section, dec_section = _make_section_fns(cfg, hp, mesh, axes)
 
     S_e = cfg.enc_seq

@@ -6,13 +6,28 @@ stage backwards toward the average per-stage total (layer memory + per-stage
 "other" memory), cap any over-full early stage at 1.3x the average by
 shifting layers to the next stage, then repair empty stages.
 
-Architecture note: in this runtime the embedding/head compute OUTSIDE the
-pipelined section, sharded over the full mesh, so per-stage "other" memory is
-uniform rather than first/last-heavy — with homogeneous layers the balanced
-division degenerates to a near-even split (remainder spread), which is
-exactly right for the padded stage stacking (parallel/pipeline.stage_layout).
-The heterogeneous-layer case (enc-dec, Swin pyramids) is where the balancing
-bites: layer_mem_mb then varies per layer and stages equalize totals.
+Architecture note — why the search feeds UNIT weights, deliberately: under
+this runtime's padded SPMD stage stacking (parallel/pipeline.stage_layout),
+every device allocates and computes ALL max(division) stack positions (padding
+slots are masked to identity, not skipped — stage-diverging lax.cond around
+the in-layer collectives deadlocks, verified on the CPU sim). Consequently
+per-device parameter memory, activation memory AND per-tick compute are each
+a function of max(division) ALONE: every division with the same maximum is
+exactly equivalent, and the cost-minimal division is any one minimizing
+max(division) — the near-even split. Feeding real per-layer memories into
+this greedy can only RAISE the maximum for skewed profiles (e.g. a heavy
+first layer yields [1, 4] over [2, 3]), which in this architecture is a
+strict pessimization — more padded compute per tick, no memory saved.
+tests/test_pipeline_uneven.py pins both halves of this claim (same-max
+divisions trajectory-identical; larger-max measurably slower). The reference
+architecture (per-stage heterogeneous programs, arbitrary layer placement,
+galvatron/core/search_engine.py:586-672) is where memory-balanced division
+genuinely pays; this port exists for interop with reference-searched configs
+and for the enc-dec pairing analysis.
+
+Embedding/head compute OUTSIDE the pipelined section here, sharded over the
+full mesh, so per-stage "other" memory is uniform — a no-op for the greedy's
+relative fills either way.
 """
 
 from __future__ import annotations

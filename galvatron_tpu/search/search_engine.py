@@ -185,15 +185,15 @@ class SearchEngine:
         multi_type = None  # (n_first, n_second) for a 2-group pp>1 pipeline
         if pp > 1 and len(self.costs.layer_types) > 1:
             # heterogeneous layer types: the enc-dec pipeline (two coupled
-            # sub-pipelines, parallel/pipeline_encdec.py) handles exactly TWO
-            # contiguous type groups whose counts pp divides, gpipe-ordered,
-            # chunks % pp == 0 (the reference's multi-layer-type DP,
-            # dynamic_programming.py:304-455, served the same model class).
-            # Swin pyramids (>2 groups) stay pp=1.
+            # sub-pipelines, parallel/pipeline_encdec.py) handles TWO
+            # contiguous type groups — ragged counts via per-sub-stack padded
+            # divisions — gpipe-ordered, chunks % pp == 0 (the reference's
+            # multi-layer-type DP, dynamic_programming.py:304-455, served the
+            # same model class). Swin pyramids (>2 groups) stay pp=1.
             groups = self._type_groups()
             if (
                 len(groups) != 2
-                or any(cnt % pp for _, cnt, _ in groups)
+                or any(cnt < pp for _, cnt, _ in groups)
                 or chunks % pp
                 or vpp > 1
                 or pipeline_type != "gpipe"
@@ -240,7 +240,11 @@ class SearchEngine:
         # pp>1: a device holds one virtual stage of EACH type, so positions =
         # lpe enc positions followed by lpd dec positions.
         if multi_type is not None:
-            lpe, lpd = multi_type[0] // pp, multi_type[1] // pp
+            # padded sub-stacks: positions per stack = ceil(count / pp); both
+            # stacks place remainders by the same stage order
+            # (balanced_division), so one stage holds the position maximum of
+            # BOTH stacks — the DP's worst case is a real stage
+            lpe, lpd = -(-multi_type[0] // pp), -(-multi_type[1] // pp)
             n_pos = lpe + lpd
             pos_lt = lambda j: (
                 self._layer_type(0) if j < lpe else self._layer_type(multi_type[0])
@@ -345,8 +349,16 @@ class SearchEngine:
             # same per-position pattern in every (virtual) stage; uneven
             # divisions truncate the pattern on light stages
             if multi_type is not None:
-                lpe = multi_type[0] // pp
-                layer_strategies = chosen[:lpe] * pp + chosen[lpe:] * pp
+                from galvatron_tpu.core.strategy import balanced_division
+
+                div_e = balanced_division(multi_type[0], pp)
+                div_d = balanced_division(multi_type[1], pp)
+                lpe = max(div_e)
+                enc_chosen, dec_chosen = chosen[:lpe], chosen[lpe:]
+                layer_strategies = [
+                    enc_chosen[q] for s in range(pp) for q in range(div_e[s])
+                ] + [dec_chosen[q] for s in range(pp) for q in range(div_d[s])]
+                division = div_e + div_d  # the 2*pp enc-dec layout
             elif division is not None:
                 layer_strategies = [
                     chosen[j] for s in range(pp) for j in range(division[s])

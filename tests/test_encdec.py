@@ -86,10 +86,10 @@ def test_encdec_rejects_cp_and_bad_pipeline_shapes():
     hp3 = HybridParallelConfig.uniform(4, pp=2, chunks=1, mixed_precision="fp32")
     with pytest.raises(ValueError, match="chunks"):
         build_runtime(T5, hp3, adam=AdamConfig(), global_batch_size=8)
-    # pp must divide both stacks (enc_layers=2 here)
+    # each stack still needs >= 1 layer per stage (enc_layers=2 here)
     cfg4 = T5.replace(enc_layers=2, num_layers=2)
     hp4 = HybridParallelConfig.uniform(4, pp=4, chunks=4, mixed_precision="fp32")
-    with pytest.raises(ValueError, match="divide"):
+    with pytest.raises(ValueError, match="at least"):
         build_runtime(cfg4, hp4, adam=AdamConfig(), global_batch_size=8)
 
 
@@ -114,6 +114,40 @@ def test_encdec_pp2_parity(tp, dp_type, ckpt):
     assert np.isfinite(float(loss2)) and float(loss2) < float(loss)
 
 
+def test_encdec_pp2_ragged_counts_parity():
+    """E=3 enc / D=5 dec layers at pp=2 — neither divisible by pp: the padded
+    per-sub-stack divisions (reference: arbitrary stage ranges,
+    core/pipeline/pipeline.py:75-77) must reproduce the flat pp=1 loss on
+    identical weights, train, and round-trip the portable checkpoint layout."""
+    cfg = T5.replace(enc_layers=3, num_layers=5)
+    hp = HybridParallelConfig.uniform(8, pp=2, chunks=2, mixed_precision="fp32")
+    rt = build_runtime(cfg, hp, adam=AdamConfig(lr=1e-3), global_batch_size=8)
+    flat = modeling.init_model_params(jax.random.key(0), cfg)
+    state = rt.init_state_from(flat)
+    rng = np.random.RandomState(5)
+    b = jnp.asarray(rng.randint(0, 128, (8, cfg.sample_len + 1)), jnp.int32)
+    ref = float(jax.jit(lambda p, bb: modeling.lm_loss(p, bb, cfg))(flat, b))
+    np.testing.assert_allclose(float(rt.eval_loss(state, b)), ref, rtol=3e-5, atol=3e-5)
+    state, loss = rt.train_step(state, b)
+    state, loss2 = rt.train_step(state, b)
+    assert np.isfinite(float(loss2)) and float(loss2) < float(loss)
+    # flatten drops padding and returns exactly E + D layers
+    flat2 = rt.flatten_params(state["params"])
+    assert len(flat2["enc_layers"]) == 3 and len(flat2["layers"]) == 5
+    # an explicit 2*pp division (enc [2,1] ‖ dec [2,3]) is also accepted
+    hp2 = HybridParallelConfig.uniform(8, pp=2, chunks=2, mixed_precision="fp32")
+    hp2.pp_division = [2, 1, 2, 3]
+    rt2 = build_runtime(cfg, hp2, adam=AdamConfig(lr=1e-3), global_batch_size=8)
+    s2 = rt2.init_state_from(flat)
+    np.testing.assert_allclose(float(rt2.eval_loss(s2, b)), ref, rtol=3e-5, atol=3e-5)
+    # a user-provided single-stack division is rejected, not silently ignored
+    hp3 = HybridParallelConfig.uniform(8, pp=2, chunks=2, mixed_precision="fp32")
+    hp3.pp_division = [5, 3]
+    with pytest.raises(ValueError, match="2\\*pp"):
+        build_runtime(cfg, hp3, adam=AdamConfig(lr=1e-3), global_batch_size=8)
+
+
+@pytest.mark.slow  # fp16 pipeline variants are slow-marked across the suite
 def test_encdec_pp2_fp16_tracks_fp32():
     """fp16 (dynamic loss scaling) through the enc-dec pipeline: losses track
     the fp32 trajectory loosely, stay finite, and the scaler advances —
@@ -253,6 +287,57 @@ def test_multi_layer_type_search_pp2():
     )
     state = rt.init_state(jax.random.key(0))
     state, loss = rt.train_step(state, batch())
+    assert np.isfinite(float(loss))
+
+
+def test_multi_layer_type_search_pp2_ragged():
+    """The search emits a pp=2 config for an enc-dec model whose enc (3) and
+    dec (5) counts are NOT divisible by pp (reference: per-stage DP over
+    arbitrary stage ranges); the emitted 2*pp division loads and trains
+    through the padded enc-dec pipeline."""
+    from galvatron_tpu.search.cost_model import (
+        ProfiledHardware,
+        ProfiledLayerType,
+        ProfiledModelCosts,
+    )
+    from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+
+    enc_lt = ProfiledLayerType(
+        fwd_ms_per_sample=1.0, parameter_mb=40.0,
+        activation_mb_per_sample={1: 20.0, 2: 10.0, 4: 5.0},
+        boundary_activation_mb_per_sample=2.0,
+    )
+    dec_lt = ProfiledLayerType(
+        fwd_ms_per_sample=2.5, parameter_mb=70.0,
+        activation_mb_per_sample={1: 40.0, 2: 20.0, 4: 10.0},
+        boundary_activation_mb_per_sample=2.0,
+    )
+    costs = ProfiledModelCosts(
+        layer_types={i: (enc_lt if i < 3 else dec_lt) for i in range(8)},
+        other_param_mb=30.0, other_act_mb_per_sample=4.0,
+        other_fwd_ms_per_sample=0.2,
+    )
+    hw = ProfiledHardware(
+        allreduce_bw={"2_1": 150.0, "2_0": 30.0, "4_1": 140.0, "8_1": 120.0},
+        p2p_bw={2: 50.0}, overlap_coe=1.1,
+    )
+    eng = SearchEngine(
+        costs, hw, num_layers=8,
+        space=SearchSpace(world_size=8, pp_choices=[2], max_tp=2),
+        memory_budget_mb=1400.0,
+    )
+    res = eng.search([8])
+    assert res is not None and res.config.pp == 2
+    assert len(res.config.layer_strategies) == 8
+    assert res.config.pp_division is not None and len(res.config.pp_division) == 4
+    div = res.config.pp_division
+    assert sum(div[:2]) == 3 and sum(div[2:]) == 5
+    cfg = T5.replace(enc_layers=3, num_layers=5)
+    rt = build_runtime(cfg, res.config, adam=AdamConfig(lr=1e-3), global_batch_size=8)
+    state = rt.init_state(jax.random.key(0))
+    rng = np.random.RandomState(9)
+    b = jnp.asarray(rng.randint(0, 128, (8, cfg.sample_len + 1)), jnp.int32)
+    state, loss = rt.train_step(state, b)
     assert np.isfinite(float(loss))
 
 
