@@ -113,18 +113,29 @@ def stage_layout(
             f"pp_division {div} must have {pp} entries >= 1 summing to {L}"
         )
     offsets = list(np.cumsum([0] + div[:-1]))
-    position_strategies: List[LayerStrategy] = []
+    return div, offsets, position_strategies(hp.layer_strategies, div, offsets, "")
+
+
+def position_strategies(
+    strats: List[LayerStrategy], div: List[int], offsets: List[int], kind: str
+) -> List[LayerStrategy]:
+    """The shared per-position strategy of a padded stage stack: stacked
+    arrays have one sharding, so real layers at the same stack position must
+    agree across stages (the enc-dec layout applies this per sub-stack)."""
+    pp = len(div)
+    out: List[LayerStrategy] = []
     for j in range(max(div)):
         stages_with_j = [s for s in range(pp) if div[s] > j]
-        strats = {hp.layer_strategies[offsets[s] + j] for s in stages_with_j}
-        if len(strats) > 1:
+        ss = {strats[offsets[s] + j] for s in stages_with_j}
+        if len(ss) > 1:
             raise ValueError(
-                f"layers at stage-position {j} must share one strategy across "
-                f"stages (got {sorted(map(str, strats))}); arbitrary per-layer "
+                f"{kind + ' ' if kind else ''}layers at stage-position {j} "
+                f"must share one strategy across stages "
+                f"(got {sorted(map(str, ss))}); arbitrary per-layer "
                 "heterogeneity is available at pp=1"
             )
-        position_strategies.append(next(iter(strats)))
-    return div, offsets, position_strategies
+        out.append(next(iter(ss)))
+    return out
 
 
 def validate_pipeline_strategies(cfg: ModelConfig, hp: HybridParallelConfig) -> int:

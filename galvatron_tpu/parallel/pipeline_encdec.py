@@ -107,26 +107,13 @@ class EncDecLayout:
         self.off_d = list(np.cumsum([0] + self.div_d[:-1]))
         self.lpe, self.lpd = max(self.div_e), max(self.div_d)
         self.pp = pp
+        from galvatron_tpu.parallel.pipeline import position_strategies
 
-        def positions(strats, div, off, lps, kind):
-            out = []
-            for q in range(lps):
-                stages_with_q = [s for s in range(pp) if div[s] > q]
-                ss = {strats[off[s] + q] for s in stages_with_q}
-                if len(ss) > 1:
-                    raise ValueError(
-                        f"{kind} layers at virtual-stage position {q} must "
-                        f"share one strategy across stages "
-                        f"(got {sorted(map(str, ss))})"
-                    )
-                out.append(next(iter(ss)))
-            return out
-
-        self.enc_pos = positions(
-            hp.layer_strategies[:E], self.div_e, self.off_e, self.lpe, "encoder"
+        self.enc_pos = position_strategies(
+            hp.layer_strategies[:E], self.div_e, self.off_e, "encoder"
         )
-        self.dec_pos = positions(
-            hp.layer_strategies[E:], self.div_d, self.off_d, self.lpd, "decoder"
+        self.dec_pos = position_strategies(
+            hp.layer_strategies[E:], self.div_d, self.off_d, "decoder"
         )
 
 
@@ -141,11 +128,10 @@ def validate_encdec_pipeline(
             f"enc-dec pipeline needs chunks ({hp.chunks}) divisible by "
             f"pp={hp.pp} (micro-batches flow in groups of pp on the ring)"
         )
-    if hp.pipeline_type != "gpipe":
+    if hp.pipeline_type not in ("gpipe", "pipedream_flush"):
         raise ValueError(
-            "enc-dec pipeline implements the gpipe-ordered coupled-sub-"
-            "pipeline schedule only; set pipeline_type='gpipe' "
-            f"(got {hp.pipeline_type!r})"
+            f"unknown pipeline_type {hp.pipeline_type!r} for the enc-dec "
+            "pipeline (gpipe | pipedream_flush)"
         )
     return EncDecLayout(cfg, hp)
 
@@ -476,7 +462,7 @@ def build_encdec_pipeline_runtime(
     fp16 = hp.mixed_precision == "fp16"
     scaler_cfg = LossScalerConfig()
 
-    def train_step(state, batch):
+    def gpipe_train_step(state, batch):
         if fp16:
             loss, grads = scaled_value_and_grad(loss_fn, state["scaler"]["scale"])(
                 state["params"], batch
@@ -485,6 +471,268 @@ def build_encdec_pipeline_runtime(
         loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
         new_params, new_opt = adamw_update(state["params"], grads, state["opt"], adam)
         return {"params": new_params, "opt": new_opt, "step": state["step"] + 1}, loss
+
+    # ------------------------------------------------------------------
+    # 1F1B (pipedream_flush) ordering: hand-written backward over the coupled
+    # sub-pipelines. The coupled pipeline is an interleaved virtual pipeline
+    # of depth 2*pp (enc virtual stage s and dec virtual stage pp+s live on
+    # device s), so the backward mirrors pipeline_1f1b: the dec backward wave
+    # starts at the last device in the SAME tick as that chunk's dec forward,
+    # rides the down-chain accumulating the cross-attention context cotangent,
+    # wraps at device 0 to seed the enc backward wave. Backward recomputes
+    # each section from stashed inputs (ring buffers bounded by the schedule
+    # depth, independent of chunks — the 1F1B property the gpipe-ordered
+    # autodiff backward lacks). enc_final_norm is folded INTO the dec section
+    # here (ctx rides the chain pre-norm), so its vjp and parameter grads fall
+    # out of the per-stage dec vjp with no separate norm bookkeeping.
+    #
+    #   enc fwd: m = t - s            dec fwd: m = t - pp - s
+    #   dec bwd: m = t - (3pp-2) + s  enc bwd: m = t - (4pp-2) + s
+    #   T = chunks + 4pp - 2;  stashes: enc min(chunks, 4pp-1),
+    #   dec/ctx min(chunks, 2pp-1)   (+1 sacrificial slot each)
+    # ------------------------------------------------------------------
+    from galvatron_tpu.parallel.pipeline_1f1b import _head_loss
+
+    head_keys = ("final_norm", "embed") if cfg.tie_word_embeddings else ("final_norm", "head")
+    n_se = min(chunks, 4 * pp - 1)
+    n_sd = min(chunks, 2 * pp - 1)
+    T_1f1b = chunks + 4 * pp - 2
+    n_static = mb * S_d  # loss-carrying positions per micro-batch
+    chain_down = [(i + 1, i) for i in range(pp - 1)]
+    ring_wrap_down = [(i, (i - 1) % pp) for i in range(pp)]
+
+    def dec_sec_norm(dec_stages_, enc_norm_, y, pre_ctx):
+        return dec_section(dec_stages_, y, modeling.norm(pre_ctx, enc_norm_, cfg))
+
+    def pipeline_body_1f1b(enc_stages, dec_stages, enc_norm, head_sub,
+                           enc_mbs, dec_mbs, labels_mbs, scale):
+        enc_stages = jax.tree.map(lambda a: jnp.squeeze(a, 0), enc_stages)
+        dec_stages = jax.tree.map(lambda a: jnp.squeeze(a, 0), dec_stages)
+        s = jax.lax.axis_index("pp")
+        is_last = s == pp - 1
+        is_first = s == 0
+        h = cfg.hidden_size
+        dt = enc_mbs.dtype
+        ea = (mb, S_e, h)
+        da = (mb, S_d, h)
+        f32 = lambda tree: jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), tree)
+
+        carry0 = {
+            "fe": jnp.zeros(ea, dt),       # enc fwd send (wrapped up-ring)
+            "fd": jnp.zeros(da, dt),       # dec fwd send (up chain)
+            "fctx": jnp.zeros(ea, dt),     # pre-norm ctx send (up chain)
+            "bdy": jnp.zeros(da, dt),      # dec bwd dy send (down chain)
+            "bdctx": jnp.zeros(ea, jnp.float32),  # accumulated dctx (down chain)
+            "be": jnp.zeros(ea, jnp.float32),     # enc bwd seed (wrapped down-ring)
+            "bey": jnp.zeros(ea, dt),      # enc bwd dy send (down chain)
+            "stash_e": jnp.zeros((n_se + 1,) + ea, dt),
+            "stash_d": jnp.zeros((n_sd + 1,) + da, dt),
+            "stash_ctx": jnp.zeros((n_sd + 1,) + ea, dt),
+            "dw_e": f32(enc_stages),
+            "dw_d": f32(dec_stages),
+            "dnorm": f32(enc_norm),
+            "dhead": f32(head_sub),
+            "dxe": jnp.zeros((chunks + 1,) + ea, jnp.float32),
+            "dxd": jnp.zeros((chunks + 1,) + da, jnp.float32),
+            "loss_sum": jnp.zeros((), jnp.float32),
+            "tok": jnp.zeros((), jnp.float32),
+        }
+
+        def tick(carry, t):
+            re = jax.lax.ppermute(carry["fe"], "pp", ring_wrap)
+            rd = jax.lax.ppermute(carry["fd"], "pp", chain)
+            rctx = jax.lax.ppermute(carry["fctx"], "pp", chain)
+            rdy_d = jax.lax.ppermute(carry["bdy"], "pp", chain_down)
+            rdctx = jax.lax.ppermute(carry["bdctx"], "pp", chain_down)
+            rbe = jax.lax.ppermute(carry["be"], "pp", ring_wrap_down)
+            rdy_e = jax.lax.ppermute(carry["bey"], "pp", chain_down)
+
+            # ---- encoder forward
+            m_ef = t - s
+            ef_valid = (m_ef >= 0) & (m_ef < chunks)
+            mef_c = jnp.clip(m_ef, 0, chunks - 1)
+            x_in_e = jnp.where(
+                is_first, jax.lax.dynamic_index_in_dim(enc_mbs, mef_c, keepdims=False), re
+            )
+            out_e = enc_section(enc_stages, x_in_e)
+            e_slot = jnp.where(ef_valid, jnp.mod(mef_c, n_se), n_se)
+            stash_e = jax.lax.dynamic_update_index_in_dim(
+                carry["stash_e"], x_in_e, e_slot, 0
+            )
+
+            # ---- decoder forward (ctx rides the chain PRE-norm; device 0's
+            # ctx is the wrapped enc output of the same chunk)
+            m_df = t - pp - s
+            df_valid = (m_df >= 0) & (m_df < chunks)
+            mdf_c = jnp.clip(m_df, 0, chunks - 1)
+            y_in = jnp.where(
+                is_first, jax.lax.dynamic_index_in_dim(dec_mbs, mdf_c, keepdims=False), rd
+            )
+            ctx_in = jnp.where(is_first, re, rctx)
+            out_d = dec_sec_norm(dec_stages, enc_norm, y_in, ctx_in)
+            d_slot = jnp.where(df_valid, jnp.mod(mdf_c, n_sd), n_sd)
+            stash_d = jax.lax.dynamic_update_index_in_dim(carry["stash_d"], y_in, d_slot, 0)
+            stash_ctx = jax.lax.dynamic_update_index_in_dim(
+                carry["stash_ctx"], ctx_in, d_slot, 0
+            )
+
+            # ---- decoder backward (recompute from stash; head loss on the
+            # recomputed output at the last device, 1F1B same-tick fwd/bwd)
+            m_db = t - (3 * pp - 2) + s
+            db_valid = (m_db >= 0) & (m_db < chunks)
+            mdb_c = jnp.clip(m_db, 0, chunks - 1)
+            y_saved = jax.lax.dynamic_index_in_dim(
+                stash_d, jnp.mod(mdb_c, n_sd), keepdims=False
+            )
+            ctx_saved = jax.lax.dynamic_index_in_dim(
+                stash_ctx, jnp.mod(mdb_c, n_sd), keepdims=False
+            )
+            out_rec, d_vjp = jax.vjp(dec_sec_norm, dec_stages, enc_norm, y_saved, ctx_saved)
+            labels = jax.lax.dynamic_index_in_dim(labels_mbs, mdb_c, keepdims=False)
+            nll, head_vjp, cnt = jax.vjp(
+                lambda hs, y: _head_loss(hs, y, labels, cfg), head_sub, out_rec,
+                has_aux=True,
+            )
+            head_mask = (is_last & db_valid).astype(jnp.float32)
+            dhead_mb, dy_head = head_vjp(head_mask * scale / n_static)
+            dy_in = jnp.where(is_last, dy_head, rdy_d)
+            dy_in = jnp.where(db_valid, dy_in, jnp.zeros_like(dy_in))
+            dw_d_mb, dnorm_mb, dy_out, dctx_out = d_vjp(dy_in.astype(dt))
+            dctx_acc = dctx_out.astype(jnp.float32) + jnp.where(
+                is_last, jnp.zeros_like(rdctx), rdctx
+            )
+            dxd = jax.lax.dynamic_update_index_in_dim(
+                carry["dxd"], dy_out.astype(jnp.float32),
+                jnp.where(db_valid & is_first, mdb_c, chunks), 0,
+            )
+
+            # ---- encoder backward (seeded by device 0's accumulated dctx,
+            # wrapped to the last device one tick later)
+            m_eb = t - (4 * pp - 2) + s
+            eb_valid = (m_eb >= 0) & (m_eb < chunks)
+            meb_c = jnp.clip(m_eb, 0, chunks - 1)
+            xe_saved = jax.lax.dynamic_index_in_dim(
+                stash_e, jnp.mod(meb_c, n_se), keepdims=False
+            )
+            _, e_vjp = jax.vjp(enc_section, enc_stages, xe_saved)
+            dye_in = jnp.where(is_last, rbe.astype(dt), rdy_e)
+            dye_in = jnp.where(eb_valid, dye_in, jnp.zeros_like(dye_in))
+            dw_e_mb, dxe_out = e_vjp(dye_in)
+            dxe = jax.lax.dynamic_update_index_in_dim(
+                carry["dxe"], dxe_out.astype(jnp.float32),
+                jnp.where(eb_valid & is_first, meb_c, chunks), 0,
+            )
+
+            new_carry = {
+                "fe": out_e,
+                "fd": out_d,
+                "fctx": ctx_in,
+                "bdy": dy_out.astype(dt),
+                "bdctx": dctx_acc,
+                "be": dctx_acc,  # meaningful only from device 0 via the wrap
+                "bey": dxe_out.astype(dt),
+                "stash_e": stash_e,
+                "stash_d": stash_d,
+                "stash_ctx": stash_ctx,
+                "dw_e": jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), carry["dw_e"], dw_e_mb
+                ),
+                "dw_d": jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), carry["dw_d"], dw_d_mb
+                ),
+                "dnorm": jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), carry["dnorm"], dnorm_mb
+                ),
+                "dhead": jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), carry["dhead"], dhead_mb
+                ),
+                "dxe": dxe,
+                "dxd": dxd,
+                "loss_sum": carry["loss_sum"] + nll * head_mask,
+                "tok": carry["tok"] + cnt * head_mask,
+            }
+            return new_carry, None
+
+        carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T_1f1b))
+        stack = lambda tree: jax.tree.map(lambda a: a[None], tree)
+        return (
+            carry["loss_sum"][None],
+            carry["tok"][None],
+            stack(carry["dw_e"]),
+            stack(carry["dw_d"]),
+            stack(carry["dnorm"]),
+            stack(carry["dhead"]),
+            carry["dxe"][None, :chunks],
+            carry["dxd"][None, :chunks],
+        )
+
+    body_1f1b_sm = jax.shard_map(
+        pipeline_body_1f1b,
+        mesh=mesh,
+        in_specs=(P("pp"), P("pp"), P(), P(), P(), P(), P(), P()),
+        out_specs=tuple([P("pp")] * 8),
+        axis_names={"pp"},
+        check_vma=False,
+    )
+
+    def train_step_1f1b(state, batch):
+        params = state["params"]
+        scale = state["scaler"]["scale"] if fp16 else jnp.ones((), jnp.float32)
+        enc_tokens = batch[:, :S_e]
+        dec = batch[:, S_e:]
+        dec_tokens, labels = dec[:, :-1], dec[:, 1:]
+        head_sub = {k: params[k] for k in head_keys}
+
+        def embed_fn(embed_params):
+            pe = {"embed": embed_params}
+            xe = modeling.embed(enc_tokens, pe, cfg)
+            xd = modeling.embed(dec_tokens, pe, cfg)
+            return constrain(xe, mesh, full_spec), constrain(xd, mesh, full_spec)
+
+        (xe, xd), embed_vjp = jax.vjp(embed_fn, params["embed"])
+        enc_mbs = xe.reshape(chunks, mb, S_e, cfg.hidden_size)
+        dec_mbs = xd.reshape(chunks, mb, S_d, cfg.hidden_size)
+        labels_mbs = labels.reshape(chunks, mb, S_d)
+
+        (loss_s, tok_s, dw_e_s, dw_d_s, dnorm_s, dhead_s, dxe_s, dxd_s) = body_1f1b_sm(
+            params["enc_stages"], params["dec_stages"], params["enc_final_norm"],
+            head_sub, enc_mbs, dec_mbs, labels_mbs, scale,
+        )
+        loss_sum = loss_s[-1]
+        tok = jnp.maximum(tok_s[-1], 1.0)
+        d_head = jax.tree.map(lambda a: a[-1], dhead_s)
+        # enc_final_norm grads accumulate on EVERY device (each dec sub-stage
+        # back-propagates through the folded norm) — sum the pp stack
+        d_norm = jax.tree.map(lambda a: a.sum(axis=0), dnorm_s)
+        dxe_full = dxe_s[0].reshape(global_batch_size, S_e, cfg.hidden_size)
+        dxd_full = dxd_s[0].reshape(global_batch_size, S_d, cfg.hidden_size)
+        (d_embed,) = embed_vjp((dxe_full.astype(xe.dtype), dxd_full.astype(xd.dtype)))
+
+        grads: Dict[str, Any] = {
+            "enc_stages": dw_e_s,
+            "dec_stages": dw_d_s,
+            "embed": d_embed,
+            "enc_final_norm": d_norm,
+        }
+        for k in head_keys:
+            if k == "embed":
+                grads["embed"] = jax.tree.map(
+                    lambda a, b: a.astype(jnp.float32) + b, grads["embed"], d_head["embed"]
+                )
+            else:
+                grads[k] = d_head[k]
+        gdenom = tok * scale / n_static
+        grads = {k: jax.tree.map(lambda g: g / gdenom, v) for k, v in grads.items()}
+        loss = loss_sum / tok
+
+        if fp16:
+            return apply_update_with_scaler(state, loss, grads, adam, scaler_cfg)
+        new_params, new_opt = adamw_update(params, grads, state["opt"], adam)
+        return {"params": new_params, "opt": new_opt, "step": state["step"] + 1}, loss
+
+    train_step = (
+        train_step_1f1b if hp.pipeline_type == "pipedream_flush" else gpipe_train_step
+    )
 
     def init_state(key):
         params = init_encdec_pipeline_params(key, cfg, hp)

@@ -147,6 +147,45 @@ def test_encdec_pp2_ragged_counts_parity():
         build_runtime(cfg, hp3, adam=AdamConfig(lr=1e-3), global_batch_size=8)
 
 
+@pytest.mark.parametrize(
+    "E,D,chunks",
+    [
+        (4, 4, 4),
+        # ragged trajectory is also pinned by the dryrun + ragged parity test
+        pytest.param(3, 5, 2, marks=pytest.mark.slow),
+    ],
+)
+def test_encdec_1f1b_training_matches_flat_trajectory(E, D, chunks):
+    """1F1B-ordered enc-dec (hand-written backward over the coupled
+    sub-pipelines, bounded stashes): two train steps must track a manual flat
+    AdamW loop exactly — the strongest gradient check; includes a ragged
+    (E=3, D=5) division."""
+    from galvatron_tpu.core.optim import adamw_update, init_opt_state
+
+    cfg = T5.replace(enc_layers=E, num_layers=D)
+    hp = HybridParallelConfig.uniform(
+        E + D, pp=2, chunks=chunks, mixed_precision="fp32",
+        pipeline_type="pipedream_flush",
+    )
+    rt = build_runtime(cfg, hp, adam=AdamConfig(lr=1e-3), global_batch_size=8)
+    flat = modeling.init_model_params(jax.random.key(1), cfg)
+    state = rt.init_state_from(flat)
+    opt = init_opt_state(flat)
+    ADAM = AdamConfig(lr=1e-3)
+    pipe_losses, ref_losses = [], []
+    for i in range(2):
+        rng = np.random.RandomState(i)
+        b = jnp.asarray(rng.randint(0, 128, (8, cfg.sample_len + 1)), jnp.int32)
+        state, loss = rt.train_step(state, b)
+        pipe_losses.append(float(loss))
+        ref_loss, grads = jax.jit(
+            jax.value_and_grad(lambda p, bb: modeling.lm_loss(p, bb, cfg))
+        )(flat, b)
+        flat, opt = adamw_update(flat, grads, opt, ADAM)
+        ref_losses.append(float(ref_loss))
+    np.testing.assert_allclose(pipe_losses, ref_losses, rtol=5e-5, atol=5e-5)
+
+
 @pytest.mark.slow  # fp16 pipeline variants are slow-marked across the suite
 def test_encdec_pp2_fp16_tracks_fp32():
     """fp16 (dynamic loss scaling) through the enc-dec pipeline: losses track
