@@ -87,15 +87,63 @@ def _iter_time_ms(cfg: ModelConfig, bsz: int, seq: int, iters: int = 4) -> float
     basis (tp=1, ddp, chunks=1 on ONE device)."""
     from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
 
-    mp = {jnp.bfloat16: "bf16", jnp.float16: "fp16"}.get(cfg.dtype, "fp32")
     hp = HybridParallelConfig(
         pp=1,
         layer_strategies=[LayerStrategy()] * cfg.total_layers,  # enc + dec
         chunks=1,
         vocab_tp=1,
-        mixed_precision=mp,
+        mixed_precision=_mp_of(cfg),
     )
     return measure_strategy_ms(cfg, hp, bsz, seq, iters, devices=jax.devices()[:1])
+
+
+def _mp_of(cfg: ModelConfig) -> str:
+    return {jnp.bfloat16: "bf16", jnp.float16: "fp16"}.get(cfg.dtype, "fp32")
+
+
+def profile_vocab_costs(
+    cfg: ModelConfig,
+    bsz: int,
+    vocab_tps=(1, 2, 4),
+    seq: Optional[int] = None,
+    iters: int = 4,
+) -> Tuple[dict, dict, str]:
+    """MEASURED embed+head+loss cost per vocab_tp as (slope ms/sample,
+    const ms/iteration, precision): a ZERO-LAYER model on exactly vocab_tp
+    devices (dp=1) runs precisely the computation the cost model's "other"
+    terms price — embedding gather, head GEMM, (vocab-parallel) cross-
+    entropy with its per-token scalar reductions, and the optimizer update
+    on those params — with the runtime's real shardings. Two batch sizes
+    (bsz, 2·bsz) separate the batch-linear share from the batch-independent
+    one (the Adam update on V·h params dominates a zero-layer step at small
+    batch, so a single-point linear scaling would grossly over-price large
+    per-device batches). dp=1 keeps the dp-extent comm OUT of the
+    measurement; other_time_cost adds it analytically for the search
+    topology. Skips vocab_tp degrees the host cannot supply (>1 on a single
+    chip) — those fall back to the analytic terms."""
+    seq = seq or cfg.max_seq_len
+    mp = _mp_of(cfg)
+    if cfg.enc_layers > 0 or cfg.objective == "cls":
+        return {}, {}, mp  # enc-dec / cls 'other' paths keep the analytic model
+    cfg0 = cfg.replace(num_layers=0)
+    slope, const = {}, {}
+    for vt in vocab_tps:
+        if vt > len(jax.devices()) or cfg.vocab_size % vt:
+            continue
+        hp = HybridParallelConfig(
+            pp=1, layer_strategies=[], chunks=1, vocab_tp=vt, mixed_precision=mp
+        )
+        try:
+            t1 = measure_strategy_ms(cfg0, hp, bsz, seq, iters, devices=jax.devices()[:vt])
+            t2 = measure_strategy_ms(
+                cfg0, hp, 2 * bsz, seq, iters, devices=jax.devices()[:vt]
+            )
+        except Exception:
+            continue  # leave this degree to the analytic fallback
+        m = max(0.0, (t2 - t1) / bsz)  # ms per sample-per-device
+        slope[int(vt)] = float(m)
+        const[int(vt)] = float(max(0.0, t1 - m * bsz))
+    return slope, const, mp
 
 
 def _temp_bytes(cfg: ModelConfig, bsz: int, seq: int) -> Optional[int]:
@@ -131,11 +179,10 @@ def _temp_bytes_tp(cfg: ModelConfig, bsz: int, seq: int, tp: int) -> Optional[in
         from galvatron_tpu.parallel.mesh import build_mesh
 
         mesh, axes = build_mesh(pp=1, devices=jax.devices()[:tp])
-        mp = {jnp.bfloat16: "bf16", jnp.float16: "fp16"}.get(cfg.dtype, "fp32")
         hp = HybridParallelConfig(
             pp=1,
             layer_strategies=[LayerStrategy(tp=tp)] * cfg.num_layers,
-            chunks=1, vocab_tp=tp, mixed_precision=mp,
+            chunks=1, vocab_tp=tp, mixed_precision=_mp_of(cfg),
         )
         rt = build_runtime(
             cfg, hp, mesh=mesh, axes=axes, adam=AdamConfig(lr=1e-4),
@@ -240,7 +287,13 @@ def profile_model(
         other_param_mb=float(other_param_count(cfg) * 4 / 1e6),
         other_act_mb_per_sample=float(seq * cfg.vocab_size * 4 / 1e6),  # logits fp32
         other_fwd_ms_per_sample=float(other_ms),
+        hidden_size=cfg.hidden_size,
     )
+    if measure_time:
+        vslope, vconst, vmp = profile_vocab_costs(cfg, bsz, seq=seq)
+        costs.measured_vocab_slope_ms = vslope
+        costs.measured_vocab_const_ms = vconst
+        costs.measured_vocab_mp = vmp
     _maybe_save(costs, out_prefix)
     return costs
 
@@ -310,6 +363,7 @@ def _profile_encdec_model(
         other_param_mb=float(other_param_count(cfg) * 4 / 1e6),
         other_act_mb_per_sample=float(S_d * cfg.vocab_size * 4 / 1e6),
         other_fwd_ms_per_sample=float(other_ms),
+        hidden_size=cfg.hidden_size,
     )
     _maybe_save(costs, out_prefix)
     return costs

@@ -87,6 +87,33 @@ class ProfiledModelCosts:
     # per-sample activation of embed+head+loss (logits dominate)
     other_act_mb_per_sample: float = 0.0
     other_fwd_ms_per_sample: float = 0.0
+    # model hidden size — lets other_time_cost derive the vocab-parallel
+    # cross-entropy scalar volume from first principles instead of a constant
+    hidden_size: int = 0
+    # MEASURED embed+head+loss cost per vocab_tp as a two-point linear fit
+    # over samples-per-device: slope (ms per sample) captures the batch-
+    # linear compute + vocab-parallel collectives, const (ms per iteration)
+    # the batch-independent share (the Adam update on the V·h params
+    # dominates a zero-layer step at small batch). Measured on vocab_tp
+    # devices at dp=1 (profiling/model.py::profile_vocab_costs);
+    # other_time_cost consumes the fit only when the search precision
+    # matches measured_vocab_mp.
+    measured_vocab_slope_ms: Dict[int, float] = field(default_factory=dict)
+    measured_vocab_const_ms: Dict[int, float] = field(default_factory=dict)
+    measured_vocab_mp: str = ""
+
+    def vocab_measurement_for(self, vocab_tp: int, mixed_precision: str):
+        """(slope_ms_per_sample, const_ms) when a matching-precision
+        measurement exists for this vocab_tp, else None."""
+        if (
+            vocab_tp in self.measured_vocab_slope_ms
+            and self.measured_vocab_mp == mixed_precision
+        ):
+            return (
+                self.measured_vocab_slope_ms[vocab_tp],
+                self.measured_vocab_const_ms.get(vocab_tp, 0.0),
+            )
+        return None
 
 
 @dataclass
@@ -247,12 +274,20 @@ def other_time_cost(
 ) -> float:
     """Embedding/head/loss time (ms) per iteration under the vocab strategy
     (the whole-model extension the reference prices via hp_config_whole_model,
-    galvatron/core/hybrid_parallel_config.py:141-179). Compute is spread over
-    the full mesh regardless of vocab_tp (batch x vocab shardings cover all
-    devices); the strategy moves the comm terms: embedding-grad reduction
-    over the dp extent, ZeRO-3 param all-gathers, and the vocab-parallel
-    cross-entropy reductions."""
-    compute = costs.other_fwd_ms_per_sample * global_bsz / world * 3.0
+    galvatron/core/hybrid_parallel_config.py:141-179).
+
+    When the profile carries a MEASURED per-vocab_tp fit (slope + const from
+    profile_vocab_costs, matching precision), the compute + vocab-parallel-
+    collective part comes from measurement: const + slope · samples-per-
+    device. The runtime computes embed/head OUTSIDE the pipelined section
+    with the batch sharded over the pp axes too (full_spec), so samples per
+    device = global_bsz·vocab_tp/world = global_bsz/(dp·pp). Only the
+    dp-extent comm (grad reduction, ZeRO gathers) stays analytic.
+
+    Analytic fallback: compute spread over the full mesh regardless of the
+    (dp, pp, vocab_tp) split is EXACT for the head GEMM / embedding /
+    elementwise loss under that same full-mesh batch sharding; the strategy
+    moves only the comm terms."""
     dp = world // (pp * vocab_tp)
     comm_bytes = 0.5 if mixed_precision in ("bf16", "fp16") else 1.0
     p_mb = costs.other_param_mb / vocab_tp
@@ -263,6 +298,11 @@ def other_time_cost(
     comm = _allreduce_ms(p_mb * comm_bytes * 2.0, dp, dp_bw)
     if embed_dp_type == "zero3":
         comm += 2.0 * _allgather_ms(p_mb * comm_bytes, dp, dp_bw)
+    fit = costs.vocab_measurement_for(vocab_tp, mixed_precision)
+    if fit is not None:
+        slope, const = fit
+        return const + slope * (global_bsz / (dp * pp)) + comm
+    compute = costs.other_fwd_ms_per_sample * global_bsz / world * 3.0
     if vocab_tp > 1 and costs.layer_types:
         lt0 = next(iter(costs.layer_types.values()))
         # vocab-parallel embedding: each device holds a vocab shard, so the
@@ -272,9 +312,15 @@ def other_time_cost(
             lt0.boundary_activation_mb_per_sample * (global_bsz / dp) * comm_bytes
         )
         comm += 2.0 * _allreduce_ms(act_msg, vocab_tp, hw.bw(vocab_tp, True))
-        # vocab-parallel cross entropy allreduces per-token max/sumexp/
-        # picked-logit scalars — ≈ 6/h of the boundary volume
-        comm += _allreduce_ms(0.002 * act_msg, vocab_tp, hw.bw(vocab_tp, True))
+        # vocab-parallel cross entropy allreduces per-token fp32 scalars
+        # (max, sum-exp, picked logit + the mirrored backward share ≈ 4):
+        # volume = S·4·4B per sample = boundary·(8/h) — derived, replacing
+        # the old hand-waved 0.002 constant (which equals h=4096 exactly)
+        h = costs.hidden_size or 4096
+        scalar_msg = (
+            lt0.boundary_activation_mb_per_sample * (global_bsz / dp) * (8.0 / h)
+        )
+        comm += _allreduce_ms(scalar_msg, vocab_tp, hw.bw(vocab_tp, True))
     return compute + comm
 
 

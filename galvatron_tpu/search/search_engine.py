@@ -510,9 +510,11 @@ class SearchEngine:
                     f"{form_strategy(s, pp, dp):>16} | {mc.states_mb:9.1f} | "
                     f"{mc.activation_mb:8.1f} | {mc.total_mb:8.1f} | {t:8.2f}"
                 )
-        # vocab/embedding strategy tradeoff (searched dimension)
+        # vocab/embedding strategy tradeoff (searched dimension); 'src' shows
+        # whether the base term is measured (profile_vocab_costs table) or
+        # analytic
         lines.append(
-            f"{'vocab strategy':>16} | {'other MB':>9} | {'other ms':>8}"
+            f"{'vocab strategy':>16} | {'other MB':>9} | {'other ms':>8} | {'src':>8}"
         )
         for vt, et in _vocab_strategy_pairs(world, pp):
                 omb = other_memory_cost(
@@ -522,8 +524,13 @@ class SearchEngine:
                 oms = other_time_cost(
                     self.costs, self.hw, world, pp, vt, et, global_bsz, self.mp
                 )
+                src = (
+                    "measured"
+                    if self.costs.vocab_measurement_for(vt, self.mp) is not None
+                    else "analytic"
+                )
                 tag = f"vtp{vt}-{et}"
-                lines.append(f"{tag:>16} | {omb:9.1f} | {oms:8.2f}")
+                lines.append(f"{tag:>16} | {omb:9.1f} | {oms:8.2f} | {src:>8}")
         return "\n".join(lines)
 
     def save_result(self, result: SearchResult, path: str) -> None:

@@ -69,6 +69,14 @@ def save_profiled_model(costs: ProfiledModelCosts, time_path=None, mem_path=None
         mem["other"] = {
             "param_mb": costs.other_param_mb,
             "act_mb_per_sample": costs.other_act_mb_per_sample,
+            "hidden_size": costs.hidden_size,
+            "measured_vocab_slope_ms": {
+                str(k): v for k, v in costs.measured_vocab_slope_ms.items()
+            },
+            "measured_vocab_const_ms": {
+                str(k): v for k, v in costs.measured_vocab_const_ms.items()
+            },
+            "measured_vocab_mp": costs.measured_vocab_mp,
         }
         write_json_config(mem, mem_path)
 
@@ -98,6 +106,16 @@ def load_profiled_model(time_path: str, mem_path: str) -> ProfiledModelCosts:
         other_param_mb=float(other.get("param_mb", 0.0)),
         other_act_mb_per_sample=float(other.get("act_mb_per_sample", 0.0)),
         other_fwd_ms_per_sample=float(other_ms),
+        hidden_size=int(other.get("hidden_size", 0)),
+        measured_vocab_slope_ms={
+            int(k): float(v)
+            for k, v in other.get("measured_vocab_slope_ms", {}).items()
+        },
+        measured_vocab_const_ms={
+            int(k): float(v)
+            for k, v in other.get("measured_vocab_const_ms", {}).items()
+        },
+        measured_vocab_mp=str(other.get("measured_vocab_mp", "")),
     )
 
 

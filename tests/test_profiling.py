@@ -105,3 +105,79 @@ def test_per_tp_activation_curve_measured():
     assert curve[1] >= curve[2] >= curve[4]
     # at least one measured entry deviates from exactly curve[1]/t
     assert any(abs(curve[t] - curve[1] / t) > 1e-9 for t in (2, 4))
+
+
+def test_vocab_costs_measured_and_consumed(tmp_path):
+    """The measured per-vocab_tp embed+head+loss fit (zero-layer model on
+    vocab_tp devices, dp=1, two batch points separating batch-linear compute
+    from the constant optimizer share) replaces the analytic vocab terms: at
+    the profile point the prediction sits within 15% of the measurement (the
+    only delta is the analytic dp-extent comm), tokens-per-device scales
+    with pp, the fit is gated on matching precision, and the JSON schema
+    round-trips."""
+    from galvatron_tpu.profiling.model import profile_vocab_costs
+    from galvatron_tpu.search.cost_model import (
+        ProfiledHardware,
+        ProfiledLayerType,
+        ProfiledModelCosts,
+        other_time_cost,
+    )
+    from galvatron_tpu.utils.config_utils import (
+        load_profiled_model,
+        save_profiled_model,
+    )
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+        ffn_dim=256, max_seq_len=64, dtype=jnp.float32,
+    )
+    slope, const, mp = profile_vocab_costs(cfg, bsz=8, vocab_tps=(1, 2, 4))
+    assert set(slope) == {1, 2, 4} and mp == "fp32"
+    assert all(v >= 0 for v in slope.values()) and all(v >= 0 for v in const.values())
+    lt = ProfiledLayerType(
+        fwd_ms_per_sample=1.0, parameter_mb=1.0,
+        activation_mb_per_sample={1: 1.0},
+        boundary_activation_mb_per_sample=cfg.max_seq_len * cfg.hidden_size * 2 / 1e6,
+    )
+    costs = ProfiledModelCosts(
+        layer_types={0: lt}, other_param_mb=0.5,
+        other_act_mb_per_sample=0.5, other_fwd_ms_per_sample=0.2,
+        hidden_size=cfg.hidden_size,
+        measured_vocab_slope_ms=slope, measured_vocab_const_ms=const,
+        measured_vocab_mp=mp,
+    )
+    hw = ProfiledHardware(allreduce_bw={"2_1": 150.0, "4_1": 140.0, "8_1": 120.0})
+    for vt in (1, 2, 4):
+        dp = 8 // vt
+        meas_at_8 = const[vt] + slope[vt] * 8  # the first measurement point
+        pred = other_time_cost(
+            costs, hw, world=8, pp=1, vocab_tp=vt, embed_dp_type="ddp",
+            global_bsz=8 * dp, mixed_precision="fp32",
+        )
+        # samples/device at this global_bsz == the profile point; the only
+        # delta vs measurement is the (tiny here) analytic dp grad comm
+        assert abs(pred - meas_at_8) / meas_at_8 < 0.15, (vt, pred, meas_at_8)
+    # pp>1 halves samples-per-device at the same global batch — the measured
+    # base must shrink accordingly (the analytic compute term never did)
+    p1 = other_time_cost(costs, hw, 8, 1, 1, "ddp", 64, "fp32")
+    p2 = other_time_cost(costs, hw, 8, 2, 1, "ddp", 64, "fp32")
+    assert p2 < p1
+    # precision mismatch -> analytic fallback
+    assert costs.vocab_measurement_for(2, "bf16") is None
+    # schema round-trip
+    save_profiled_model(
+        costs, str(tmp_path / "time.json"), str(tmp_path / "mem.json")
+    )
+    loaded = load_profiled_model(str(tmp_path / "time.json"), str(tmp_path / "mem.json"))
+    assert loaded.measured_vocab_slope_ms == slope
+    assert loaded.measured_vocab_const_ms == const
+    assert loaded.measured_vocab_mp == mp and loaded.hidden_size == 128
+    # the developer harness labels measured vs analytic sources
+    eng = SearchEngine(
+        loaded, hw, num_layers=2,
+        space=SearchSpace(world_size=8, pp_choices=[1]), memory_budget_mb=1000.0,
+        mixed_precision="fp32",
+    )
+    assert "measured" in eng.check_cost_model(8)
+    loaded.measured_vocab_slope_ms.clear()
+    assert "measured" not in eng.check_cost_model(8)
