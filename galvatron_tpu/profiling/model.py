@@ -104,7 +104,7 @@ def _mp_of(cfg: ModelConfig) -> str:
 def profile_vocab_costs(
     cfg: ModelConfig,
     bsz: int,
-    vocab_tps=(1, 2, 4),
+    vocab_tps=(1, 2, 4, 8),
     seq: Optional[int] = None,
     iters: int = 4,
 ) -> Tuple[dict, dict, str]:

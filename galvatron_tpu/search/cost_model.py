@@ -271,6 +271,7 @@ def other_time_cost(
     embed_dp_type: str,
     global_bsz: int,
     mixed_precision: str = "bf16",
+    use_measured: bool = True,
 ) -> float:
     """Embedding/head/loss time (ms) per iteration under the vocab strategy
     (the whole-model extension the reference prices via hp_config_whole_model,
@@ -298,9 +299,15 @@ def other_time_cost(
     comm = _allreduce_ms(p_mb * comm_bytes * 2.0, dp, dp_bw)
     if embed_dp_type == "zero3":
         comm += 2.0 * _allgather_ms(p_mb * comm_bytes, dp, dp_bw)
-    fit = costs.vocab_measurement_for(vocab_tp, mixed_precision)
+    fit = costs.vocab_measurement_for(vocab_tp, mixed_precision) if use_measured else None
     if fit is not None:
         slope, const = fit
+        # the dp=1 measurement's const is dominated by the FULL Adam update
+        # on the V·h params; under embed zero3 each device updates only its
+        # 1/dp shard, so the const shrinks accordingly (the gathers it then
+        # needs are the analytic zero3 comm terms above)
+        if embed_dp_type == "zero3":
+            const = const / dp
         return const + slope * (global_bsz / (dp * pp)) + comm
     compute = costs.other_fwd_ms_per_sample * global_bsz / world * 3.0
     if vocab_tp > 1 and costs.layer_types:

@@ -160,6 +160,37 @@ class SearchEngine:
         lts = self.costs.layer_types
         return lts.get(i, lts[0]) if len(lts) > 1 else lts[0]
 
+    def _feasible_strategies(self, pp: int, global_bsz: int, chunks: int):
+        """Strategy space under the strict chunk filter: the micro-batch
+        (global_bsz / chunks) must split over each strategy's dp axes.
+        Shared by evaluate() and homogeneity_gap() so the two cost models
+        cannot diverge."""
+        world = self.space.world_size
+
+        def feasible(s: LayerStrategy) -> bool:
+            dp = world // (pp * s.tp * s.cp)
+            return (global_bsz % (dp * chunks * max(1, s.cp))) == 0
+
+        return [s for s in generate_layer_strategies(self.space, pp) if feasible(s)]
+
+    def _boundary_msg_mb(self, lt, global_bsz: int, chunks: int) -> float:
+        """Per-micro-batch p2p boundary volume (comm-dtype bytes)."""
+        return (
+            lt.boundary_activation_mb_per_sample
+            * (global_bsz / chunks)
+            * (0.5 if self.mp in ("bf16", "fp16") else 1.0)
+        )
+
+    @staticmethod
+    def _stage_tick_ms(intra, inter, res, chunks: int, vpp: int = 1) -> float:
+        """Per-tick stage time for a chosen per-position assignment: layer
+        compute plus the inter-position resharding every micro-batch pays on
+        its stage pass (transition tables price the full global batch, so
+        /chunks yields the per-micro-batch share)."""
+        n_pos = len(res)
+        inter_sum = sum(inter[res[j], res[j + 1]] for j in range(n_pos - 1))
+        return (sum(intra[j, res[j]] for j in range(n_pos)) + inter_sum) * vpp / chunks
+
     def _type_groups(self):
         """Contiguous (start, count, layer_type) runs over layer indices.
         Grouped by VALUE equality — JSON-loaded profiles materialize a fresh
@@ -221,14 +252,7 @@ class SearchEngine:
             # memories — unit weights give the same split as any baseline cost
             division = pp_division_memory_balanced([1.0] * self.L, pp)
             lps = max(division)
-        cands = generate_layer_strategies(space, pp)
-        # the micro-batch (global_bsz / chunks) must split over each
-        # strategy's dp axes — strict chunk filter
-        def feasible(s: LayerStrategy) -> bool:
-            dp = world // (pp * s.tp * s.cp)
-            return (global_bsz % (dp * chunks * max(1, s.cp))) == 0
-
-        cands = [s for s in cands if feasible(s)]
+        cands = self._feasible_strategies(pp, global_bsz, chunks)
         if not cands:
             return None
         S = len(cands)
@@ -281,7 +305,16 @@ class SearchEngine:
         # the layer DP only when the remaining budget actually changes
         dp_cache: Dict[int, tuple] = {}
         best = None  # (total_ms, res, mem_used, vt, et, other_mb)
-        for vt, et in _vocab_strategy_pairs(world, pp):
+        pairs = list(_vocab_strategy_pairs(world, pp))
+        # consistent pricing across the sweep: consume measured vocab costs
+        # only when EVERY swept degree is covered — a mixed sweep would bias
+        # toward unmeasured degrees (the measured fit carries the
+        # batch-independent optimizer const the analytic terms price at zero)
+        use_measured = all(
+            self.costs.vocab_measurement_for(vt, self.mp) is not None
+            for vt, _ in pairs
+        )
+        for vt, et in pairs:
             other_mb = other_memory_cost(
                 self.costs, world, pp, vocab_tp=vt, embed_dp_type=et,
                 global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,
@@ -302,12 +335,7 @@ class SearchEngine:
                 # so /chunks yields the per-micro-batch share; riding the
                 # tick time lets pipeline_time_cost amplify it by the
                 # fill/steady factor instead of counting it flat)
-                inter_sum = sum(
-                    inter[res[j], res[j + 1]] for j in range(n_pos - 1)
-                )
-                per_stage_ms = (
-                    sum(intra[j, res[j]] for j in range(n_pos)) + inter_sum
-                ) * vpp / chunks
+                per_stage_ms = self._stage_tick_ms(intra, inter, res, chunks, vpp)
                 if multi_type is not None:
                     # two coupled sub-pipelines (pipeline_encdec.py): every
                     # tick runs one enc + one dec virtual stage, so per-tick
@@ -324,19 +352,16 @@ class SearchEngine:
                     p2p_ms = p2p_mb / self.hw.p2p(pp)
                     total_ms = (chunks + 2 * pp - 1) * (per_stage_ms + p2p_ms)
                 else:
-                    boundary_msg = (
-                        lt0.boundary_activation_mb_per_sample
-                        * (global_bsz / chunks)
-                        * (0.5 if self.mp in ("bf16", "fp16") else 1.0)
-                    )
                     total_ms = pipeline_time_cost(
-                        [per_stage_ms] * pp, boundary_msg, pp, chunks, self.hw,
-                        vpp=vpp,
+                        [per_stage_ms] * pp,
+                        self._boundary_msg_mb(lt0, global_bsz, chunks),
+                        pp, chunks, self.hw, vpp=vpp,
                     )
             else:
                 total_ms = cost
             total_ms += other_time_cost(
-                self.costs, self.hw, world, pp, vt, et, global_bsz, self.mp
+                self.costs, self.hw, world, pp, vt, et, global_bsz, self.mp,
+                use_measured=use_measured,
             )
             if best is None or total_ms < best[0]:
                 best = (total_ms, res, mem_used, vt, et, other_mb)
@@ -474,6 +499,82 @@ class SearchEngine:
             )
         return best
 
+    def homogeneity_gap(
+        self, pp: int, global_bsz: int, chunks: int,
+        pipeline_type: str = "pipedream_flush",
+    ) -> Optional[Dict]:
+        """Quantify the cross-stage homogeneity restriction (the reference
+        places any strategy on any layer of any stage,
+        hybrid_parallel_model.py:81-153; this runtime's padded SPMD stacking
+        shares one strategy per stack position across stages).
+
+        For homogeneous layers under a uniform budget, per-stage DPs are
+        IDENTICAL subproblems, so the restriction costs nothing under gpipe.
+        The gap comes from 1F1B's stage-varying activation bound
+        (2(pp-1-s)+1 in-flight micro-batches): later stages have memory
+        headroom the position-restricted DP — which prices stage 0's worst
+        case everywhere — cannot exploit. This runs the layer DP once per
+        stage with stage-specific memory (the reference's formulation) and
+        reports the predicted iteration-time delta.
+
+        Returns {restricted_ms, unrestricted_ms, delta_pct, per_stage}
+        (None when the restricted search itself finds nothing feasible)."""
+        r = self.evaluate(pp, global_bsz, chunks, pipeline_type)
+        if r is None or pp == 1 or len(self.costs.layer_types) > 1:
+            return None
+        world = self.space.world_size
+        lps = -(-self.L // pp)
+        cands = self._feasible_strategies(pp, global_bsz, chunks)
+        S = len(cands)
+        lt0 = self._layer_type(0)
+        vt = r.config.vocab_tp
+        et = r.config.embed_dp_type
+        other_mb = other_memory_cost(
+            self.costs, world, pp, vocab_tp=vt, embed_dp_type=et,
+            global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,
+        )
+        budget = self.budget_mb - other_mb
+        if budget <= 0:
+            return None
+        V = int(budget / self.unit)
+        inter = np.zeros((S, S), np.float64)
+        for a in range(S):
+            for b in range(S):
+                inter[a, b] = transition_cost_ms(
+                    cands[a], cands[b], lt0, self.hw, world, pp, global_bsz, self.mp
+                )
+        intra = np.zeros((lps, S), np.float64)
+        for k, s in enumerate(cands):
+            intra[:, k] = layer_time_cost(
+                lt0, s, self.hw, world, pp, global_bsz, mixed_precision=self.mp
+            )
+        stage_ms, per_stage = [], []
+        for st in range(pp):
+            mem = np.zeros((lps, S), np.int32)
+            for k, s in enumerate(cands):
+                mc = layer_memory_cost(
+                    lt0, s, world, pp, global_bsz, chunks, stage_idx=st,
+                    pipeline_type=pipeline_type, mixed_precision=self.mp,
+                )
+                mem[:, k] = max(1, int(np.ceil(mc.total_mb / self.unit)))
+            cost, res, _ = run_dp(mem, intra, inter, V)
+            if not np.isfinite(cost) or (res < 0).any():
+                return None
+            stage_ms.append(self._stage_tick_ms(intra, inter, res, chunks))
+            per_stage.append([form_strategy(cands[k], pp, world // (pp * cands[k].tp * cands[k].cp)) for k in res])
+        unrestricted = pipeline_time_cost(
+            stage_ms, self._boundary_msg_mb(lt0, global_bsz, chunks), pp, chunks, self.hw
+        )
+        unrestricted += other_time_cost(
+            self.costs, self.hw, world, pp, vt, et, global_bsz, self.mp
+        )
+        return {
+            "restricted_ms": float(r.cost_ms),
+            "unrestricted_ms": float(unrestricted),
+            "delta_pct": float(100.0 * (r.cost_ms - unrestricted) / r.cost_ms),
+            "per_stage": per_stage,
+        }
+
     def check_cost_model(
         self, global_bsz: int, chunks: int = 1, pp: int = 1,
         pipeline_type: str = "gpipe", strategies: Optional[Sequence[LayerStrategy]] = None,
@@ -512,23 +613,26 @@ class SearchEngine:
                 )
         # vocab/embedding strategy tradeoff (searched dimension); 'src' shows
         # whether the base term is measured (profile_vocab_costs table) or
-        # analytic
+        # analytic — with the same whole-sweep consistency gate evaluate()
+        # applies (a mixed sweep would bias toward unmeasured degrees)
+        pairs = list(_vocab_strategy_pairs(world, pp))
+        use_measured = all(
+            self.costs.vocab_measurement_for(vt, self.mp) is not None
+            for vt, _ in pairs
+        )
         lines.append(
             f"{'vocab strategy':>16} | {'other MB':>9} | {'other ms':>8} | {'src':>8}"
         )
-        for vt, et in _vocab_strategy_pairs(world, pp):
+        for vt, et in pairs:
                 omb = other_memory_cost(
                     self.costs, world, pp, vocab_tp=vt, embed_dp_type=et,
                     global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,
                 )
                 oms = other_time_cost(
-                    self.costs, self.hw, world, pp, vt, et, global_bsz, self.mp
+                    self.costs, self.hw, world, pp, vt, et, global_bsz, self.mp,
+                    use_measured=use_measured,
                 )
-                src = (
-                    "measured"
-                    if self.costs.vocab_measurement_for(vt, self.mp) is not None
-                    else "analytic"
-                )
+                src = "measured" if use_measured else "analytic"
                 tag = f"vtp{vt}-{et}"
                 lines.append(f"{tag:>16} | {omb:9.1f} | {oms:8.2f} | {src:>8}")
         return "\n".join(lines)

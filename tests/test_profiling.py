@@ -131,8 +131,8 @@ def test_vocab_costs_measured_and_consumed(tmp_path):
         vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
         ffn_dim=256, max_seq_len=64, dtype=jnp.float32,
     )
-    slope, const, mp = profile_vocab_costs(cfg, bsz=8, vocab_tps=(1, 2, 4))
-    assert set(slope) == {1, 2, 4} and mp == "fp32"
+    slope, const, mp = profile_vocab_costs(cfg, bsz=8)
+    assert set(slope) == {1, 2, 4, 8} and mp == "fp32"
     assert all(v >= 0 for v in slope.values()) and all(v >= 0 for v in const.values())
     lt = ProfiledLayerType(
         fwd_ms_per_sample=1.0, parameter_mb=1.0,
@@ -147,7 +147,7 @@ def test_vocab_costs_measured_and_consumed(tmp_path):
         measured_vocab_mp=mp,
     )
     hw = ProfiledHardware(allreduce_bw={"2_1": 150.0, "4_1": 140.0, "8_1": 120.0})
-    for vt in (1, 2, 4):
+    for vt in (1, 2, 4, 8):
         dp = 8 // vt
         meas_at_8 = const[vt] + slope[vt] * 8  # the first measurement point
         pred = other_time_cost(
