@@ -184,7 +184,10 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         ns = initialize_galvatron("profile_hardware", rest, model_default)
         from galvatron_tpu.profiling.hardware import profile_hardware
 
-        hw = profile_hardware(msg_mb=ns.profile_size_mb, out_path=ns.hardware_output_path)
+        hw = profile_hardware(
+            msg_mb=ns.profile_size_mb, out_path=ns.hardware_output_path,
+            num_slices=ns.num_slices or None,
+        )
         print(f"allreduce: {hw.allreduce_bw}")
         print(f"p2p: {hw.p2p_bw}")
         print(f"overlap_coe: {hw.overlap_coe}")

@@ -212,6 +212,10 @@ def _add_hardware_args(p: argparse.ArgumentParser):
     g = p.add_argument_group("profile-hardware")
     g.add_argument("--profile_size_mb", type=float, default=64.0)
     g.add_argument("--hardware_output_path", type=str, default="hardware_config.json")
+    g.add_argument("--num_slices", type=int, default=0,
+                   help="profile on the slice-major multislice mesh so "
+                   "DCN-crossing groups are measured as such (0 = "
+                   "auto-detect from device slice indices)")
 
 
 def build_parser(mode: str, model_default: Optional[str] = None) -> argparse.ArgumentParser:

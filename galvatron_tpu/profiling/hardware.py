@@ -110,15 +110,18 @@ def profile_allreduce(
 
 
 def profile_p2p(
-    world: int, msg_mb: float = 64.0, dtype=jnp.bfloat16
+    world: int, msg_mb: float = 64.0, dtype=jnp.bfloat16, num_slices: int = 1
 ) -> Dict[int, float]:
     """ppermute bandwidth (GB/s) per pipeline degree (reference p2p profile:
-    core/profiler.py:429-441)."""
+    core/profiler.py:429-441). With ``num_slices``>1 the mesh is built
+    slice-major exactly as the runtime's (mesh.build_mesh), so the pp ring
+    crosses the DCN boundary and the measured bandwidth IS the DCN number
+    the search will price pp>1 with."""
     out: Dict[int, float] = {}
     nbytes = np.dtype(dtype).itemsize
     pp = 2
     while pp <= world:
-        mesh, axes = build_mesh(pp=pp)
+        mesh, axes = build_mesh(pp=pp, num_slices=num_slices if num_slices > 1 else None)
         n_per = int(msg_mb * 1e6 / nbytes)  # message size per stage boundary
         x = jnp.ones((pp, n_per), dtype)
         perm = [(i, (i + 1) % pp) for i in range(pp)]
@@ -167,16 +170,50 @@ def profile_overlap_coe(mesh: Mesh, axes: MeshAxes, size_mb: float = 64.0) -> fl
     return round(max(1.0, float(coe)), 4)
 
 
+def dcn_crossing_keys(world: int, num_slices: int) -> list:
+    """Which "size_consec" allreduce keys cross the slice/DCN boundary under
+    the runtime's slice-major mesh ordering (mesh.build_mesh): the top
+    log2(num_slices) data axes span slices, so every STRIDED (major-axis)
+    group crosses, and a CONSECUTIVE group crosses once it outgrows one
+    slice's extent. (The pp axis is outermost, so with num_slices>1 every
+    p2p degree crosses too.)"""
+    if num_slices <= 1 or world <= 1:
+        return []
+    m = int(np.log2(world))
+    s = int(np.log2(num_slices))
+    out = []
+    for k in range(1, m + 1):
+        if k < m:
+            out.append(f"{2 ** k}_0")  # strided: always on the major axes
+        if k > m - s:
+            out.append(f"{2 ** k}_1")  # consecutive group wider than a slice
+    return out
+
+
 def profile_hardware(
-    msg_mb: float = 64.0, out_path: Optional[str] = None
+    msg_mb: float = 64.0, out_path: Optional[str] = None,
+    num_slices: Optional[int] = None,
 ) -> ProfiledHardware:
-    """Full sweep (reference entry: profile_hardware/profile_hardware.py)."""
-    mesh, axes = build_mesh(pp=1)
+    """Full sweep (reference entry: profile_hardware/profile_hardware.py).
+
+    Pods/multislice recipe (docs/HARDWARE_PROFILING.md): run this once on
+    the target topology (``profile-hardware --num_slices N`` on a DCN-
+    connected deployment; N is auto-detected from device slice indices when
+    omitted). The profiler builds the SAME slice-major mesh the runtime
+    uses, so the (size, consec) groups it times are exactly the axis
+    combinations the search prices — strided/major groups and the pp ring
+    ride the DCN and their measured entries carry the DCN bandwidth, keyed
+    identically. ``dcn_keys`` records which entries crossed the boundary."""
+    mesh, axes = build_mesh(pp=1, num_slices=num_slices)
     world = mesh.devices.size
+    eff_slices = num_slices or len(
+        {getattr(d, "slice_index", 0) for d in np.asarray(mesh.devices).ravel()}
+    )
     hw = ProfiledHardware(
         allreduce_bw=profile_allreduce(mesh, axes, msg_mb),
-        p2p_bw=profile_p2p(world, msg_mb) if world > 1 else {},
+        p2p_bw=profile_p2p(world, msg_mb, num_slices=eff_slices) if world > 1 else {},
         overlap_coe=profile_overlap_coe(mesh, axes, msg_mb) if world > 1 else 1.1,
+        dcn_keys=dcn_crossing_keys(world, eff_slices),
     )
     if out_path:
         from galvatron_tpu.utils.config_utils import save_profiled_hardware

@@ -19,7 +19,7 @@ Parameter sizes are computed analytically from the model config.
 from __future__ import annotations
 
 import time
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -104,7 +104,7 @@ def _mp_of(cfg: ModelConfig) -> str:
 def profile_vocab_costs(
     cfg: ModelConfig,
     bsz: int,
-    vocab_tps=(1, 2, 4, 8),
+    vocab_tps: Optional[Sequence[int]] = None,
     seq: Optional[int] = None,
     iters: int = 4,
 ) -> Tuple[dict, dict, str]:
@@ -125,6 +125,13 @@ def profile_vocab_costs(
     mp = _mp_of(cfg)
     if cfg.enc_layers > 0 or cfg.objective == "cls":
         return {}, {}, mp  # enc-dec / cls 'other' paths keep the analytic model
+    if vocab_tps is None:
+        # every power of two this host can supply — the search consumes the
+        # fit only when ALL degrees its sweep can select are covered
+        # (SearchEngine._vocab_use_measured), so a capped default would
+        # silently disable measured pricing on larger hosts
+        n = len(jax.devices())
+        vocab_tps = [2 ** k for k in range(int(np.log2(n)) + 1)]
     cfg0 = cfg.replace(num_layers=0)
     slope, const = {}, {}
     for vt in vocab_tps:

@@ -124,6 +124,11 @@ class ProfiledHardware:
     allreduce_bw: Dict[str, float] = field(default_factory=dict)  # "size_consec" → GB/s
     p2p_bw: Dict[int, float] = field(default_factory=dict)  # pp degree → GB/s
     overlap_coe: float = 1.1
+    # which allreduce keys (and, with num_slices>1, every p2p degree) were
+    # measured ACROSS the slice/DCN boundary — informational provenance:
+    # entries already carry the boundary in their measured values because the
+    # profiler builds the same slice-major mesh the runtime uses
+    dcn_keys: list = field(default_factory=list)
 
     def fallback_sources(self, pp: int = 1) -> list:
         """Which bandwidth terms would come from built-in defaults rather than

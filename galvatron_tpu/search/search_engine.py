@@ -160,6 +160,18 @@ class SearchEngine:
         lts = self.costs.layer_types
         return lts.get(i, lts[0]) if len(lts) > 1 else lts[0]
 
+    def _vocab_use_measured(self) -> bool:
+        """Consistent vocab pricing across the ENTIRE search: consume the
+        measured fit only when every vocab_tp degree any pp in the sweep can
+        select (all powers of two up to world) is covered — a mixed sweep,
+        whether within one pp or across pps, would bias toward unmeasured
+        degrees (the measured fit carries the batch-independent optimizer
+        const the analytic terms price at zero)."""
+        return all(
+            self.costs.vocab_measurement_for(vt, self.mp) is not None
+            for vt in _pow2s(self.space.world_size)
+        )
+
     def _feasible_strategies(self, pp: int, global_bsz: int, chunks: int):
         """Strategy space under the strict chunk filter: the micro-batch
         (global_bsz / chunks) must split over each strategy's dp axes.
@@ -306,14 +318,7 @@ class SearchEngine:
         dp_cache: Dict[int, tuple] = {}
         best = None  # (total_ms, res, mem_used, vt, et, other_mb)
         pairs = list(_vocab_strategy_pairs(world, pp))
-        # consistent pricing across the sweep: consume measured vocab costs
-        # only when EVERY swept degree is covered — a mixed sweep would bias
-        # toward unmeasured degrees (the measured fit carries the
-        # batch-independent optimizer const the analytic terms price at zero)
-        use_measured = all(
-            self.costs.vocab_measurement_for(vt, self.mp) is not None
-            for vt, _ in pairs
-        )
+        use_measured = self._vocab_use_measured()
         for vt, et in pairs:
             other_mb = other_memory_cost(
                 self.costs, world, pp, vocab_tp=vt, embed_dp_type=et,
@@ -566,7 +571,8 @@ class SearchEngine:
             stage_ms, self._boundary_msg_mb(lt0, global_bsz, chunks), pp, chunks, self.hw
         )
         unrestricted += other_time_cost(
-            self.costs, self.hw, world, pp, vt, et, global_bsz, self.mp
+            self.costs, self.hw, world, pp, vt, et, global_bsz, self.mp,
+            use_measured=self._vocab_use_measured(),
         )
         return {
             "restricted_ms": float(r.cost_ms),
@@ -616,10 +622,7 @@ class SearchEngine:
         # analytic — with the same whole-sweep consistency gate evaluate()
         # applies (a mixed sweep would bias toward unmeasured degrees)
         pairs = list(_vocab_strategy_pairs(world, pp))
-        use_measured = all(
-            self.costs.vocab_measurement_for(vt, self.mp) is not None
-            for vt, _ in pairs
-        )
+        use_measured = self._vocab_use_measured()
         lines.append(
             f"{'vocab strategy':>16} | {'other MB':>9} | {'other ms':>8} | {'src':>8}"
         )

@@ -138,6 +138,7 @@ def save_profiled_hardware(hw: ProfiledHardware, path: str) -> None:
             "allreduce": hw.allreduce_bw,
             "p2p": {str(k): v for k, v in hw.p2p_bw.items()},
             "overlap_coe": hw.overlap_coe,
+            "dcn_keys": list(hw.dcn_keys),
         },
         path,
     )
@@ -149,4 +150,5 @@ def load_profiled_hardware(path: str) -> ProfiledHardware:
         allreduce_bw={str(k): float(v) for k, v in d.get("allreduce", {}).items()},
         p2p_bw={int(k): float(v) for k, v in d.get("p2p", {}).items()},
         overlap_coe=float(d.get("overlap_coe", 1.1)),
+        dcn_keys=list(d.get("dcn_keys", [])),
     )

@@ -181,3 +181,61 @@ def test_vocab_costs_measured_and_consumed(tmp_path):
     assert "measured" in eng.check_cost_model(8)
     loaded.measured_vocab_slope_ms.clear()
     assert "measured" not in eng.check_cost_model(8)
+
+
+def test_multislice_hardware_profile_dcn_keying(tmp_path):
+    """profile-hardware on a multislice topology: the slice-major mesh makes
+    strided groups and the pp ring cross the DCN boundary, measured under the
+    same keys the search prices; dcn_keys records the crossings and the
+    schema round-trips. A search with the measured config (and with the
+    shipped reference 2x8 exemplar) prices pp>1 with no fallbacks."""
+    from galvatron_tpu.profiling.hardware import dcn_crossing_keys, profile_hardware
+    from galvatron_tpu.search.cost_model import ProfiledLayerType, ProfiledModelCosts
+    from galvatron_tpu.utils.config_utils import load_profiled_hardware
+
+    # world 8 as 2 "slices": m=3, s=1 -> strided 2_0/4_0 cross, consec 8_1
+    assert set(dcn_crossing_keys(8, 2)) == {"2_0", "4_0", "8_1"}
+    assert dcn_crossing_keys(8, 1) == []
+    assert set(dcn_crossing_keys(16, 2)) == {"2_0", "4_0", "8_0", "16_1"}
+    hw = profile_hardware(
+        msg_mb=1.0, out_path=str(tmp_path / "hw.json"), num_slices=2
+    )
+    assert hw.allreduce_bw and hw.p2p_bw and set(hw.dcn_keys) == {"2_0", "4_0", "8_1"}
+    loaded = load_profiled_hardware(str(tmp_path / "hw.json"))
+    assert loaded.dcn_keys == hw.dcn_keys and loaded.allreduce_bw == hw.allreduce_bw
+
+    lt = ProfiledLayerType(
+        fwd_ms_per_sample=1.0, parameter_mb=40.0,
+        activation_mb_per_sample={1: 20.0, 2: 10.0, 4: 5.0},
+        boundary_activation_mb_per_sample=2.0,
+    )
+    costs = ProfiledModelCosts(
+        layer_types={0: lt}, other_param_mb=30.0,
+        other_act_mb_per_sample=4.0, other_fwd_ms_per_sample=0.2,
+        hidden_size=64,
+    )
+    eng = SearchEngine(
+        costs, loaded, num_layers=4,
+        space=SearchSpace(world_size=8, pp_choices=[2], max_tp=2),
+        memory_budget_mb=2000.0,
+    )
+    r = eng.evaluate(2, 8, 2, "gpipe")
+    assert r is not None and r.details["fallback_bandwidths"] == []
+
+    # the shipped reference-topology exemplar does the same at world 16
+    import os
+
+    import galvatron_tpu
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(galvatron_tpu.__file__)))
+    ref = load_profiled_hardware(
+        os.path.join(repo_root, "configs", "hardware", "reference_2x8_ib.json")
+    )
+    assert set(ref.dcn_keys) == {"2_0", "4_0", "8_0", "16_1"}
+    eng2 = SearchEngine(
+        costs, ref, num_layers=4,
+        space=SearchSpace(world_size=16, pp_choices=[2], max_tp=2),
+        memory_budget_mb=2000.0,
+    )
+    r2 = eng2.evaluate(2, 16, 2, "gpipe")
+    assert r2 is not None and r2.details["fallback_bandwidths"] == []
