@@ -110,6 +110,7 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             costs, hw, num_layers=cfg.total_layers, space=sspace,
             memory_budget_mb=ns.memory_constraint_gb * 1024.0,
             mixed_precision=ns.mixed_precision,
+            section_pipeline=bool(cfg.swin_depths),
         )
         if ns.check_cost_model:
             bsz = ns.settle_bsz if ns.settle_bsz > 0 else ns.min_bsz

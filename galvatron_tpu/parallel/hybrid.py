@@ -263,11 +263,6 @@ def build_runtime(
     if cfg.enc_layers > 0:
         if any(s.cp > 1 for s in hp.layer_strategies):
             raise ValueError("context parallelism is not supported for enc-dec models")
-    if cfg.swin_depths and hp.pp > 1:
-        raise ValueError(
-            "Swin models run at pp=1 (hierarchical stages have heterogeneous "
-            "layer widths; the SPMD stage stacking needs homogeneous pytrees)"
-        )
     seq_len = seq_len or cfg.sample_len
 
     if cfg.dtype != jnp.float32 and hp.mixed_precision == "fp32":
@@ -284,6 +279,14 @@ def build_runtime(
         scaler_cfg = LossScalerConfig()
 
     if hp.pp > 1:
+        if cfg.swin_depths:
+            from galvatron_tpu.parallel.pipeline_swin import (
+                build_swin_pipeline_runtime,
+            )
+
+            return build_swin_pipeline_runtime(
+                cfg, hp, mesh, axes, adam, global_batch_size, seq_len
+            )
         if cfg.enc_layers > 0:
             from galvatron_tpu.parallel.pipeline_encdec import (
                 build_encdec_pipeline_runtime,

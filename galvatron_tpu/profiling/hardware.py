@@ -209,6 +209,12 @@ def profile_hardware(
     eff_slices = num_slices or len(
         {getattr(d, "slice_index", 0) for d in np.asarray(mesh.devices).ravel()}
     )
+    # mirror build_mesh's inference guard: it only slice-major-orders clean
+    # binary factors, so anything else must be treated as one slice here too
+    # (a 3-slice detection would otherwise crash the p2p mesh build and
+    # mislabel dcn_keys)
+    if eff_slices < 1 or eff_slices & (eff_slices - 1) or world % eff_slices:
+        eff_slices = 1
     hw = ProfiledHardware(
         allreduce_bw=profile_allreduce(mesh, axes, msg_mb),
         p2p_bw=profile_p2p(world, msg_mb, num_slices=eff_slices) if world > 1 else {},

@@ -147,6 +147,7 @@ class SearchEngine:
         memory_budget_mb: float,
         mixed_precision: str = "bf16",
         mem_unit_mb: float = 8.0,
+        section_pipeline: bool = False,
     ):
         self.costs = model_costs
         self.hw = hardware
@@ -155,6 +156,11 @@ class SearchEngine:
         self.budget_mb = memory_budget_mb
         self.mp = mixed_precision
         self.unit = mem_unit_mb
+        # True = multi-type groups are a vision pyramid (pipeline_swin's
+        # K-section pair-stacked engine) even at K=2 — a 2-stage Swin profile
+        # is otherwise indistinguishable from an enc-dec one (the CLI sets
+        # this from cfg.swin_depths)
+        self.section_pipeline = section_pipeline
 
     def _layer_type(self, i: int) -> ProfiledLayerType:
         lts = self.costs.layer_types
@@ -163,13 +169,14 @@ class SearchEngine:
     def _vocab_use_measured(self) -> bool:
         """Consistent vocab pricing across the ENTIRE search: consume the
         measured fit only when every vocab_tp degree any pp in the sweep can
-        select (all powers of two up to world) is covered — a mixed sweep,
-        whether within one pp or across pps, would bias toward unmeasured
-        degrees (the measured fit carries the batch-independent optimizer
-        const the analytic terms price at zero)."""
+        select (powers of two up to world // min(pp)) is covered — a mixed
+        sweep, whether within one pp or across pps, would bias toward
+        unmeasured degrees (the measured fit carries the batch-independent
+        optimizer const the analytic terms price at zero)."""
+        min_pp = min(self.space.pp_choices) if self.space.pp_choices else 1
         return all(
             self.costs.vocab_measurement_for(vt, self.mp) is not None
-            for vt in _pow2s(self.space.world_size)
+            for vt in _pow2s(self.space.world_size // min_pp)
         )
 
     def _feasible_strategies(self, pp: int, global_bsz: int, chunks: int):
@@ -226,23 +233,25 @@ class SearchEngine:
         if world % pp or self.L < pp:
             return None
         multi_type = None  # (n_first, n_second) for a 2-group pp>1 pipeline
+        swin_groups = None  # [(count, layer_type)] for a K>2-section pipeline
         if pp > 1 and len(self.costs.layer_types) > 1:
-            # heterogeneous layer types: the enc-dec pipeline (two coupled
-            # sub-pipelines, parallel/pipeline_encdec.py) handles TWO
-            # contiguous type groups — ragged counts via per-sub-stack padded
-            # divisions — gpipe-ordered, chunks % pp == 0 (the reference's
-            # multi-layer-type DP, dynamic_programming.py:304-455, served the
-            # same model class). Swin pyramids (>2 groups) stay pp=1.
+            # heterogeneous layer types (the reference's multi-layer-type DP,
+            # dynamic_programming.py:304-455): TWO contiguous groups ride the
+            # enc-dec coupled sub-pipelines (parallel/pipeline_encdec.py,
+            # ragged counts via per-sub-stack padded divisions); K>2 groups
+            # with even counts ride the K-section pair-stacked pipeline
+            # (parallel/pipeline_swin.py). Both gpipe-ordered, chunks % pp.
             groups = self._type_groups()
-            if (
-                len(groups) != 2
-                or any(cnt < pp for _, cnt, _ in groups)
-                or chunks % pp
-                or vpp > 1
-                or pipeline_type != "gpipe"
-            ):
+            if chunks % pp or vpp > 1 or pipeline_type != "gpipe":
                 return None
-            multi_type = (groups[0][1], groups[1][1])
+            if len(groups) == 2 and not self.section_pipeline:
+                if any(cnt < pp for _, cnt, _ in groups):
+                    return None
+                multi_type = (groups[0][1], groups[1][1])
+            elif all(cnt % 2 == 0 for _, cnt, _ in groups):
+                swin_groups = [(cnt, lt) for _, cnt, lt in groups]
+            else:
+                return None
         if global_bsz % chunks:
             return None
         if vpp > 1:
@@ -258,10 +267,11 @@ class SearchEngine:
         # realizes it with padded stage stacking (pipeline.stage_layout)
         lps = -(-self.L // pp)  # positions per stage = max(division)
         division: Optional[List[int]] = None
-        if pp > 1 and self.L % pp:
-            # single layer type here (heterogeneous types return None above),
-            # and the balanced division is scale-invariant over uniform
-            # memories — unit weights give the same split as any baseline cost
+        if pp > 1 and self.L % pp and multi_type is None and swin_groups is None:
+            # single layer type here (multi-type paths carry their own
+            # per-section divisions), and the balanced division is
+            # scale-invariant over uniform memories — unit weights give the
+            # same split as any baseline cost
             division = pp_division_memory_balanced([1.0] * self.L, pp)
             lps = max(division)
         cands = self._feasible_strategies(pp, global_bsz, chunks)
@@ -275,6 +285,7 @@ class SearchEngine:
         # stages, stage 0 carries the 1F1B worst case. Multi-type (enc-dec)
         # pp>1: a device holds one virtual stage of EACH type, so positions =
         # lpe enc positions followed by lpd dec positions.
+        pos_layers = 1  # layers per searched position (2 for swin pairs)
         if multi_type is not None:
             # padded sub-stacks: positions per stack = ceil(count / pp); both
             # stacks place remainders by the same stage order
@@ -285,6 +296,18 @@ class SearchEngine:
             pos_lt = lambda j: (
                 self._layer_type(0) if j < lpe else self._layer_type(multi_type[0])
             )
+        elif swin_groups is not None:
+            # pair-stacked sections (pipeline_swin.SwinLayout): positions per
+            # section = max of the pair spread; the same _spread_pairs the
+            # runtime uses, so emitted strategies land on the right layers
+            from galvatron_tpu.parallel.pipeline_swin import _spread_pairs
+
+            pos_layers = 2
+            sec_div = [_spread_pairs(cnt // 2, pp) for cnt, _ in swin_groups]
+            sec_lp = [max(dv) for dv in sec_div]
+            n_pos = sum(sec_lp)
+            pos_sec = [k for k, lp in enumerate(sec_lp) for _ in range(lp)]
+            pos_lt = lambda j: swin_groups[pos_sec[j]][1]
         else:
             n_pos = self.L if pp == 1 else lps // vpp
             pos_lt = self._layer_type
@@ -298,9 +321,12 @@ class SearchEngine:
                     pipeline_type=pipeline_type, mixed_precision=self.mp,
                     vpp=vpp,
                 )
-                # a device holds vpp layers per searched position (interleaved)
-                mem[j, k] = max(1, int(np.ceil(vpp * mc.total_mb / self.unit)))
-                intra[j, k] = layer_time_cost(
+                # a device holds vpp layers per searched position
+                # (interleaved) or 2 (swin pairs)
+                mem[j, k] = max(
+                    1, int(np.ceil(pos_layers * vpp * mc.total_mb / self.unit))
+                )
+                intra[j, k] = pos_layers * layer_time_cost(
                     lt, s, self.hw, world, pp, global_bsz, mixed_precision=self.mp
                 )
         lt0 = self._layer_type(0)
@@ -356,6 +382,17 @@ class SearchEngine:
                     p2p_mb = (2.0 * enc_b + dec_b) * (global_bsz / chunks) * bf
                     p2p_ms = p2p_mb / self.hw.p2p(pp)
                     total_ms = (chunks + 2 * pp - 1) * (per_stage_ms + p2p_ms)
+                elif swin_groups is not None:
+                    # K coupled sections (pipeline_swin.py): every tick runs
+                    # one virtual stage of EVERY section; chunks + K·pp - 1
+                    # ticks; each section's output rides its own ring ppermute
+                    bf = 0.5 if self.mp in ("bf16", "fp16") else 1.0
+                    Kg = len(swin_groups)
+                    p2p_mb = sum(
+                        lt.boundary_activation_mb_per_sample for _, lt in swin_groups
+                    ) * (global_bsz / chunks) * bf
+                    p2p_ms = p2p_mb / self.hw.p2p(pp)
+                    total_ms = (chunks + Kg * pp - 1) * (per_stage_ms + p2p_ms)
                 else:
                     total_ms = pipeline_time_cost(
                         [per_stage_ms] * pp,
@@ -389,6 +426,17 @@ class SearchEngine:
                     enc_chosen[q] for s in range(pp) for q in range(div_e[s])
                 ] + [dec_chosen[q] for s in range(pp) for q in range(div_d[s])]
                 division = div_e + div_d  # the 2*pp enc-dec layout
+            elif swin_groups is not None:
+                # per-layer strategies in the runtime's pair layout: section-
+                # major, stage-major within a section, two layers per pair
+                layer_strategies = []
+                base = 0
+                for k in range(len(swin_groups)):
+                    sec_chosen = chosen[base:base + sec_lp[k]]
+                    for s in range(pp):
+                        for q in range(sec_div[k][s]):
+                            layer_strategies += [sec_chosen[q], sec_chosen[q]]
+                    base += sec_lp[k]
             elif division is not None:
                 layer_strategies = [
                     chosen[j] for s in range(pp) for j in range(division[s])

@@ -183,6 +183,7 @@ def test_vocab_costs_measured_and_consumed(tmp_path):
     assert "measured" not in eng.check_cost_model(8)
 
 
+@pytest.mark.slow  # full hardware sweep on the sim, like test_hardware_profile_schema
 def test_multislice_hardware_profile_dcn_keying(tmp_path):
     """profile-hardware on a multislice topology: the slice-major mesh makes
     strided groups and the pp ring cross the DCN boundary, measured under the

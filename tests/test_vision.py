@@ -168,10 +168,73 @@ def test_swin_loss_parity(swin_ref, name):
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
 
-def test_swin_rejects_pipeline():
+@pytest.mark.parametrize("pp,tp", [(2, 1), (2, 2)])
+def test_swin_pp2_parity(swin_ref, pp, tp):
+    """Swin pp>1: K coupled sections over the pp ring (pair-stacked stages).
+    The pipeline must reproduce the flat pp=1 loss on identical weights and
+    track the reference trajectory; flatten drops padding exactly."""
+    batches, ref_traj = swin_ref
+    hp = HybridParallelConfig.uniform(
+        4, pp=pp, tp=tp, chunks=2, vocab_tp=tp, mixed_precision="fp32"
+    )
+    rt = build_runtime(SWIN_CFG, hp, adam=ADAM, global_batch_size=8)
+    flat = modeling.init_model_params(jax.random.key(0), SWIN_CFG)
+    state = rt.init_state_from(flat)
+    losses = []
+    for b in batches:
+        state, loss = rt.train_step(state, b)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, ref_traj, rtol=2e-4, atol=2e-4)
+    flat2 = rt.flatten_params(state["params"])
+    assert len(flat2["layers"]) == 4 and all(l is not None for l in flat2["layers"])
+
+
+def test_swin_pp4_zero_pair_stages_and_three_sections(swin_ref):
+    """pp wider than a section's pair count leaves zero-pair (masked) stages;
+    a 3-section pyramid exercises K>2 coupled sections. Both must match the
+    flat loss on identical weights."""
+    batches, _ = swin_ref
+    # pp=4 on the 2-pair pyramid: two sections of 1 pair each -> 3 idle
+    # stages per section
+    hp4 = HybridParallelConfig.uniform(4, pp=4, chunks=4, mixed_precision="fp32")
+    rt4 = build_runtime(SWIN_CFG, hp4, adam=ADAM, global_batch_size=8)
+    flat = modeling.init_model_params(jax.random.key(0), SWIN_CFG)
+    s4 = rt4.init_state_from(flat)
+    ref = float(jax.jit(lambda p, b: modeling.lm_loss(p, b, SWIN_CFG))(flat, batches[0]))
+    np.testing.assert_allclose(
+        float(rt4.eval_loss(s4, batches[0])), ref, rtol=3e-5, atol=3e-5
+    )
+    # K=3 sections
+    cfg3 = SWIN_CFG.replace(num_layers=6, swin_depths=(2, 2, 2))
+    b3 = make_batches(cfg3, seed=3, n=1)[0]
+    hp3 = HybridParallelConfig.uniform(6, pp=2, chunks=2, mixed_precision="fp32")
+    rt3 = build_runtime(cfg3, hp3, adam=ADAM, global_batch_size=8)
+    flat3 = modeling.init_model_params(jax.random.key(1), cfg3)
+    s3 = rt3.init_state_from(flat3)
+    ref3 = float(jax.jit(lambda p, b: modeling.lm_loss(p, b, cfg3))(flat3, b3))
+    np.testing.assert_allclose(
+        float(rt3.eval_loss(s3, b3)), ref3, rtol=3e-5, atol=3e-5
+    )
+    s3, l3 = rt3.train_step(s3, b3)
+    assert np.isfinite(float(l3))
+
+
+def test_swin_pipeline_constraints():
+    # odd depths cannot pair-stack
+    cfg_odd = SWIN_CFG.replace(num_layers=4, swin_depths=(1, 3))
     hp = HybridParallelConfig.uniform(4, pp=2, chunks=2, mixed_precision="fp32")
-    with pytest.raises(ValueError, match="pp=1"):
-        build_runtime(SWIN_CFG, hp, adam=ADAM, global_batch_size=8)
+    with pytest.raises(ValueError, match="even"):
+        build_runtime(cfg_odd, hp, adam=ADAM, global_batch_size=8)
+    # pair halves must share a strategy
+    hp_bad = HybridParallelConfig(
+        pp=2, chunks=2, mixed_precision="fp32",
+        layer_strategies=[
+            LayerStrategy(tp=1), LayerStrategy(tp=2),
+            LayerStrategy(tp=1), LayerStrategy(tp=2),
+        ],
+    )
+    with pytest.raises(ValueError, match="pair"):
+        build_runtime(SWIN_CFG, hp_bad, adam=ADAM, global_batch_size=8)
 
 
 def test_swin_shift_mask_blocks_wrapped_pairs():
@@ -272,3 +335,49 @@ def test_vit_preset_shapes():
     assert swin.num_layers == sum(swin.swin_depths)
     ps = jax.eval_shape(lambda k: modeling.init_model_params(k, swin), jax.random.key(0))
     assert ps["head"]["w"].shape == (128 * 8, 1000)  # C·2^3 after 3 merges
+
+
+def test_swin_search_emits_pp2_and_runtime_trains():
+    """The multi-type search emits a pp=2 config for a Swin pyramid
+    (section_pipeline=True routes even 2-group profiles to the K-section
+    pair-stacked engine) and the config builds + trains."""
+    from galvatron_tpu.search.cost_model import (
+        ProfiledHardware,
+        ProfiledLayerType,
+        ProfiledModelCosts,
+    )
+    from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+
+    lt0 = ProfiledLayerType(
+        fwd_ms_per_sample=1.0, parameter_mb=10.0,
+        activation_mb_per_sample={1: 8.0, 2: 4.0}, boundary_activation_mb_per_sample=1.0,
+    )
+    lt1 = ProfiledLayerType(
+        fwd_ms_per_sample=1.5, parameter_mb=30.0,
+        activation_mb_per_sample={1: 6.0, 2: 3.0}, boundary_activation_mb_per_sample=0.5,
+    )
+    costs = ProfiledModelCosts(
+        layer_types={0: lt0, 1: lt0, 2: lt1, 3: lt1},
+        other_param_mb=5.0, other_act_mb_per_sample=1.0,
+        other_fwd_ms_per_sample=0.1,
+    )
+    hw = ProfiledHardware(
+        allreduce_bw={"2_1": 150.0, "2_0": 30.0, "4_1": 140.0, "8_1": 120.0},
+        p2p_bw={2: 50.0}, overlap_coe=1.1,
+    )
+    eng = SearchEngine(
+        costs, hw, num_layers=4,
+        space=SearchSpace(world_size=8, pp_choices=[2], max_tp=2),
+        memory_budget_mb=600.0, section_pipeline=True,
+    )
+    res = eng.search([8])
+    assert res is not None and res.config.pp == 2
+    ls = res.config.layer_strategies
+    assert len(ls) == 4
+    # pair layout: layers 0/1 (stage-0 pair) and 2/3 share strategies
+    assert ls[0] == ls[1] and ls[2] == ls[3]
+    rt = build_runtime(SWIN_CFG, res.config, adam=ADAM, global_batch_size=8)
+    state = rt.init_state(jax.random.key(0))
+    b = make_batches(SWIN_CFG, seed=5, n=1)[0]
+    state, loss = rt.train_step(state, b)
+    assert np.isfinite(float(loss))
