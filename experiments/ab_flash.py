@@ -909,9 +909,27 @@ def make_window_attnblock(attn_impl_fn, num_layers, bsz=8, seq=2048, iters=6):
     return run
 
 
+def attn_block_qkvstack(x, p, cfg, cos_sin=None, alibi=None, remat_attn=False):
+    """Head-major wiring with the STACKED qkv fed straight to the kernels
+    (no q/k/v slice copies) — calls the production ops entry. NOTE: since
+    this landed as the production default, "hmprod" routes through the same
+    path; compare against historical commits, not hmprod."""
+    b, s, h = x.shape
+    hd = cfg.head_dim
+    n = cfg.num_heads
+    w = p["wqkv"].astype(x.dtype)
+    qkv = jnp.einsum("bsh,hcnd->bcnsd", x, w.reshape(h, 3, n, hd))
+    o = fa.flash_attention_qkv(qkv, rope=cos_sin)
+    return jnp.einsum("bnsd,nde->bse", o, p["wo"].astype(x.dtype).reshape(n, hd, h))
+
+
 # "hmprod" is the real production attn_block (head-major gate active) —
 # compare kernel variants against it, not against "base"
-ATTN_VARIANTS = {"xlahm": attn_block_xlahm, "hmprod": _ATTN_BLOCK_ORIG}
+ATTN_VARIANTS = {
+    "xlahm": attn_block_xlahm,
+    "hmprod": _ATTN_BLOCK_ORIG,
+    "qkvstack": attn_block_qkvstack,
+}
 
 
 def main():

@@ -675,7 +675,11 @@ def _attn_block_headmajor(x, p, cfg: ModelConfig, rope, remat_attn: bool):
     inside the GEMMs instead of materializing reshape+transpose copies
     between the projection and the kernels (~0.32 ms/layer/sample on the
     v5e 7B-shape bench; the copies were ~2.9 ms/layer-batch in the trace)."""
-    from galvatron_tpu.ops.flash_attention import flash_attention_hm
+    from galvatron_tpu.ops.flash_attention import (
+        flash_attention_hm,
+        flash_attention_qkv,
+        flash_qkv_supported,
+    )
 
     b, s, h = x.shape
     hd = cfg.head_dim
@@ -685,6 +689,21 @@ def _attn_block_headmajor(x, p, cfg: ModelConfig, rope, remat_attn: bool):
         qkv = jnp.einsum("bsh,hcnd->bcnsd", x, w.reshape(h, 3, n, hd))
         if "wqkv_b" in p:
             qkv = qkv + p["wqkv_b"].astype(x.dtype).reshape(3, n, hd)[None, :, :, None, :]
+        if flash_qkv_supported(s, hd, cfg.causal, rope):
+            # the kernels consume the STACKED projection output directly —
+            # index-mapped block specs instead of q/k/v slice copies
+            def core_qkv(qkv_):
+                return flash_attention_qkv(qkv_, rope=rope)
+
+            if remat_attn:
+                core_qkv = jax.checkpoint(core_qkv)
+            o = _constrain_attn_out(core_qkv(qkv), cfg)
+            y = jnp.einsum(
+                "bnsd,nde->bse", o, p["wo"].astype(x.dtype).reshape(n, hd, h)
+            )
+            if "wo_b" in p:
+                y = y + p["wo_b"].astype(x.dtype)
+            return y
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
     else:
         kv, group = qkv_dims(cfg)

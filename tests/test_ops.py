@@ -155,6 +155,45 @@ def test_headmajor_attn_block_matches_legacy_path():
         )
 
 
+def test_flash_qkv_stacked_matches_reference():
+    """The stacked-qkv entry (flash_attention_qkv: kernels consume the fused
+    projection's (b, 3, h, s, d) output via index-mapped block specs) is the
+    default production path for blocked MHA — pin forward AND gradients
+    (its custom VJP slices the stacked residual into the grid backward and
+    restacks dq/dk/dv) against the materialized-rope reference."""
+    from galvatron_tpu.ops.flash_attention import (
+        flash_attention_qkv,
+        flash_qkv_supported,
+    )
+
+    s, d = 128, 32
+    q, k, v = rand_qkv(jax.random.key(8), s=s, d=d)
+    cos, sin = _rope_tables(s, d)
+    assert flash_qkv_supported(s, d, True, (cos, sin))
+    # (b, s, n, d) triple -> stacked (b, 3, n, s, d) head-major
+    qkv = jnp.stack(
+        [jnp.transpose(t, (0, 2, 1, 3)) for t in (q, k, v)], axis=1
+    )
+
+    def f_stacked(qkv_):
+        out = flash_attention_qkv(qkv_, rope=(cos, sin), block_q=32)
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    def f_ref(q_, k_, v_):
+        qr = modeling.apply_rope(q_, cos, sin)
+        kr = modeling.apply_rope(k_, cos, sin)
+        return (ref_attention(qr, kr, v_) ** 2).sum()
+
+    np.testing.assert_allclose(float(f_stacked(qkv)), float(f_ref(q, k, v)), rtol=2e-5)
+    dqkv = jax.grad(f_stacked)(qkv)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for c, g in enumerate(g_ref):
+        np.testing.assert_allclose(
+            np.asarray(jnp.transpose(dqkv[:, c], (0, 2, 1, 3))), np.asarray(g),
+            rtol=5e-4, atol=5e-4, err_msg=f"slot {c}",
+        )
+
+
 def test_flash_fallback_preserves_causal_and_scale():
     """The untileable-shape fallback must honor causal=False (encoder models)
     and a caller-supplied sm_scale — regression: it used to rebuild a default
