@@ -966,5 +966,22 @@ def main():
         print(f"{nm}: median {np.median(results[nm]):.4f}  all={['%.4f' % x for x in results[nm]]}")
 
 
+def make_attn_qkvstack_block(block):
+    def attn(x, p, cfg, cos_sin=None, alibi=None, remat_attn=False):
+        b, s, h = x.shape
+        hd = cfg.head_dim
+        n = cfg.num_heads
+        w = p["wqkv"].astype(x.dtype)
+        qkv = jnp.einsum("bsh,hcnd->bcnsd", x, w.reshape(h, 3, n, hd))
+        o = fa.flash_attention_qkv(qkv, rope=cos_sin, block_q=block)
+        return jnp.einsum("bnsd,nde->bse", o, p["wo"].astype(x.dtype).reshape(n, hd, h))
+
+    return attn
+
+
+ATTN_VARIANTS["qkvstack512"] = make_attn_qkvstack_block(512)
+ATTN_VARIANTS["qkvstack2048"] = make_attn_qkvstack_block(2048)
+
+
 if __name__ == "__main__":
     main()
