@@ -168,7 +168,10 @@ def test_swin_loss_parity(swin_ref, name):
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.parametrize("pp,tp", [(2, 1), (2, 2)])
+@pytest.mark.parametrize(
+    "pp,tp",
+    [(2, 1), pytest.param(2, 2, marks=pytest.mark.slow)],
+)
 def test_swin_pp2_parity(swin_ref, pp, tp):
     """Swin pp>1: K coupled sections over the pp ring (pair-stacked stages).
     The pipeline must reproduce the flat pp=1 loss on identical weights and
@@ -189,6 +192,7 @@ def test_swin_pp2_parity(swin_ref, pp, tp):
     assert len(flat2["layers"]) == 4 and all(l is not None for l in flat2["layers"])
 
 
+@pytest.mark.slow  # edge coverage; the pp=2 parity + constraints stay default
 def test_swin_pp4_zero_pair_stages_and_three_sections(swin_ref):
     """pp wider than a section's pair count leaves zero-pair (masked) stages;
     a 3-section pyramid exercises K>2 coupled sections. Both must match the
