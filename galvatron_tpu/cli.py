@@ -126,10 +126,20 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             if ns.bsz_scale < 2:
                 print(f"error: --bsz_scale must be >= 2, got {ns.bsz_scale}")
                 return 2
+            rec = 0
+            if ns.recommend_min_bsz:
+                # PRUNE the grid (drop points below the recommendation) —
+                # shifting its anchor would skip points ABOVE it too
+                rec = min(eng.recommend_min_bsz(), ns.max_bsz)
+                if rec > ns.min_bsz:
+                    print(f"recommend_min_bsz: pruning sweep below {rec}")
             bszs, b = [], ns.min_bsz
             while b <= ns.max_bsz:
-                bszs.append(b)
+                if b >= rec:
+                    bszs.append(b)
                 b *= ns.bsz_scale
+            if not bszs:
+                bszs = [ns.max_bsz]  # rec sat between the last grid point and the cap
         if ns.validate_top_k > 0:
             # one sweep serves both the saved result and the validation
             # candidates (search_topk ranks by predicted throughput, same

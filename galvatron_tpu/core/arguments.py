@@ -136,6 +136,10 @@ def _add_search_args(p: argparse.ArgumentParser):
     g.add_argument("--max_bsz", type=int, default=64)
     g.add_argument("--bsz_scale", type=int, default=2)
     g.add_argument("--settle_bsz", type=int, default=-1, help="search exactly this bsz")
+    g.add_argument("--recommend_min_bsz", type=int, default=0,
+                   help="1 = raise the sweep's min bsz to 65%% of the "
+                   "pure-strategy baselines' max feasible batch (reference "
+                   "recommend_min_bsz pruning — pure search-time saving)")
     g.add_argument("--max_chunks", type=int, default=64)
     g.add_argument("--search_space", type=str, default="full",
                    choices=["full", "dp+tp", "dp+pp", "3d", "dp", "tp", "pp", "sdp"])

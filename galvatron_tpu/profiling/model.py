@@ -296,7 +296,11 @@ def profile_model(
         other_fwd_ms_per_sample=float(other_ms),
         hidden_size=cfg.hidden_size,
     )
-    if measure_time:
+    # vocab measurement costs ~2 jitted builds per feasible vocab_tp — worth
+    # it on real hardware, but on the CPU simulation the numbers are
+    # synthetic (like the hardware profiler's) and the compiles are slow, so
+    # it defaults off there; call profile_vocab_costs directly to force
+    if measure_time and jax.default_backend() != "cpu":
         vslope, vconst, vmp = profile_vocab_costs(cfg, bsz, seq=seq)
         costs.measured_vocab_slope_ms = vslope
         costs.measured_vocab_const_ms = vconst

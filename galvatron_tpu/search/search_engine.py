@@ -552,6 +552,60 @@ class SearchEngine:
             )
         return best
 
+    def recommend_min_bsz(self, scale: int = 8) -> int:
+        """Prune sweep batch sizes that are search-time waste (reference:
+        recommend_min_bsz, search_engine.py:257-276): pure-strategy baselines
+        (dp / ZeRO-3 / full-tp at pp=1) each have a maximum feasible global
+        batch under the memory budget; throughput rises with bsz until
+        memory binds, so the sweep starts 65% of the way from the smallest
+        to the largest baseline maximum. Returns a lower bound for the
+        caller's min_bsz (``scale`` when nothing is feasible — the sweep
+        itself then reports infeasibility)."""
+        world = self.space.world_size
+        baselines = [LayerStrategy(), LayerStrategy(dp_type="zero3")]
+        tp = min(world, self.space.max_tp or world)
+        if tp > 1:
+            baselines.append(LayerStrategy(tp=tp))
+
+        groups = self._type_groups()  # type-aware: price every layer type
+
+        def feasible(s: LayerStrategy, bsz: int) -> bool:
+            mem = sum(
+                cnt
+                * layer_memory_cost(
+                    lt, s, world, 1, bsz, 1, mixed_precision=self.mp
+                ).total_mb
+                for _, cnt, lt in groups
+            )
+            other = other_memory_cost(
+                self.costs, world, 1, vocab_tp=1, embed_dp_type="ddp",
+                global_bsz=bsz, chunks=1, mixed_precision=self.mp,
+            )
+            return mem + other <= self.budget_mb
+
+        def max_feasible(s: LayerStrategy) -> int:
+            # memory is monotone in bsz: geometric probe for an infeasible
+            # upper bound, then bisect to `scale` granularity (~40 cost-model
+            # evaluations instead of a linear scan)
+            if not feasible(s, scale):
+                return 0
+            lo, hi = scale, 2 * scale
+            while hi <= (1 << 20) and feasible(s, hi):
+                lo, hi = hi, 2 * hi
+            while hi - lo > scale:
+                mid = (lo + hi) // 2 // scale * scale
+                if mid in (lo, hi):
+                    break
+                lo, hi = (mid, hi) if feasible(s, mid) else (lo, mid)
+            return lo
+
+        vals = [max_feasible(s) for s in baselines]
+        if not any(vals):
+            return scale
+        lo, hi = min(vals), max(vals)
+        start = int((lo * 0.35 + hi * 0.65) // scale * scale)
+        return max(start, scale)
+
     def homogeneity_gap(
         self, pp: int, global_bsz: int, chunks: int,
         pipeline_type: str = "pipedream_flush",

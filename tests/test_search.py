@@ -370,3 +370,43 @@ def test_homogeneity_gap_reference_shaped():
             saw_gap_band = True  # later stages DID pick different strategies
     # the binding band (11GB) exercises genuinely different per-stage choices
     assert saw_gap_band
+
+
+def test_recommend_min_bsz_prunes_sweep():
+    """The bsz-sweep pruning (reference recommend_min_bsz): pure-strategy
+    baselines bound the feasible batch range; the recommended start sits
+    inside it, scales down with the budget, and degrades to `scale` when
+    nothing fits."""
+    from galvatron_tpu.search.cost_model import (
+        ProfiledHardware,
+        ProfiledLayerType,
+        ProfiledModelCosts,
+    )
+
+    lt = ProfiledLayerType(
+        fwd_ms_per_sample=1.0, parameter_mb=40.0,
+        activation_mb_per_sample={1: 20.0, 2: 10.0, 4: 5.0, 8: 2.5},
+        boundary_activation_mb_per_sample=2.0,
+    )
+    costs = ProfiledModelCosts(
+        layer_types={0: lt}, other_param_mb=30.0,
+        other_act_mb_per_sample=4.0, other_fwd_ms_per_sample=0.2,
+    )
+    hw = ProfiledHardware(allreduce_bw={"8_1": 120.0})
+
+    def eng(budget_mb):
+        return SearchEngine(
+            costs, hw, num_layers=4,
+            space=SearchSpace(world_size=8, pp_choices=[1]),
+            memory_budget_mb=budget_mb,
+        )
+
+    rec_big = eng(4000.0).recommend_min_bsz(scale=8)
+    rec_small = eng(900.0).recommend_min_bsz(scale=8)
+    assert rec_big > rec_small >= 8
+    assert rec_big % 8 == 0
+    # a sweep starting at the recommendation still finds the optimum region
+    res = eng(4000.0).search([rec_big])
+    assert res is not None
+    # nothing feasible -> degrade to scale (the sweep reports infeasibility)
+    assert eng(1.0).recommend_min_bsz(scale=8) == 8
