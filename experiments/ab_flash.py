@@ -741,6 +741,155 @@ def flash_ident(q, k, v, **_):
     return q
 
 
+# ---------------------------------------------------------------------------
+# v5: ONE pallas call per (b, h) — k-outer / all-q-chains-live structure with
+# hand-rolled double-buffered HBM→VMEM DMA of k/v blocks (the emit_pipeline
+# idea, but with a statically unrolled k loop so the causal specialization
+# stays static). Removes: per-q-block invocation overhead, the output
+# concatenate, and (nq(nq+1)/2 - nq) redundant k-block ropes. ``interleave``
+# orders both chains' dots before both softmaxes per step to give Mosaic
+# adjacent independent MXU/VPU ops.
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel_v5(*refs, nq, block, interleave):
+    (q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref, tri_ref,
+     o_ref, lse_ref, k_buf, v_buf, sems) = refs
+    b_idx = pl.program_id(0)
+    h_idx = pl.program_id(1)
+
+    def k_dma(j, slot):
+        return pltpu.make_async_copy(
+            k_ref.at[b_idx, h_idx, pl.ds(j * block, block), :],
+            k_buf.at[slot], sems.at[slot, 0],
+        )
+
+    def v_dma(j, slot):
+        return pltpu.make_async_copy(
+            v_ref.at[b_idx, h_idx, pl.ds(j * block, block), :],
+            v_buf.at[slot], sems.at[slot, 1],
+        )
+
+    k_dma(0, 0).start()
+    v_dma(0, 0).start()
+    # rope all q chains once (scale folded into cq/sq)
+    qs = [
+        _rope_rows(
+            q_ref[0, 0, i * block:(i + 1) * block],
+            cq_ref[i * block:(i + 1) * block],
+            sq_ref[i * block:(i + 1) * block],
+        ).astype(q_ref.dtype)
+        for i in range(nq)
+    ]
+    m = [None] * nq
+    l = [None] * nq
+    acc = [None] * nq
+    for j in range(nq):
+        slot = j % 2
+        if j + 1 < nq:
+            k_dma(j + 1, (j + 1) % 2).start()
+            v_dma(j + 1, (j + 1) % 2).start()
+        k_dma(j, slot).wait()
+        v_dma(j, slot).wait()
+        kj = _rope_rows(
+            k_buf[slot],
+            ck_ref[j * block:(j + 1) * block],
+            sk_ref[j * block:(j + 1) * block],
+        ).astype(k_buf.dtype)
+        vj = v_buf[slot]
+        chains = list(range(j, nq))  # causal: chain i sees k block j iff j <= i
+
+        def score(i):
+            s = jax.lax.dot_general(
+                qs[i], kj, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if i == j:  # diagonal block
+                s = s + tri_ref[...].astype(jnp.float32)
+            return s
+
+        def update(i, s):
+            if m[i] is None:
+                m[i] = jnp.max(s, axis=1, keepdims=True)
+                p = jnp.exp2(s - m[i])
+                l[i] = jnp.sum(p, axis=1, keepdims=True)
+                acc[i] = jax.lax.dot(
+                    p.astype(vj.dtype), vj, preferred_element_type=jnp.float32
+                )
+            else:
+                m_new = jnp.maximum(m[i], jnp.max(s, axis=1, keepdims=True))
+                p = jnp.exp2(s - m_new)
+                alpha = jnp.exp2(m[i] - m_new)
+                l[i] = alpha * l[i] + jnp.sum(p, axis=1, keepdims=True)
+                acc[i] = alpha * acc[i] + jax.lax.dot(
+                    p.astype(vj.dtype), vj, preferred_element_type=jnp.float32
+                )
+                m[i] = m_new
+
+        if interleave:
+            ss = {i: score(i) for i in chains}
+            for i in chains:
+                update(i, ss[i])
+        else:
+            for i in chains:
+                update(i, score(i))
+    for i in range(nq):
+        o_ref[0, 0, i * block:(i + 1) * block] = (
+            acc[i] / jnp.maximum(l[i], 1e-30)
+        ).astype(o_ref.dtype)
+        lse_ref[0, 0, i * block:(i + 1) * block] = (
+            m[i] * LN2 + jnp.log(jnp.maximum(l[i], 1e-30))
+        ).astype(jnp.float32)
+
+
+def make_flash_v5(block=1024, interleave=False):
+    def flash_v5(q, k, v, causal=True, sm_scale=None, rope=None, **_):
+        b, s, n, d = q.shape
+        if sm_scale is None:
+            sm_scale = 1.0 / float(np.sqrt(d))
+        assert rope is not None and causal and s % block == 0
+        qt = jnp.transpose(q, (0, 2, 1, 3))
+        kt = jnp.transpose(k, (0, 2, 1, 3))
+        vt = jnp.transpose(v, (0, 2, 1, 3))
+        nq = s // block
+        lam = sm_scale * LOG2E
+        cos, sin = rope
+        cqs, sqs = cos * lam, sin * lam
+        r = np.arange(block)
+        tri = jnp.asarray(np.where(r[:, None] >= r[None, :], 0.0, NEG_INF), jnp.bfloat16)
+        rows = pl.BlockSpec((s, d // 2), lambda b_, h_: (0, 0))
+        out, _lse = pl.pallas_call(
+            functools.partial(_fwd_kernel_v5, nq=nq, block=block, interleave=interleave),
+            grid=(b, n),
+            in_specs=[
+                pl.BlockSpec((1, 1, s, d), lambda b_, h_: (b_, h_, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                rows, rows, rows, rows,
+                pl.BlockSpec((block, block), lambda b_, h_: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, s, d), lambda b_, h_: (b_, h_, 0, 0)),
+                pl.BlockSpec((1, 1, s, 1), lambda b_, h_: (b_, h_, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
+                jax.ShapeDtypeStruct((b, n, s, 1), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, block, d), q.dtype),
+                pltpu.VMEM((2, block, d), q.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")
+            ),
+        )(qt, kt, vt, cqs, sqs, cos, sin, tri)
+        return jnp.transpose(out, (0, 2, 1, 3))
+
+    return flash_v5
+
+
 # NOTE: "base" now means the transposing flash_attention wrapper with
 # FLASH_HEADMAJOR disabled; the full production path (head-major wiring) is
 # the "xlahm"-equivalent in ATTN_VARIANTS / make_window_attnblock.
@@ -763,6 +912,9 @@ VARIANTS = {
     "v2e": make_flash_v2e(1024, 512, hoist_all=False),
     "v2f": make_flash_v2e(1024, 512, hoist_all=True),
     "v2e1024": make_flash_v2e(1024, 1024, hoist_all=False),
+    "v5": make_flash_v5(1024, interleave=False),
+    "v5i": make_flash_v5(1024, interleave=True),
+    "v5b512": make_flash_v5(512, interleave=False),
 }
 
 
