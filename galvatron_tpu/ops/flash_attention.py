@@ -283,15 +283,21 @@ def _flash_qkv_fwd_rule(qkv, rope, sm_scale, block_q):
 
 
 def _flash_qkv_bwd_rule(sm_scale, block_q, res, do):
-    # the backward pays the q/k/v slices (grid kernels take separate arrays);
-    # only the forward is on the headline path
     qkv, out, lse, rope = res
-    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-    dq, dk, dv = _flash_bwd(
-        (q, k, v, out, lse, rope), do, sm_scale, True, block_q, block_q,
-        _use_interpret(),
-    )
-    dqkv = jnp.stack([dq, dk, dv], axis=1)
+    s, d = qkv.shape[3], qkv.shape[4]
+    if _use_blocked_bwd(s, d, True, rope, block_q, block_q):
+        bk, bq_sub = _bwd_blocks(block_q)
+        dqkv = _flash_bwd_blocked(
+            None, None, None, do, out, lse, rope, sm_scale, bk, bq_sub,
+            _use_interpret(), qkv=qkv, do_stacked_out=True,
+        )
+    else:
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        dq, dk, dv = _flash_bwd(
+            (q, k, v, out, lse, rope), do, sm_scale, True, block_q, block_q,
+            _use_interpret(),
+        )
+        dqkv = jnp.stack([dq, dk, dv], axis=1)
     drope = None if rope is None else jax.tree.map(jnp.zeros_like, rope)
     return dqkv, drope
 
@@ -412,7 +418,229 @@ def _flash_fwd_blocked_qkv(qkv, rope, sm_scale, block_q, interpret):
 
 
 # ---------------------------------------------------------------------------
-# Backward kernels
+# Blocked-causal COMBINED backward: one pallas call per (batch, head),
+# k-block-outer / q-sub-block-inner, dq + dk + dv in one pass
+# ---------------------------------------------------------------------------
+#
+# The grid-style dK/dV + dQ kernels below recompute the score and dp matmuls
+# in BOTH kernels (7 dots per block pair) and pay per-(i,j) grid bookkeeping;
+# a round-4 train-step trace (experiments/trace_train.py) measured them at
+# 13.7 ms/layer-batch plus 3.3 ms for the separate delta pass — 4.7x the
+# blocked forward's 3.59 ms for 3.5x the FLOPs. This kernel applies the
+# forward's round-3 treatment to the backward: ONE invocation per (b, h)
+# with a statically unrolled causal loop (k blocks outer, q sub-blocks
+# inner), sharing the recomputed p and dp across dq/dk/dv (5 dots per pair),
+# computing delta = sum(do*out) in-kernel from operands it already reads,
+# and (on the stacked path) consuming the (b, 3, h, s, d) qkv residual and
+# emitting a stacked (b, 3, h, s, d) dqkv via index-mapped block specs so
+# the fused-projection backward sees slice-copy-free operands.
+#
+# Scale folding (mirrors the forward): q is roped through tables pre-scaled
+# by sm_scale*LOG2E, so base-2 scores are a plain dot and
+#   dk_roped = sm_scale * ds^T @ R(q) = LN2 * ds^T @ q_scaled
+#   dq_roped = sm_scale * ds   @ R(k)
+# with the counter-rotations using the UNSCALED tables.
+
+
+def _bwd_kernel_blocked(*refs, nk, ratio, bq_sub, bk, stacked, sm_scale):
+    (q_ref, k_ref, v_ref, do_ref, out_ref, lse_ref,
+     cos_ref, sin_ref) = refs[:8]
+    if stacked:
+        (dqkv_ref,) = refs[8:]
+    else:
+        dq_ref, dk_ref, dv_ref = refs[8:]
+    lead = (0, 0, 0) if stacked else (0, 0)
+    s_len = q_ref.shape[-2]
+    nqs = s_len // bq_sub
+    lam = jnp.float32(sm_scale * LOG2E)
+
+    # q sub-blocks roped lazily through scale-folded tables derived from the
+    # unscaled ones in-kernel (separate scaled inputs would cost another
+    # s x d/2 x 2 fp32 of VMEM; full-s rope would hold s x d fp32
+    # intermediates — per-block keeps transients at bq_sub x d)
+    q_s = [None] * nqs
+
+    def q_rows(i):
+        if q_s[i] is None:
+            rows = slice(i * bq_sub, (i + 1) * bq_sub)
+            q_s[i] = _rope_rows(
+                q_ref[lead][rows], cos_ref[rows] * lam, sin_ref[rows] * lam
+            ).astype(q_ref.dtype)
+        return q_s[i]
+
+    do = do_ref[0, 0]
+    # delta = sum(do*out) per row, computed lazily per q sub-block (a full-s
+    # fp32 product would transiently hold s x d fp32)
+    delta_c = [None] * nqs
+
+    def delta_rows(i):
+        if delta_c[i] is None:
+            rows = slice(i * bq_sub, (i + 1) * bq_sub)
+            delta_c[i] = jnp.sum(
+                do[rows].astype(jnp.float32)
+                * out_ref[0, 0][rows].astype(jnp.float32),
+                axis=-1, keepdims=True,
+            )
+        return delta_c[i]
+
+    lse2 = lse_ref[0, 0].astype(jnp.float32) * LOG2E  # base-2
+
+    dq = [None] * nqs
+    for j in range(nk):
+        k_r = _rope_rows(
+            k_ref[lead][j * bk:(j + 1) * bk],
+            cos_ref[j * bk:(j + 1) * bk], sin_ref[j * bk:(j + 1) * bk],
+        ).astype(k_ref.dtype)
+        v_j = v_ref[lead][j * bk:(j + 1) * bk]
+        dk_acc = dv_acc = None
+        for i in range(j * ratio, nqs):
+            rows = slice(i * bq_sub, (i + 1) * bq_sub)
+            s2 = jax.lax.dot_general(
+                q_rows(i), k_r, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            t = i - j * ratio
+            if t < ratio:  # diagonal-straddling sub-block: iota mask with
+                # the static row offset (cheaper in VMEM than a mask input)
+                r_io = t * bq_sub + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq_sub, bk), 0
+                )
+                c_io = jax.lax.broadcasted_iota(jnp.int32, (bq_sub, bk), 1)
+                s2 = jnp.where(r_io >= c_io, s2, NEG_INF)
+            p = jnp.exp2(s2 - lse2[rows])
+            do_i = do[rows]
+            pv = jax.lax.dot_general(
+                p.astype(do.dtype), do_i, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dv_acc = pv if dv_acc is None else dv_acc + pv
+            dp = jax.lax.dot_general(
+                do_i, v_j, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = (p * (dp - delta_rows(i))).astype(q_ref.dtype)
+            dk_i = jax.lax.dot_general(
+                ds, q_rows(i), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dk_acc = dk_i if dk_acc is None else dk_acc + dk_i
+            dq_i = jax.lax.dot(ds, k_r, preferred_element_type=jnp.float32)
+            dq[i] = dq_i if dq[i] is None else dq[i] + dq_i
+        cols = slice(j * bk, (j + 1) * bk)
+        dk_out = _rope_rows_t(dk_acc * LN2, cos_ref[cols], sin_ref[cols])
+        if stacked:
+            dqkv_ref[0, 1, 0, cols] = dk_out.astype(dqkv_ref.dtype)
+            dqkv_ref[0, 2, 0, cols] = dv_acc.astype(dqkv_ref.dtype)
+        else:
+            dk_ref[0, 0, cols] = dk_out.astype(dk_ref.dtype)
+            dv_ref[0, 0, cols] = dv_acc.astype(dv_ref.dtype)
+    for i in range(nqs):
+        rows = slice(i * bq_sub, (i + 1) * bq_sub)
+        # dq was accumulated against R(k) (unscaled tables)
+        dq_out = _rope_rows_t(dq[i] * sm_scale, cos_ref[rows], sin_ref[rows])
+        if stacked:
+            dqkv_ref[0, 0, 0, rows] = dq_out.astype(dqkv_ref.dtype)
+        else:
+            dq_ref[0, 0, rows] = dq_out.astype(dq_ref.dtype)
+
+
+def _flash_bwd_blocked(
+    q, k, v, do, out, lse, rope, sm_scale, bk, bq_sub, interpret, qkv=None, do_stacked_out=False
+):
+    """Combined blocked-causal backward. Either separate (b, h, s, d) q/k/v
+    (returns dq, dk, dv) or stacked ``qkv`` (b, 3, h, s, d) with
+    ``do_stacked_out`` (returns dqkv)."""
+    stacked = qkv is not None
+    if stacked:
+        b, _, h, s, d = qkv.shape
+        dtype = qkv.dtype
+    else:
+        b, h, s, d = q.shape
+        dtype = q.dtype
+    nk = s // bk
+    ratio = bk // bq_sub
+    cos, sin = rope
+    # single-buffer the big (s, d) slabs: Mosaic's default double-buffering
+    # across grid steps costs 2x VMEM on every operand, which blows the 16M
+    # scoped limit at the 7B shape (measured 19.3M); per-invocation compute
+    # (~4 GFLOP) dwarfs the unoverlapped slab fetch
+    single = pl.Buffered(buffer_count=1)
+    if stacked:
+        qkv_specs = [
+            pl.BlockSpec((1, 1, 1, s, d), lambda b_, h_: (b_, 0, h_, 0, 0), pipeline_mode=single),
+            pl.BlockSpec((1, 1, 1, s, d), lambda b_, h_: (b_, 1, h_, 0, 0), pipeline_mode=single),
+            pl.BlockSpec((1, 1, 1, s, d), lambda b_, h_: (b_, 2, h_, 0, 0), pipeline_mode=single),
+        ]
+        qkv_inputs = (qkv, qkv, qkv)
+    else:
+        spec = pl.BlockSpec((1, 1, s, d), lambda b_, h_: (b_, h_, 0, 0), pipeline_mode=single)
+        qkv_specs = [spec, spec, spec]
+        qkv_inputs = (q, k, v)
+    bhsd = pl.BlockSpec((1, 1, s, d), lambda b_, h_: (b_, h_, 0, 0), pipeline_mode=single)
+    rows = pl.BlockSpec((s, d // 2), lambda b_, h_: (0, 0), pipeline_mode=single)
+    if do_stacked_out:
+        out_specs = [pl.BlockSpec((1, 3, 1, s, d), lambda b_, h_: (b_, 0, h_, 0, 0), pipeline_mode=single)]
+        out_shape = [jax.ShapeDtypeStruct((b, 3, h, s, d), dtype)]
+    else:
+        out_specs = [bhsd, bhsd, bhsd]
+        out_shape = [jax.ShapeDtypeStruct((b, h, s, d), dtype)] * 3
+    res = pl.pallas_call(
+        functools.partial(
+            _bwd_kernel_blocked, nk=nk, ratio=ratio, bq_sub=bq_sub, bk=bk,
+            stacked=stacked, sm_scale=float(sm_scale),
+        ),
+        grid=(b, h),
+        in_specs=qkv_specs + [
+            bhsd,  # do
+            bhsd,  # out
+            # (s, 1) pads to (s, 128) lanes under TPU tiling — 1M fp32, so
+            # single-buffer it like the slabs
+            pl.BlockSpec((1, 1, s, 1), lambda b_, h_: (b_, h_, 0, 0), pipeline_mode=single),
+            rows, rows,
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")
+        ),
+        interpret=interpret,
+    )(*qkv_inputs, do, out, lse, cos, sin)
+    return res[0] if do_stacked_out else tuple(res)
+
+
+# VMEM budget for the combined backward: resident operands + the (bq_sub, bk)
+# fp32 score/p/dp/ds transients. The backward picks its own (smaller) blocks
+# than the forward's 1024: the remat/while train-step context charges ~1M
+# more scoped VMEM than a standalone compile of the same kernel, so the
+# margin must survive both. (512, 1024) measured 17.4M in-context, (256,
+# 1024) 16.3M; (256, 512) fits with margin.
+_BWD_BQ_SUB = 256
+_BWD_BK = 512
+# the combined backward keeps ALL slabs + dq accumulators resident per
+# invocation, so its envelope is tighter than the forward's: s=4096/d=128
+# measured 21.4M scoped even standalone. Beyond this the grid kernels serve.
+_BWD_MAX_SEQ_X_DIM = 2048 * 128
+
+
+def _bwd_blocks(block_q):
+    """(bk, bq_sub) the combined backward actually uses for a forward block
+    size ``block_q``."""
+    bk = min(_BWD_BK, block_q)
+    return bk, min(_BWD_BQ_SUB, bk)
+
+
+def _use_blocked_bwd(s, d, causal, rope, block_q, block_k):
+    bk, bq_sub = _bwd_blocks(block_q)
+    return (
+        _use_blocked(s, d, causal, rope, block_q, block_k)
+        and s * d <= _BWD_MAX_SEQ_X_DIM
+        and s % bk == 0
+        and bk % bq_sub == 0
+    )
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (grid style — non-causal / no-rope / ring per-hop paths)
 # ---------------------------------------------------------------------------
 
 
@@ -649,8 +877,14 @@ def _flash_fwd_rule(q, k, v, rope, sm_scale, causal, block_q, block_k):
 
 
 def _flash_bwd_rule(sm_scale, causal, block_q, block_k, res, do):
-    dq, dk, dv = _flash_bwd(res, do, sm_scale, causal, block_q, block_k, _use_interpret())
-    rope = res[5]
+    q, k, v, out, lse, rope = res
+    if _use_blocked_bwd(q.shape[2], q.shape[3], causal, rope, block_q, block_k):
+        bk, bq_sub = _bwd_blocks(block_q)
+        dq, dk, dv = _flash_bwd_blocked(
+            q, k, v, do, out, lse, rope, sm_scale, bk, bq_sub, _use_interpret(),
+        )
+    else:
+        dq, dk, dv = _flash_bwd(res, do, sm_scale, causal, block_q, block_k, _use_interpret())
     drope = None if rope is None else jax.tree.map(jnp.zeros_like, rope)
     return dq, dk, dv, drope
 

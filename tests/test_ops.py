@@ -159,8 +159,9 @@ def test_flash_qkv_stacked_matches_reference():
     """The stacked-qkv entry (flash_attention_qkv: kernels consume the fused
     projection's (b, 3, h, s, d) output via index-mapped block specs) is the
     default production path for blocked MHA — pin forward AND gradients
-    (its custom VJP slices the stacked residual into the grid backward and
-    restacks dq/dk/dv) against the materialized-rope reference."""
+    (its custom VJP feeds the stacked residual to the combined blocked
+    backward, which emits a stacked dqkv directly) against the
+    materialized-rope reference."""
     from galvatron_tpu.ops.flash_attention import (
         flash_attention_qkv,
         flash_qkv_supported,
@@ -191,6 +192,43 @@ def test_flash_qkv_stacked_matches_reference():
         np.testing.assert_allclose(
             np.asarray(jnp.transpose(dqkv[:, c], (0, 2, 1, 3))), np.asarray(g),
             rtol=5e-4, atol=5e-4, err_msg=f"slot {c}",
+        )
+
+
+def test_flash_bwd_subblock_ratio():
+    """The combined blocked backward tiles q in sub-blocks smaller than the
+    k block on VMEM-constrained shapes (ratio = bk/bq_sub > 1); the
+    diagonal-straddling sub-blocks then mask with a static row offset.
+    Force ratio=2 and pin gradients against the materialized-rope
+    reference (the default-config tests all run ratio=1)."""
+    from galvatron_tpu.ops import flash_attention as fa
+
+    s, d = 128, 32
+    q, k, v = rand_qkv(jax.random.key(11), s=s, d=d)
+    cos, sin = _rope_tables(s, d)
+
+    def f_flash(q_, k_, v_):
+        out = fa.flash_attention(
+            q_, k_, v_, causal=True, block_q=64, block_k=64, rope=(cos, sin)
+        )
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    def f_ref(q_, k_, v_):
+        qr = modeling.apply_rope(q_, cos, sin)
+        kr = modeling.apply_rope(k_, cos, sin)
+        return (ref_attention(qr, kr, v_) ** 2).sum()
+
+    orig = fa._BWD_BQ_SUB
+    fa._BWD_BQ_SUB = 32
+    try:
+        assert fa._use_blocked_bwd(s, d, True, (cos, sin), 64, 64)
+        g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        fa._BWD_BQ_SUB = orig
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, gf, gr in zip("qkv", g_flash, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), rtol=5e-4, atol=5e-4, err_msg=name
         )
 
 
