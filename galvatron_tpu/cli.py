@@ -178,9 +178,13 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         from galvatron_tpu.profiling.model import profile_model
 
         prefix = ns.output_prefix or f"profile_{ns.model_size}"
+        if bool(ns.layernum_min) != bool(ns.layernum_max):
+            print("error: --layernum_min and --layernum_max must be given "
+                  "together (0,0 = adaptive basis)")
+            return 2
         costs = profile_model(
             cfg, bsz=ns.profile_batch_size,
-            layernums=(ns.layernum_min, ns.layernum_max),
+            layernums=(ns.layernum_min, ns.layernum_max) if ns.layernum_max else None,
             measure_time=ns.profile_type in ("computation", "both"),
         )
         from galvatron_tpu.utils.config_utils import save_profiled_model

@@ -184,8 +184,9 @@ def _add_profile_args(p: argparse.ArgumentParser):
     g.add_argument("--profile_type", type=str, default="both",
                    choices=["computation", "memory", "both"])
     g.add_argument("--profile_batch_size", type=int, default=8)
-    g.add_argument("--layernum_min", type=int, default=2)
-    g.add_argument("--layernum_max", type=int, default=4)
+    g.add_argument("--layernum_min", type=int, default=0,
+                   help="0 = adaptive (scales with the model's layer count)")
+    g.add_argument("--layernum_max", type=int, default=0)
     g.add_argument("--output_prefix", type=str, default=None)
     # (--mixed_precision / --attn_impl come from the training group, which the
     # profile parser includes — build_parser)

@@ -94,6 +94,15 @@ class ModelConfig:
     # (world-wide replicate) of dy — XLA b/433785288. The pin trades that for
     # a tp-wide gather of o in forward. None → unconstrained.
     attn_out_shard_ctx: Optional[Any] = None
+    # (mesh, batch_axes, head_axes) installed by the layer hooks for tp>1
+    # flash layers: _attn_block_headmajor pins the stacked (b, 3, n, s, d)
+    # qkv projection output to (dp, -, tp, -, -). The forward pin is a no-op
+    # (it matches propagation), but with_sharding_constraint's transpose
+    # applies the same spec to the BACKWARD cotangent — without it GSPMD has
+    # been seen sharding the combined bwd kernel's dqkv along the size-3
+    # stack axis (padding it across tp x dp devices) and paying an
+    # involuntary replicate-and-repartition. None → unconstrained.
+    qkv_shard_ctx: Optional[Any] = None
     # vision families (reference legacy vit/swin model_type branches,
     # galvatron/core/parallel.py:64-89, cost_model.py:76,87-106).
     # image_size > 0 switches the input pipeline from token ids to uint8
@@ -654,6 +663,22 @@ def _repeat_kv_hm(x, n_rep: int):
     )
 
 
+def _constrain_qkv(qkv, cfg: ModelConfig):
+    """Pin the stacked (b, 3, n, s, d) qkv (and, via the vjp transpose, its
+    dqkv cotangent) to (dp, -, tp, -, -) when the layer hook installed
+    qkv_shard_ctx — see the ModelConfig field comment."""
+    if cfg.qkv_shard_ctx is None:
+        return qkv
+    from jax.sharding import PartitionSpec as P
+
+    from galvatron_tpu.parallel.sharding import constrain
+
+    mesh, dp_ax, tp_ax = cfg.qkv_shard_ctx
+    return constrain(
+        qkv, mesh, P(dp_ax or None, None, tp_ax or None, None, None)
+    )
+
+
 def _constrain_attn_out(o, cfg: ModelConfig):
     """Pin the attention context to batch-sharded/head-replicated when the
     layer hook installed attn_out_shard_ctx (zero3+tp layers) — see the
@@ -689,6 +714,7 @@ def _attn_block_headmajor(x, p, cfg: ModelConfig, rope, remat_attn: bool):
         qkv = jnp.einsum("bsh,hcnd->bcnsd", x, w.reshape(h, 3, n, hd))
         if "wqkv_b" in p:
             qkv = qkv + p["wqkv_b"].astype(x.dtype).reshape(3, n, hd)[None, :, :, None, :]
+        qkv = _constrain_qkv(qkv, cfg)
         if flash_qkv_supported(s, hd, cfg.causal, rope):
             # the kernels consume the STACKED projection output directly —
             # index-mapped block specs instead of q/k/v slice copies

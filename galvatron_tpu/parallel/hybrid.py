@@ -190,6 +190,16 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
             layer_cfg = layer_cfg.replace(
                 attn_out_shard_ctx=(mesh, axes.dp_axes(s.tp, s.tp_consec, s.cp))
             )
+        if s.tp > 1:
+            # pin the stacked qkv (and its dqkv cotangent) — see
+            # modeling._constrain_qkv
+            layer_cfg = layer_cfg.replace(
+                qkv_shard_ctx=(
+                    mesh,
+                    axes.dp_axes(s.tp, s.tp_consec, s.cp),
+                    axes.tp_axes(s.tp, s.tp_consec),
+                )
+            )
         cos_sin = (
             modeling.rope_tables(layer_cfg, x.shape[1]) if layer_cfg.pos_embed == "rope" else None
         )
