@@ -72,11 +72,16 @@ class EncDecLayout:
         from galvatron_tpu.core.strategy import balanced_division
 
         E, D, pp = cfg.enc_layers, cfg.num_layers, hp.pp
-        if E < pp or D < pp:
+        if E < 1 or D < 1:
             raise ValueError(
-                f"enc-dec pipeline needs at least pp={pp} encoder and decoder "
-                f"layers (got {E} enc / {D} dec)"
+                f"enc-dec pipeline needs at least one encoder and one decoder "
+                f"layer (got {E} enc / {D} dec)"
             )
+        # a sub-stack SMALLER than pp is fine: balanced_division yields zero
+        # entries for the tail stages, whose padded positions are fully
+        # masked (identity sections that just forward the ring traffic) —
+        # the reference places arbitrary layer ranges per stage the same way
+        # (galvatron/core/pipeline/pipeline.py:75-77)
         div = hp.pp_division
         if div is not None and len(div) == pp:
             # HybridParallelConfig.__post_init__ auto-fills a length-pp
@@ -95,10 +100,10 @@ class EncDecLayout:
             self.div_e, self.div_d = list(div[:pp]), list(div[pp:])
             if sum(self.div_e) != E or sum(self.div_d) != D or min(
                 self.div_e + self.div_d
-            ) < 1:
+            ) < 0:
                 raise ValueError(
                     f"enc-dec pp_division {div} must split as enc({E}) ‖ "
-                    f"dec({D}) with >=1 layers per stage per stack"
+                    f"dec({D}) with non-negative per-stage counts"
                 )
         else:
             self.div_e = balanced_division(E, pp)

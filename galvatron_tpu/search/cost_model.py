@@ -201,9 +201,17 @@ def layer_memory_cost(
     pipeline_type: str = "gpipe",
     mixed_precision: str = "bf16",
     vpp: int = 1,
+    stash_boundary_bound: Optional[int] = None,
 ) -> MemoryCost:
     """Per-chip memory for one layer under strategy ``s``
-    (reference: MemoryCostModel, galvatron/core/cost_model.py:4-122)."""
+    (reference: MemoryCostModel, galvatron/core/cost_model.py:4-122).
+
+    ``stash_boundary_bound``: the coupled enc-dec 1F1B
+    (parallel/pipeline_encdec.py) stashes only section INPUTS in a ring of
+    that many micro-batch slots and recomputes the section in its backward
+    tick, so its activation term is boundary-sized per stashed chunk plus
+    ONE live micro-batch of full activations — not act x in-flight like the
+    single-stack 1F1B whose in-flight bound this branch bypasses."""
     dp = world // (pp * s.tp * s.cp)
     # fp32 MB after TP sharding; the expert fraction additionally shards by
     # ep, and its ZeRO sharding spreads only over the dp/ep extent left (the
@@ -234,6 +242,13 @@ def layer_memory_cost(
     ) * mb_bsz
     if pp == 1:
         act = act_per_mb  # accumulation scan keeps one micro-batch live
+    elif stash_boundary_bound is not None:
+        act = (
+            lt.boundary_activation_mb_per_sample
+            * mb_bsz
+            * min(chunks, stash_boundary_bound)
+            + act_per_mb
+        )
     elif pipeline_type == "gpipe":
         act = act_per_mb * chunks
     else:  # 1F1B: bounded in-flight stash (interleaved 1F1B: the mirrored
@@ -340,6 +355,16 @@ def other_time_cost(
 # Time cost
 # ---------------------------------------------------------------------------
 
+# fwd+2bwd = 3.0; remat replay factors MEASURED on v5e (h=2048/8-layer,
+# bsz 8, flash path, one process): full 3.83, selective 3.22 — the replayed
+# forward is cheaper than a standalone fwd (no loss/collective tail and XLA
+# overlaps part of the recompute with the backward), so the naive 4.0 / 3.33
+# overpriced ckpt by ~4%. Shared constants: the coupled enc-dec 1F1B pricing
+# (search_engine) reuses the full-replay factor for its per-tick section
+# recompute — re-measure in ONE place.
+REMAT_FULL_FACTOR = 3.85
+REMAT_SELECTIVE_FACTOR = 3.25
+
 
 def layer_time_cost(
     lt: ProfiledLayerType,
@@ -364,13 +389,11 @@ def layer_time_cost(
         (1.0 - frac) / s.tp + frac / (s.tp * max(1, s.ep))
     )
     fwd = per_sample * local_bsz
-    # fwd + 2×bwd; full remat adds one fwd replay, selective replays only the
-    # attention core. MEASURED factors (v5e, h=2048/8-layer, bsz 8, flash
-    # path, one process): full 3.83, selective 3.22 — the replayed forward
-    # is cheaper than a standalone fwd (no loss/collective tail and XLA
-    # overlaps part of the recompute with the backward), so the naive 4.0 /
-    # 3.33 overpriced ckpt by ~4%.
-    compute = fwd * (3.85 if s.ckpt == "full" else 3.25 if s.ckpt == "selective" else 3.0)
+    compute = fwd * (
+        REMAT_FULL_FACTOR if s.ckpt == "full"
+        else REMAT_SELECTIVE_FACTOR if s.ckpt == "selective"
+        else 3.0
+    )
 
     comm_bytes_factor = 0.5 if mixed_precision in ("bf16", "fp16") else 1.0
     # TP: 2 allreduces fwd + 2 bwd of one (b, s, h) activation (Megatron f/g;

@@ -242,13 +242,22 @@ class SearchEngine:
             # with even counts ride the K-section pair-stacked pipeline
             # (parallel/pipeline_swin.py). Both gpipe-ordered, chunks % pp.
             groups = self._type_groups()
-            if chunks % pp or vpp > 1 or pipeline_type != "gpipe":
+            if chunks % pp or vpp > 1:
                 return None
             if len(groups) == 2 and not self.section_pipeline:
-                if any(cnt < pp for _, cnt, _ in groups):
-                    return None
+                # sub-stacks smaller than pp are fine: balanced_division
+                # yields zero-layer (fully-masked identity) stages, so e.g. a
+                # 2-encoder-layer T5 pipelines at pp=4 (reference analogue:
+                # arbitrary per-stage layer ranges, core/pipeline/pipeline.py:75-77)
                 multi_type = (groups[0][1], groups[1][1])
+                # both coupled schedules exist for 2-group models: gpipe
+                # (T = chunks + 2pp - 1, autodiff backward, act x chunks)
+                # and the hand-written coupled 1F1B (pipeline_encdec.py:
+                # T = chunks + 4pp - 2, input-stash ring + section
+                # recompute, bounded memory)
             elif all(cnt % 2 == 0 for _, cnt, _ in groups):
+                if pipeline_type != "gpipe":
+                    return None  # K-section Swin pipeline is gpipe-only
                 swin_groups = [(cnt, lt) for _, cnt, lt in groups]
             else:
                 return None
@@ -315,11 +324,17 @@ class SearchEngine:
         intra = np.zeros((n_pos, S), np.float64)
         for j in range(n_pos):
             lt = pos_lt(j)
+            # coupled enc-dec 1F1B: input-stash ring bounds from the
+            # schedule (pipeline_encdec.py: enc min(chunks, 4pp-1),
+            # dec/ctx min(chunks, 2pp-1))
+            stash_bound = None
+            if multi_type is not None and pipeline_type == "pipedream_flush":
+                stash_bound = (4 * pp - 1) if j < lpe else (2 * pp - 1)
             for k, s in enumerate(cands):
                 mc = layer_memory_cost(
                     lt, s, world, pp, global_bsz, chunks, stage_idx=0,
                     pipeline_type=pipeline_type, mixed_precision=self.mp,
-                    vpp=vpp,
+                    vpp=vpp, stash_boundary_bound=stash_bound,
                 )
                 # a device holds vpp layers per searched position
                 # (interleaved) or 2 (swin pairs)
@@ -329,6 +344,26 @@ class SearchEngine:
                 intra[j, k] = pos_layers * layer_time_cost(
                     lt, s, self.hw, world, pp, global_bsz, mixed_precision=self.mp
                 )
+        if multi_type is not None and pipeline_type == "pipedream_flush":
+            # coupled 1F1B: every backward tick recomputes its section from
+            # the stashed input ONCE regardless of the layer's own ckpt
+            # setting, so the effective per-tick factor is
+            # max(strategy factor, full-replay factor) — scale each
+            # candidate's priced factor up to the replay factor instead of
+            # stacking them (a flat multiplier would double-count ckpt)
+            from galvatron_tpu.search.cost_model import (
+                REMAT_FULL_FACTOR,
+                REMAT_SELECTIVE_FACTOR,
+            )
+
+            mult = np.array([
+                1.0 if s.ckpt == "full"
+                else REMAT_FULL_FACTOR / REMAT_SELECTIVE_FACTOR
+                if s.ckpt == "selective"
+                else REMAT_FULL_FACTOR / 3.0
+                for s in cands
+            ])
+            intra = intra * mult[None, :]
         lt0 = self._layer_type(0)
         inter = np.zeros((S, S), np.float64)
         for a in range(S):
@@ -345,11 +380,26 @@ class SearchEngine:
         best = None  # (total_ms, res, mem_used, vt, et, other_mb)
         pairs = list(_vocab_strategy_pairs(world, pp))
         use_measured = self._vocab_use_measured()
+        pf_overhead = 0.0
+        if multi_type is not None and pipeline_type == "pipedream_flush":
+            # per-DEVICE constants the coupled 1F1B carries beyond the
+            # per-position stash rings (pipeline_encdec.py carry): the
+            # dxe/dxd fp32 input-cotangent buffers hold (chunks+1)
+            # micro-batches ≈ the full per-device batch boundary (fp32), and
+            # the ctx stash holds (min(chunks, 2pp-1)+1) enc-boundary
+            # micro-batch slots. Sized at the candidate worst case
+            # (largest per-device batch = smallest dp = largest tp).
+            enc_b = self._layer_type(0).boundary_activation_mb_per_sample
+            dec_b = self._layer_type(multi_type[0]).boundary_activation_mb_per_sample
+            fp32x = 2.0 if self.mp in ("bf16", "fp16") else 1.0
+            rows = global_bsz / max(1, world // (pp * max(s.tp for s in cands)))
+            pf_overhead = (enc_b + dec_b) * rows * ((chunks + 1) / chunks) * fp32x
+            pf_overhead += enc_b * (rows / chunks) * (min(chunks, 2 * pp - 1) + 1)
         for vt, et in pairs:
             other_mb = other_memory_cost(
                 self.costs, world, pp, vocab_tp=vt, embed_dp_type=et,
                 global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,
-            )
+            ) + pf_overhead
             budget = self.budget_mb - other_mb
             if budget <= 0:
                 continue
@@ -381,7 +431,13 @@ class SearchEngine:
                     ).boundary_activation_mb_per_sample
                     p2p_mb = (2.0 * enc_b + dec_b) * (global_bsz / chunks) * bf
                     p2p_ms = p2p_mb / self.hw.p2p(pp)
-                    total_ms = (chunks + 2 * pp - 1) * (per_stage_ms + p2p_ms)
+                    if pipeline_type == "pipedream_flush":
+                        # hand-written coupled 1F1B: chunks + 4pp - 2 ticks
+                        # (the per-tick section recompute is already scaled
+                        # into intra above)
+                        total_ms = (chunks + 4 * pp - 2) * (per_stage_ms + p2p_ms)
+                    else:
+                        total_ms = (chunks + 2 * pp - 1) * (per_stage_ms + p2p_ms)
                 elif swin_groups is not None:
                     # K coupled sections (pipeline_swin.py): every tick runs
                     # one virtual stage of EVERY section; chunks + K·pp - 1
@@ -468,7 +524,12 @@ class SearchEngine:
                 "pp": pp, "vpp": vpp, "chunks": chunks,
                 "pipeline_type": pipeline_type,
                 "vocab_tp": vocab_tp, "embed_dp_type": embed_dp_type,
+                # includes encdec_1f1b_overhead_mb when that schedule is priced
                 "other_memory_mb": float(other_mb),
+                **(
+                    {"encdec_1f1b_overhead_mb": float(pf_overhead)}
+                    if pf_overhead else {}
+                ),
                 # non-empty => comm terms priced from built-in defaults, not
                 # measured bandwidths (e.g. search ran on a single-chip host)
                 "fallback_bandwidths": self.hw.fallback_sources(pp),
@@ -706,11 +767,17 @@ class SearchEngine:
                 f"{'strategy':>16} | {'states MB':>9} | {'act MB':>8} | "
                 f"{'total MB':>8} | {'time ms':>8}"
             )
+            # same stash-ring pricing evaluate() applies to the coupled
+            # enc-dec 1F1B (enc group stashes 4pp-1 slots, dec 2pp-1)
+            stash_bound = None
+            if len(groups) == 2 and pp > 1 and pipeline_type == "pipedream_flush":
+                stash_bound = (4 * pp - 1) if gi == 0 else (2 * pp - 1)
             for s in cands:
                 dp = world // (pp * s.tp * s.cp)
                 mc = layer_memory_cost(
                     lt, s, world, pp, global_bsz, chunks, stage_idx=0,
                     pipeline_type=pipeline_type, mixed_precision=self.mp,
+                    stash_boundary_bound=stash_bound,
                 )
                 t = layer_time_cost(
                     lt, s, self.hw, world, pp, global_bsz, mixed_precision=self.mp

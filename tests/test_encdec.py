@@ -86,11 +86,18 @@ def test_encdec_rejects_cp_and_bad_pipeline_shapes():
     hp3 = HybridParallelConfig.uniform(4, pp=2, chunks=1, mixed_precision="fp32")
     with pytest.raises(ValueError, match="chunks"):
         build_runtime(T5, hp3, adam=AdamConfig(), global_batch_size=8)
-    # each stack still needs >= 1 layer per stage (enc_layers=2 here)
+    # sub-stacks smaller than pp are legal (zero-layer masked stages) — only
+    # an EMPTY stack is rejected
+    from galvatron_tpu.parallel.pipeline_encdec import validate_encdec_pipeline
+
     cfg4 = T5.replace(enc_layers=2, num_layers=2)
     hp4 = HybridParallelConfig.uniform(4, pp=4, chunks=4, mixed_precision="fp32")
-    with pytest.raises(ValueError, match="at least"):
-        build_runtime(cfg4, hp4, adam=AdamConfig(), global_batch_size=8)
+    lay = validate_encdec_pipeline(cfg4, hp4)
+    assert sorted(lay.div_e) == [0, 0, 1, 1]
+    cfg5 = T5.replace(enc_layers=0, num_layers=4)
+    with pytest.raises(ValueError, match="at least one"):
+        validate_encdec_pipeline(cfg5, HybridParallelConfig.uniform(
+            4, pp=4, chunks=4, mixed_precision="fp32"))
 
 
 @pytest.mark.parametrize("tp,dp_type,ckpt", [(1, "ddp", False), (2, "zero3", True)])
@@ -398,3 +405,102 @@ def test_encdec_measured_profile_two_types():
     )
     r = eng.evaluate(2, 8, 2, "gpipe")
     assert r is not None and r.config.pp == 2
+
+
+def test_encdec_search_emits_1f1b_and_trains():
+    """The multi-type search prices the coupled enc-dec 1F1B
+    (pipeline_type=pipedream_flush): at equal (pp, bsz, chunks) it must
+    predict LESS activation memory than the gpipe schedule (input-stash ring
+    vs act x chunks) at a higher-or-equal predicted time (more ticks +
+    section recompute), and under a budget only the 1F1B fits, search()
+    must emit it — and the emitted config must train. Reference: the
+    multi-type DP prices any model under either schedule,
+    galvatron/core/dynamic_programming.py:304-455."""
+    from galvatron_tpu.profiling.model import profile_model
+    from galvatron_tpu.search.cost_model import ProfiledHardware
+    from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+
+    costs = profile_model(T5, bsz=8, measure_time=False)
+
+    def make_eng(budget, allow_ckpt=True):
+        return SearchEngine(
+            costs, ProfiledHardware(), num_layers=T5.total_layers,
+            space=SearchSpace(world_size=4, pp_choices=[2], max_tp=2,
+                              allow_ckpt=allow_ckpt),
+            memory_budget_mb=budget, mixed_precision="fp32",
+            mem_unit_mb=0.0625,  # tiny model: sub-MB per-layer activations
+        )
+
+    eng = make_eng(2000.0)
+    r_g = eng.evaluate(2, 64, 64, "gpipe")
+    r_f = eng.evaluate(2, 64, 64, "pipedream_flush")
+    assert r_g is not None and r_f is not None
+    assert r_f.config.pipeline_type == "pipedream_flush"
+    assert r_f.memory_mb < r_g.memory_mb  # bounded stash vs act x chunks
+    assert r_f.cost_ms >= r_g.cost_ms  # more ticks + section recompute
+
+    # with remat disallowed (the regime where 1F1B is THE memory lever —
+    # gpipe must hold act x chunks while the 1F1B stash ring is bounded), a
+    # budget just above the 1F1B footprint leaves no feasible gpipe and the
+    # search emits the 1F1B schedule. (With ckpt allowed, gpipe+full-remat
+    # is often lighter than the coupled 1F1B, whose fp32 dx cotangent
+    # buffers are charged via encdec_1f1b_overhead_mb — the search prices
+    # all three and picks the real winner.)
+    r_f2 = make_eng(2000.0, allow_ckpt=False).evaluate(2, 64, 64, "pipedream_flush")
+    assert "encdec_1f1b_overhead_mb" in r_f2.details
+    tight = make_eng(r_f2.memory_mb * 1.05, allow_ckpt=False)
+    assert tight.evaluate(2, 64, 64, "gpipe") is None
+    r = tight.search([64], max_chunks=64)
+    assert r is not None and r.config.pipeline_type == "pipedream_flush"
+
+    # the emitted config trains through the coupled 1F1B runtime
+    rt = build_runtime(T5, r.config, adam=AdamConfig(lr=3e-3), global_batch_size=64)
+    state = rt.init_state(jax.random.key(0))
+    rng = np.random.RandomState(3)
+    b = jnp.asarray(rng.randint(0, 128, (64, T5.sample_len + 1)), jnp.int32)
+    losses = []
+    for _ in range(4):
+        state, loss = rt.train_step(state, rt.shard_batch(b))
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_encdec_small_encoder_stack_below_pp():
+    """A sub-stack SMALLER than pp (E=2 at pp=4) rides zero-layer masked
+    stages (balanced_division yields [0,1,1,0]): eval parity against the
+    flat model on identical weights, training works under BOTH coupled
+    schedules, and the search emits a pp=4 config for it. Reference:
+    arbitrary per-stage layer ranges, core/pipeline/pipeline.py:75-77."""
+    cfg = T5.replace(enc_layers=2, num_layers=4)
+    flat = modeling.init_model_params(jax.random.key(0), cfg)
+    rng = np.random.RandomState(7)
+    b = jnp.asarray(rng.randint(0, 128, (8, cfg.sample_len + 1)), jnp.int32)
+    ref = float(jax.jit(lambda p, bb: modeling.lm_loss(p, bb, cfg))(flat, b))
+    for ptype in ("gpipe", "pipedream_flush"):
+        hp = HybridParallelConfig.uniform(
+            6, pp=4, chunks=4, mixed_precision="fp32", pipeline_type=ptype
+        )
+        rt = build_runtime(cfg, hp, adam=AdamConfig(lr=1e-3), global_batch_size=8)
+        state = rt.init_state_from(flat)
+        np.testing.assert_allclose(
+            float(rt.eval_loss(state, b)), ref, rtol=3e-5, atol=3e-5,
+            err_msg=ptype,
+        )
+        state, loss = rt.train_step(state, b)
+        state, loss2 = rt.train_step(state, b)
+        assert np.isfinite(float(loss2)) and float(loss2) < float(loss), ptype
+
+    # the search no longer bails on count < pp
+    from galvatron_tpu.profiling.model import profile_model
+    from galvatron_tpu.search.cost_model import ProfiledHardware
+    from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+
+    costs = profile_model(cfg, bsz=8, measure_time=False)
+    eng = SearchEngine(
+        costs, ProfiledHardware(), num_layers=cfg.total_layers,
+        space=SearchSpace(world_size=4, pp_choices=[4], max_tp=1),
+        memory_budget_mb=2000.0, mixed_precision="fp32",
+    )
+    r = eng.evaluate(4, 8, 4, "gpipe")
+    assert r is not None and r.config.pp == 4
+    assert r.config.pp_division[:4] == [0, 1, 1, 0]  # enc split with zeros
