@@ -136,6 +136,42 @@ def save_checkpoint_portable(ckpt_dir: str, state: Any, step: int, runtime) -> s
     return save_checkpoint(ckpt_dir, flat, step)
 
 
+def _tree_keypaths(tree) -> set:
+    from jax.tree_util import tree_flatten_with_path
+
+    leaves, _ = tree_flatten_with_path(tree)
+    return {jax.tree_util.keystr(kp) for kp, _ in leaves}
+
+
+def _checkpoint_layout(
+    ckpt_dir: str, step: Optional[int], flat_abstract, stacked_abstract
+) -> Optional[str]:
+    """POSITIVE layout detection: compare the on-disk checkpoint tree
+    structure (orbax metadata) against the two candidate layouts instead of
+    classifying restore-exception text (which breaks whenever orbax rewords
+    a structure mismatch). Returns 'flat' | 'stacked' | 'neither', or None
+    when the metadata itself cannot be read (caller falls back to
+    try-restore + exception classification)."""
+    ocp = _ocp()
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+    try:
+        meta = ocp.StandardCheckpointer().metadata(path)
+        # StepMetadata wraps the saved tree; the tree itself flattens with
+        # the same keypaths as the state pytree
+        disk = _tree_keypaths(getattr(meta, "item_metadata", meta))
+    except Exception:
+        return None
+    if disk == _tree_keypaths(flat_abstract):
+        return "flat"
+    if disk == _tree_keypaths(stacked_abstract):
+        return "stacked"
+    return "neither"
+
+
 def restore_checkpoint_portable(ckpt_dir: str, runtime, step: Optional[int] = None) -> Any:
     """Restore a portable (flat-layout) checkpoint into the runtime's own
     layout, resharding as needed. Flat leaves restore under the per-layer
@@ -145,22 +181,35 @@ def restore_checkpoint_portable(ckpt_dir: str, runtime, step: Optional[int] = No
     if runtime.restack_params is None:
         return restore_checkpoint(ckpt_dir, abstract_state_of(runtime), step)
     flat_abstract = flat_abstract_state_of(runtime)
+    layout = _checkpoint_layout(
+        ckpt_dir, step, flat_abstract, abstract_state_of(runtime)
+    )
+    if layout == "stacked":
+        # pre-portable checkpoint in the engine's own stacked layout
+        return restore_checkpoint(ckpt_dir, abstract_state_of(runtime), step)
+    if layout == "neither":
+        raise ValueError(
+            "checkpoint matches neither the portable flat-layers layout "
+            "nor this runtime's stacked layout — it was likely saved "
+            "under a different pipeline configuration by a pre-portable "
+            "revision; resume it once with its original configuration to "
+            "re-save portably."
+        )
     try:
         flat = restore_checkpoint(ckpt_dir, flat_abstract, step)
     except FileNotFoundError:
         raise
     except Exception as flat_err:
-        # pre-portable checkpoints carry the engine's STACKED layout; fall
-        # back to a direct same-layout restore — but only on evidence of a
-        # layout/structure mismatch (orbax names missing/mismatched paths).
-        # A transient I/O or deserialization failure on a genuinely flat
-        # checkpoint must surface verbatim, not as "matches neither layout".
+        if layout == "flat":
+            # structure positively identified as flat: any failure here is a
+            # real restore error, surface it verbatim
+            raise
+        # metadata unavailable (layout is None): fall back to the old
+        # exception-text classification before trying the stacked layout
         low = str(flat_err).lower()
         mismatch_words = (
             "missing", "mismatch", "structure", "rank", "shape", "not found",
         )
-        # KeyError/TypeError are how pytree/dict structure mismatches surface
-        # when the message itself names only the offending key
         structural = isinstance(flat_err, (KeyError, TypeError))
         if not structural and not any(w in low for w in mismatch_words):
             raise

@@ -178,8 +178,12 @@ class HybridParallelConfig:
                 raise ValueError("pp_division length must equal pp (or 2*pp for enc-dec)")
             if sum(self.pp_division) != self.num_layers:
                 raise ValueError("pp_division must sum to the layer count")
-            if any(n < 1 for n in self.pp_division):
-                raise ValueError("pp_division entries must be >= 1")
+            # the 2*pp enc-dec layout allows zero-layer (fully masked)
+            # stages for sub-stacks smaller than pp; single-stack pipelines
+            # require at least one layer per stage
+            floor = 0 if len(self.pp_division) == 2 * self.pp else 1
+            if any(n < floor for n in self.pp_division):
+                raise ValueError(f"pp_division entries must be >= {floor}")
             if self.vpp > 1 and len(set(self.pp_division)) > 1:
                 raise ValueError(
                     "the interleaved schedule (vpp>1) requires a uniform "

@@ -22,6 +22,7 @@ Attention dispatch mirrors the reference's core-vs-flash switch
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from dataclasses import dataclass
 from functools import partial
@@ -647,9 +648,24 @@ def attention(q, k, v, cfg: ModelConfig, bias=None, rope=None):
 
 # escape hatch for A/B harnesses (experiments/ab_flash.py) that monkeypatch
 # ops.flash_attention.flash_attention: the head-major wiring below bypasses
-# that symbol, so kernel-level experiments must set this False for the window
-# they build (and restore it) or every variant silently benches this path
+# that symbol, so kernel-level experiments must disable it for the window
+# they build or every variant silently benches this path. Use the
+# flash_headmajor() context manager — a crash between a bare set and its
+# restore would silently leave every later attn_block on the legacy path.
 FLASH_HEADMAJOR = True
+
+
+@contextlib.contextmanager
+def flash_headmajor(enabled: bool):
+    """Temporarily force the head-major flash wiring on/off (restores the
+    previous value even on error)."""
+    global FLASH_HEADMAJOR
+    prev = FLASH_HEADMAJOR
+    FLASH_HEADMAJOR = enabled
+    try:
+        yield
+    finally:
+        FLASH_HEADMAJOR = prev
 
 
 def _repeat_kv_hm(x, n_rep: int):

@@ -90,6 +90,20 @@ class SwinLayout:
                 f"swin pipeline needs chunks ({hp.chunks}) divisible by "
                 f"pp={pp} (micro-batches flow in groups of pp on the ring)"
             )
+        # the layout derives its per-section divisions from swin_depths; a
+        # user-provided pp_division that differs from the auto-filled
+        # balanced default is rejected instead of silently ignored (the
+        # enc-dec layout applies the same guard)
+        from galvatron_tpu.core.strategy import balanced_division
+
+        if hp.pp_division is not None and hp.pp_division != balanced_division(
+            sum(depths), pp
+        ):
+            raise ValueError(
+                f"swin pipeline derives stage divisions from swin_depths "
+                f"{tuple(depths)} per section; a custom pp_division "
+                f"({hp.pp_division}) is not honored"
+            )
         self.K = len(depths)
         self.pp = pp
         self.base = list(np.cumsum([0] + [d for d in depths[:-1]]))  # layer idx base

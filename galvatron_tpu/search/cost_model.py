@@ -166,6 +166,12 @@ class ProfiledHardware:
         return 50.0
 
 
+# HBM bandwidth assumed when splitting a measured constant into its
+# memory-traffic share (v5e-class default; used only for the zero3
+# Adam-update correction in other_time_cost)
+_HBM_GBPS = 800.0
+
+
 def _allreduce_ms(msg_mb: float, size: int, bw_gbps: float) -> float:
     if size <= 1 or msg_mb == 0:
         return 0.0
@@ -322,12 +328,17 @@ def other_time_cost(
     fit = costs.vocab_measurement_for(vocab_tp, mixed_precision) if use_measured else None
     if fit is not None:
         slope, const = fit
-        # the dp=1 measurement's const is dominated by the FULL Adam update
-        # on the V·h params; under embed zero3 each device updates only its
-        # 1/dp shard, so the const shrinks accordingly (the gathers it then
-        # needs are the analytic zero3 comm terms above)
+        # under embed zero3 each device updates only its 1/dp param shard —
+        # but ONLY the Adam-update share of the measured const shrinks; the
+        # rest (dispatch and per-step fixed overheads, which dominate the
+        # zero-layer measurement on this environment) does not. The update
+        # share is estimated from its memory traffic: ~28 B/param (read
+        # p/g/m/v fp32, write p/m/v) = 7x the fp32 param MB at HBM rate
+        # (dividing the WHOLE const by dp systematically underpriced zero3
+        # at large dp and biased the vocab-strategy choice toward it).
         if embed_dp_type == "zero3":
-            const = const / dp
+            adam_ms = min(const, 7.0 * p_mb / _HBM_GBPS)
+            const = const - adam_ms + adam_ms / dp
         return const + slope * (global_bsz / (dp * pp)) + comm
     compute = costs.other_fwd_ms_per_sample * global_bsz / world * 3.0
     if vocab_tp > 1 and costs.layer_types:
@@ -374,12 +385,20 @@ def layer_time_cost(
     pp: int,
     global_bsz: int,
     mixed_precision: str = "bf16",
+    recompute_factor: Optional[float] = None,
 ) -> float:
     """Per-iteration per-layer time (ms) under strategy ``s`` (reference:
     TimeCostModel, galvatron/core/cost_model.py:125-349): compute (bwd=2×fwd,
     remat adds one fwd), TP collectives on the critical path, DP grad
     reduction + ZeRO gathers overlapped under the measured slowdown
-    coefficient."""
+    coefficient.
+
+    ``recompute_factor``: schedules that replay the layer's forward
+    regardless of its own ckpt setting (the coupled enc-dec 1F1B recomputes
+    each section from its stashed input) price compute at
+    max(strategy factor, recompute_factor) and the TP collectives at the
+    full-remat replay convention — per term, so the once-per-iteration DP
+    grad reduction is NOT inflated."""
     dp = world // (pp * s.tp * s.cp)
     local_bsz = global_bsz / dp / max(1, s.cp)
     # expert compute (≈ the expert param fraction of layer FLOPs) divides by
@@ -389,11 +408,14 @@ def layer_time_cost(
         (1.0 - frac) / s.tp + frac / (s.tp * max(1, s.ep))
     )
     fwd = per_sample * local_bsz
-    compute = fwd * (
+    factor = (
         REMAT_FULL_FACTOR if s.ckpt == "full"
         else REMAT_SELECTIVE_FACTOR if s.ckpt == "selective"
         else 3.0
     )
+    if recompute_factor is not None:
+        factor = max(factor, recompute_factor)
+    compute = fwd * factor
 
     comm_bytes_factor = 0.5 if mixed_precision in ("bf16", "fp16") else 1.0
     # TP: 2 allreduces fwd + 2 bwd of one (b, s, h) activation (Megatron f/g;
@@ -401,8 +423,8 @@ def layer_time_cost(
     act_msg = lt.boundary_activation_mb_per_sample * local_bsz * comm_bytes_factor
     tp_bw = hw.bw(s.tp, s.tp_consec)
     tp_ms = 4.0 * _allreduce_ms(act_msg, s.tp, tp_bw)
-    if s.ckpt == "full":
-        tp_ms *= 1.5  # full recompute replays the forward collectives
+    if s.ckpt == "full" or recompute_factor is not None:
+        tp_ms *= 1.5  # forward-replay schedules replay the fwd collectives
     # (selective recompute replays no TP collectives: the attention core sits
     # between the column- and row-parallel linears)
     # CP: the ring rotates K/V cp-1 hops per pass (the diagonal hop is

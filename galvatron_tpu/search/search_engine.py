@@ -26,6 +26,7 @@ import numpy as np
 
 from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy, form_strategy
 from galvatron_tpu.search.cost_model import (
+    REMAT_FULL_FACTOR,
     MemoryCost,
     ProfiledHardware,
     ProfiledLayerType,
@@ -156,6 +157,16 @@ class SearchEngine:
         self.budget_mb = memory_budget_mb
         self.mp = mixed_precision
         self.unit = mem_unit_mb
+        # structural bail-outs that fired during the last sweep (multi-type
+        # schedule/shape classes the engines cannot realize) — written into
+        # the emitted config as `search_restrictions` the way
+        # fallback_bandwidths already labels unmeasured bandwidths. A tag is
+        # dropped when its class nonetheless produced feasible pp>1 results
+        # in the same sweep (e.g. chunks=1 grid points always trip the
+        # divisibility bail; that is not a degradation when chunks=2.. were
+        # searched), so a present tag means the class was REALLY excluded.
+        self._restrictions: set = set()
+        self._restriction_ok: set = set()
         # True = multi-type groups are a vision pyramid (pipeline_swin's
         # K-section pair-stacked engine) even at K=2 — a 2-stage Swin profile
         # is otherwise indistinguishable from an enc-dec one (the CLI sets
@@ -242,7 +253,11 @@ class SearchEngine:
             # with even counts ride the K-section pair-stacked pipeline
             # (parallel/pipeline_swin.py). Both gpipe-ordered, chunks % pp.
             groups = self._type_groups()
-            if chunks % pp or vpp > 1:
+            if chunks % pp:
+                self._restrictions.add("multi_type_pp_needs_chunks_divisible_by_pp")
+                return None
+            if vpp > 1:
+                self._restrictions.add("multi_type_pp_no_interleaved_vpp")
                 return None
             if len(groups) == 2 and not self.section_pipeline:
                 # sub-stacks smaller than pp are fine: balanced_division
@@ -257,9 +272,11 @@ class SearchEngine:
                 # recompute, bounded memory)
             elif all(cnt % 2 == 0 for _, cnt, _ in groups):
                 if pipeline_type != "gpipe":
-                    return None  # K-section Swin pipeline is gpipe-only
+                    self._restrictions.add("section_pipeline_gpipe_only")
+                    return None
                 swin_groups = [(cnt, lt) for _, cnt, lt in groups]
             else:
+                self._restrictions.add("section_pipeline_odd_pair_count_pp1_only")
                 return None
         if global_bsz % chunks:
             return None
@@ -330,6 +347,16 @@ class SearchEngine:
             stash_bound = None
             if multi_type is not None and pipeline_type == "pipedream_flush":
                 stash_bound = (4 * pp - 1) if j < lpe else (2 * pp - 1)
+            # coupled 1F1B: every backward tick recomputes its section from
+            # the stashed input ONCE regardless of the layer's own ckpt
+            # setting — layer_time_cost prices compute at
+            # max(strategy factor, full-replay factor) and the TP replay,
+            # without inflating the once-per-iteration DP reduction
+            recompute = (
+                REMAT_FULL_FACTOR
+                if multi_type is not None and pipeline_type == "pipedream_flush"
+                else None
+            )
             for k, s in enumerate(cands):
                 mc = layer_memory_cost(
                     lt, s, world, pp, global_bsz, chunks, stage_idx=0,
@@ -342,28 +369,9 @@ class SearchEngine:
                     1, int(np.ceil(pos_layers * vpp * mc.total_mb / self.unit))
                 )
                 intra[j, k] = pos_layers * layer_time_cost(
-                    lt, s, self.hw, world, pp, global_bsz, mixed_precision=self.mp
+                    lt, s, self.hw, world, pp, global_bsz, mixed_precision=self.mp,
+                    recompute_factor=recompute,
                 )
-        if multi_type is not None and pipeline_type == "pipedream_flush":
-            # coupled 1F1B: every backward tick recomputes its section from
-            # the stashed input ONCE regardless of the layer's own ckpt
-            # setting, so the effective per-tick factor is
-            # max(strategy factor, full-replay factor) — scale each
-            # candidate's priced factor up to the replay factor instead of
-            # stacking them (a flat multiplier would double-count ckpt)
-            from galvatron_tpu.search.cost_model import (
-                REMAT_FULL_FACTOR,
-                REMAT_SELECTIVE_FACTOR,
-            )
-
-            mult = np.array([
-                1.0 if s.ckpt == "full"
-                else REMAT_FULL_FACTOR / REMAT_SELECTIVE_FACTOR
-                if s.ckpt == "selective"
-                else REMAT_FULL_FACTOR / 3.0
-                for s in cands
-            ])
-            intra = intra * mult[None, :]
         lt0 = self._layer_type(0)
         inter = np.zeros((S, S), np.float64)
         for a in range(S):
@@ -467,6 +475,11 @@ class SearchEngine:
             return None
         total_ms, res, mem_used, vocab_tp, embed_dp_type, other_mb = best
 
+        if multi_type is not None:
+            self._restriction_ok.add("multi_type_pp")
+        elif swin_groups is not None:
+            self._restriction_ok.add("section_pp")
+
         chosen = [cands[k] for k in res]
         if pp > 1:
             # same per-position pattern in every (virtual) stage; uneven
@@ -541,6 +554,8 @@ class SearchEngine:
     def _iter_results(self, global_bsz_list, max_chunks, verbose=False):
         """Yield every feasible SearchResult in the (bsz, pp, chunks,
         schedule, vpp) sweep."""
+        self._restrictions.clear()
+        self._restriction_ok.clear()
         pps = self.space.pp_choices or [
             p for p in _pow2s(self.space.world_size) if p <= self.L
         ]
@@ -569,6 +584,19 @@ class SearchEngine:
                                 )
                             yield r
 
+    # which sweep success unclears a fired tag (tags absent here are
+    # standing exclusions and always reported once fired)
+    _RESTRICTION_CLEARED_BY = {
+        "multi_type_pp_needs_chunks_divisible_by_pp": "multi_type_pp",
+        "section_pipeline_gpipe_only": "section_pp",
+    }
+
+    def _active_restrictions(self) -> List[str]:
+        return sorted(
+            t for t in self._restrictions
+            if self._RESTRICTION_CLEARED_BY.get(t) not in self._restriction_ok
+        )
+
     def search_topk(
         self, global_bsz_list: Sequence[int], k: int, max_chunks: int = 64,
         verbose: bool = False,
@@ -588,6 +616,10 @@ class SearchEngine:
             seen.add(key)
             out.append(r)
         out.sort(key=lambda r: -r.throughput_samples_per_s)
+        rs = self._active_restrictions()
+        if rs:
+            for r in out:
+                r.details["search_restrictions"] = rs
         return out[:k]
 
     def search(
@@ -604,6 +636,10 @@ class SearchEngine:
                 r.throughput_samples_per_s > best.throughput_samples_per_s
             ):
                 best = r
+        if best is not None:
+            rs = self._active_restrictions()
+            if rs:
+                best.details["search_restrictions"] = rs
         if best is not None and verbose:
             s0 = best.config.layer_strategies[0]
             dp = self.space.world_size // (best.config.pp * s0.tp * s0.cp)
@@ -818,5 +854,10 @@ class SearchEngine:
         fb = result.details.get("fallback_bandwidths")
         if fb:
             d["fallback_bandwidths"] = fb  # priced from defaults, not measured
+        rs = result.details.get("search_restrictions")
+        if rs:
+            # structural bail-outs that really excluded a schedule/shape
+            # class from the sweep that produced this result
+            d["search_restrictions"] = rs
         with open(path, "w") as f:
             json.dump(d, f, indent=2)

@@ -504,3 +504,9 @@ def test_encdec_small_encoder_stack_below_pp():
     r = eng.evaluate(4, 8, 4, "gpipe")
     assert r is not None and r.config.pp == 4
     assert r.config.pp_division[:4] == [0, 1, 1, 0]  # enc split with zeros
+    # the emitted config must survive validate() and BUILD (zero-entry 2*pp
+    # divisions are legal only for the enc-dec layout)
+    rt4 = build_runtime(cfg, r.config, adam=AdamConfig(lr=1e-3), global_batch_size=8)
+    s4 = rt4.init_state(jax.random.key(1))
+    s4, l4 = rt4.train_step(s4, rt4.shard_batch(b))
+    assert np.isfinite(float(l4))

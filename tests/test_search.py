@@ -410,3 +410,39 @@ def test_recommend_min_bsz_prunes_sweep():
     assert res is not None
     # nothing feasible -> degrade to scale (the sweep reports infeasibility)
     assert eng(1.0).recommend_min_bsz(scale=8) == 8
+
+
+def test_search_restrictions_labeled_in_saved_config(tmp_path):
+    """When a structural bail-out silently narrows the sweep (e.g. a
+    multi-type model at pp>1 with chunks not divisible by pp), the emitted
+    config JSON records it in `search_restrictions` — the same provenance
+    labeling fallback_bandwidths gives unmeasured bandwidths."""
+    import json
+
+    from galvatron_tpu.models.modeling import ModelConfig
+    from galvatron_tpu.profiling.model import profile_model
+
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4, ffn_dim=128,
+        max_seq_len=16, enc_layers=2, enc_seq=16, pos_embed="learned",
+        tie_word_embeddings=True,
+    )
+    costs = profile_model(cfg, bsz=8, measure_time=False)
+    eng = SearchEngine(
+        costs, ProfiledHardware(), num_layers=cfg.total_layers,
+        space=SearchSpace(world_size=4, pp_choices=[1, 2], max_tp=1),
+        memory_budget_mb=2000.0, mixed_precision="fp32",
+    )
+    # max_chunks=1: every pp=2 multi-type evaluation bails on chunks % pp
+    # and NO multi-type pp>1 config exists — the class was really excluded
+    r = eng.search([8], max_chunks=1)
+    assert r is not None and r.config.pp == 1
+    out = tmp_path / "cfg.json"
+    eng.save_result(r, str(out))
+    d = json.loads(out.read_text())
+    assert "multi_type_pp_needs_chunks_divisible_by_pp" in d["search_restrictions"]
+    # a full sweep still trips the chunks=1 grid point, but pp>1 multi-type
+    # configs DID search — the tag is cleared, no field written
+    r2 = eng.search([8], max_chunks=8)
+    eng.save_result(r2, str(out))
+    assert "search_restrictions" not in json.loads(out.read_text())
