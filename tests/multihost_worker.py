@@ -1,0 +1,70 @@
+"""Worker for the 2-process CPU-cluster multi-host test (not collected by
+pytest — spawned by tests/test_multihost.py). Each process owns 4 virtual
+CPU devices of an 8-device cluster; the pair drives
+jax.distributed.initialize, the make_array_from_callback batch path, real
+cross-process collectives, and the portable checkpoint save.
+
+Usage: python multihost_worker.py <process_id> <coordinator_port> <ckpt_dir>
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+ckpt_dir = sys.argv[3]
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(f"localhost:{port}", num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+assert len(jax.local_devices()) == 4
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from galvatron_tpu.core.checkpoint import save_checkpoint_portable  # noqa: E402
+from galvatron_tpu.core.optim import AdamConfig  # noqa: E402
+from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy  # noqa: E402
+from galvatron_tpu.models.modeling import ModelConfig  # noqa: E402
+from galvatron_tpu.parallel.hybrid import build_runtime  # noqa: E402
+
+CFG = ModelConfig(
+    vocab_size=64, hidden_size=32, num_layers=2, num_heads=2, ffn_dim=64,
+    max_seq_len=16,
+)
+# tp=2 x dp=4: the dp axes cross the process boundary, so the grad
+# allreduce and the batch sharding both exercise the DCN-analogue path
+HP = HybridParallelConfig(
+    pp=1,
+    layer_strategies=[LayerStrategy(tp=2), LayerStrategy(tp=2, dp_type="zero2")],
+    chunks=1, vocab_tp=1, mixed_precision="fp32",
+)
+
+rt = build_runtime(CFG, HP, adam=AdamConfig(lr=1e-3), global_batch_size=8, seq_len=16)
+state = rt.init_state(jax.random.key(0))
+
+# every process runs the same deterministic loader (the reference's
+# DistributedSampler role); shard_batch's make_array_from_callback branch
+# materializes only locally-owned rows
+rng = np.random.RandomState(0)
+batch_np = rng.randint(0, 64, (8, 17)).astype(np.int32)
+losses = []
+for _ in range(3):
+    batch = rt.shard_batch(batch_np)
+    assert batch.sharding is not None and not batch.is_fully_addressable
+    state, loss = rt.train_step(state, batch)
+    losses.append(float(loss))
+print(f"worker {pid} losses: {' '.join(f'{l:.6f}' for l in losses)}", flush=True)
+assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+# portable checkpoint written cooperatively by both processes
+save_checkpoint_portable(ckpt_dir, state, step=3, runtime=rt)
+print(f"worker {pid} OK", flush=True)
