@@ -160,6 +160,23 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
                 f"(bsz {res.global_bsz})"
             )
             _validate_search(cands, cfg, ns)
+        if ns.report_homogeneity_gap and res.config.pp > 1 and res.config.vpp == 1:
+            g = eng.homogeneity_gap(
+                res.config.pp, res.global_bsz, res.config.chunks,
+                res.config.pipeline_type,
+            )
+            if g is None:
+                print("homogeneity gap: n/a (not defined for this "
+                      "shape/schedule, or the per-stage DP is infeasible)")
+            else:
+                print(
+                    f"homogeneity gap: restricted {g['restricted_ms']:.1f} ms vs "
+                    f"unrestricted per-stage {g['unrestricted_ms']:.1f} ms "
+                    f"(delta {g['delta_pct']:+.3f}%)"
+                )
+                res.details["homogeneity_gap_pct"] = g["delta_pct"]
+        elif ns.report_homogeneity_gap and res.config.vpp > 1:
+            print("homogeneity gap: n/a for interleaved (vpp>1) schedules")
         out = ns.output_config_path or f"galvatron_config_{ns.model_size}_{ns.num_devices}dev.json"
         eng.save_result(res, out)
         print(f"saved searched strategy → {out}")

@@ -176,6 +176,11 @@ def _add_search_args(p: argparse.ArgumentParser):
                    "steps each on this host's devices and report measured vs "
                    "predicted iteration time and whether the predicted "
                    "ranking holds (requires --num_devices == local devices)")
+    g.add_argument("--report_homogeneity_gap", type=int, default=0,
+                   help="after searching a pp>1 config, run per-stage DPs "
+                   "with stage-specific memory (the reference's unrestricted "
+                   "per-stage placement) and report/record the predicted "
+                   "cost of this runtime's cross-stage position sharing")
 
 
 def _add_profile_args(p: argparse.ArgumentParser):

@@ -234,6 +234,40 @@ class SearchEngine:
                 groups.append([i, 1, lt])
         return groups
 
+    def _coupled_total_ms(
+        self, tick_ms: float, pp: int, chunks: int, pipeline_type: str,
+        global_bsz: int, multi_type, swin_groups,
+    ) -> float:
+        """Iteration time of the coupled tick-synchronous pipelines from one
+        bottleneck tick — the ONE pricing both evaluate() and
+        homogeneity_gap() use (a divergence here would make the gap measure
+        formula skew instead of the homogeneity restriction).
+
+        enc-dec (pipeline_encdec.py): every tick runs one enc + one dec
+        virtual stage; T = chunks + 2pp - 1 (gpipe autodiff) or
+        chunks + 4pp - 2 (coupled 1F1B; its per-tick section recompute is
+        priced in the intra table); three ppermutes per tick — enc out and
+        ctx at the encoder boundary size, dec y at the decoder's.
+        Swin (pipeline_swin.py): every tick runs one virtual stage of EVERY
+        section; T = chunks + K*pp - 1; each section's output rides its own
+        ring ppermute."""
+        bf = 0.5 if self.mp in ("bf16", "fp16") else 1.0
+        if multi_type is not None:
+            enc_b = self._layer_type(0).boundary_activation_mb_per_sample
+            dec_b = self._layer_type(multi_type[0]).boundary_activation_mb_per_sample
+            p2p_mb = (2.0 * enc_b + dec_b) * (global_bsz / chunks) * bf
+            T = (
+                chunks + 4 * pp - 2
+                if pipeline_type == "pipedream_flush"
+                else chunks + 2 * pp - 1
+            )
+        else:
+            p2p_mb = sum(
+                lt.boundary_activation_mb_per_sample for _, lt in swin_groups
+            ) * (global_bsz / chunks) * bf
+            T = chunks + len(swin_groups) * pp - 1
+        return T * (tick_ms + p2p_mb / self.hw.p2p(pp))
+
     # -- single (pp, bsz, chunks, pipeline_type) evaluation ------------------
 
     def evaluate(
@@ -425,38 +459,11 @@ class SearchEngine:
                 # tick time lets pipeline_time_cost amplify it by the
                 # fill/steady factor instead of counting it flat)
                 per_stage_ms = self._stage_tick_ms(intra, inter, res, chunks, vpp)
-                if multi_type is not None:
-                    # two coupled sub-pipelines (pipeline_encdec.py): every
-                    # tick runs one enc + one dec virtual stage, so per-tick
-                    # time is the full position sum; chunks + 2·pp - 1 ticks
-                    # (the runtime's T); three ppermutes per tick — enc out
-                    # and ctx at the encoder boundary size, dec y at the
-                    # decoder boundary size
-                    bf = 0.5 if self.mp in ("bf16", "fp16") else 1.0
-                    enc_b = self._layer_type(0).boundary_activation_mb_per_sample
-                    dec_b = self._layer_type(
-                        multi_type[0]
-                    ).boundary_activation_mb_per_sample
-                    p2p_mb = (2.0 * enc_b + dec_b) * (global_bsz / chunks) * bf
-                    p2p_ms = p2p_mb / self.hw.p2p(pp)
-                    if pipeline_type == "pipedream_flush":
-                        # hand-written coupled 1F1B: chunks + 4pp - 2 ticks
-                        # (the per-tick section recompute is already scaled
-                        # into intra above)
-                        total_ms = (chunks + 4 * pp - 2) * (per_stage_ms + p2p_ms)
-                    else:
-                        total_ms = (chunks + 2 * pp - 1) * (per_stage_ms + p2p_ms)
-                elif swin_groups is not None:
-                    # K coupled sections (pipeline_swin.py): every tick runs
-                    # one virtual stage of EVERY section; chunks + K·pp - 1
-                    # ticks; each section's output rides its own ring ppermute
-                    bf = 0.5 if self.mp in ("bf16", "fp16") else 1.0
-                    Kg = len(swin_groups)
-                    p2p_mb = sum(
-                        lt.boundary_activation_mb_per_sample for _, lt in swin_groups
-                    ) * (global_bsz / chunks) * bf
-                    p2p_ms = p2p_mb / self.hw.p2p(pp)
-                    total_ms = (chunks + Kg * pp - 1) * (per_stage_ms + p2p_ms)
+                if multi_type is not None or swin_groups is not None:
+                    total_ms = self._coupled_total_ms(
+                        per_stage_ms, pp, chunks, pipeline_type, global_bsz,
+                        multi_type, swin_groups,
+                    )
                 else:
                     total_ms = pipeline_time_cost(
                         [per_stage_ms] * pp,
@@ -721,13 +728,20 @@ class SearchEngine:
         stage with stage-specific memory (the reference's formulation) and
         reports the predicted iteration-time delta.
 
-        Returns {restricted_ms, unrestricted_ms, delta_pct, per_stage}
-        (None when the restricted search itself finds nothing feasible)."""
+        Multi-type models are covered too: enc-dec stages run their own DPs
+        over their REAL per-stage layer counts (ragged/sub-pp divisions give
+        light stages headroom the shared-position search cannot use), with
+        the coupled-1F1B stash memory and recompute pricing; Swin sections
+        use their per-stage pair spreads.
+
+        Returns {restricted_ms, unrestricted_ms, delta_pct, per_stage}.
+        None = not defined for this shape/schedule (pp=1, vpp>1, odd swin
+        sections, >2 non-section groups) or the restricted search itself
+        finds nothing feasible."""
         r = self.evaluate(pp, global_bsz, chunks, pipeline_type)
-        if r is None or pp == 1 or len(self.costs.layer_types) > 1:
+        if r is None or pp == 1:
             return None
         world = self.space.world_size
-        lps = -(-self.L // pp)
         cands = self._feasible_strategies(pp, global_bsz, chunks)
         S = len(cands)
         lt0 = self._layer_type(0)
@@ -736,7 +750,7 @@ class SearchEngine:
         other_mb = other_memory_cost(
             self.costs, world, pp, vocab_tp=vt, embed_dp_type=et,
             global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,
-        )
+        ) + r.details.get("encdec_1f1b_overhead_mb", 0.0)
         budget = self.budget_mb - other_mb
         if budget <= 0:
             return None
@@ -747,28 +761,107 @@ class SearchEngine:
                 inter[a, b] = transition_cost_ms(
                     cands[a], cands[b], lt0, self.hw, world, pp, global_bsz, self.mp
                 )
-        intra = np.zeros((lps, S), np.float64)
-        for k, s in enumerate(cands):
-            intra[:, k] = layer_time_cost(
-                lt0, s, self.hw, world, pp, global_bsz, mixed_precision=self.mp
-            )
+
+        # per-stage position descriptors: (layer_type, stash_bound, layers)
+        groups = self._type_groups()
+        recompute = None
+        if len(groups) == 1:
+            mode = "single"
+            lps = -(-self.L // pp)
+            stage_positions = [[(lt0, None, 1)] * lps for _ in range(pp)]
+        elif len(groups) == 2 and not self.section_pipeline:
+            if pipeline_type not in ("gpipe", "pipedream_flush") or chunks % pp:
+                return None
+            from galvatron_tpu.core.strategy import balanced_division
+
+            mode = "encdec"
+            E, D = groups[0][1], groups[1][1]
+            div_e, div_d = balanced_division(E, pp), balanced_division(D, pp)
+            lte, ltd = self._layer_type(0), self._layer_type(E)
+            pf = pipeline_type == "pipedream_flush"
+            se = (4 * pp - 1) if pf else None
+            sd = (2 * pp - 1) if pf else None
+            if pf:
+                recompute = REMAT_FULL_FACTOR
+            stage_positions = [
+                [(lte, se, 1)] * div_e[st] + [(ltd, sd, 1)] * div_d[st]
+                for st in range(pp)
+            ]
+        elif all(cnt % 2 == 0 for _, cnt, _ in groups) and pipeline_type == "gpipe":
+            from galvatron_tpu.parallel.pipeline_swin import _spread_pairs
+
+            mode = "swin"
+            sec_div = [_spread_pairs(cnt // 2, pp) for _, cnt, _ in groups]
+            stage_positions = [
+                [
+                    (groups[k][2], None, 2)
+                    for k in range(len(groups))
+                    for _ in range(sec_div[k][st])
+                ]
+                for st in range(pp)
+            ]
+        else:
+            return None
+
+        intra_rows: Dict[int, np.ndarray] = {}
+
+        def intra_row(lt) -> np.ndarray:
+            key = id(lt)
+            if key not in intra_rows:
+                intra_rows[key] = np.array([
+                    layer_time_cost(
+                        lt, s, self.hw, world, pp, global_bsz,
+                        mixed_precision=self.mp, recompute_factor=recompute,
+                    )
+                    for s in cands
+                ])
+            return intra_rows[key]
+
+        mem_rows: Dict[tuple, np.ndarray] = {}
+
+        def mem_row(lt, stash, n_lay, st) -> np.ndarray:
+            key = (id(lt), stash, n_lay, st)
+            if key not in mem_rows:
+                mem_rows[key] = np.array([
+                    max(1, int(np.ceil(
+                        n_lay * layer_memory_cost(
+                            lt, s, world, pp, global_bsz, chunks, stage_idx=st,
+                            pipeline_type=pipeline_type, mixed_precision=self.mp,
+                            stash_boundary_bound=stash,
+                        ).total_mb / self.unit
+                    )))
+                    for s in cands
+                ], np.int32)
+            return mem_rows[key]
+
         stage_ms, per_stage = [], []
         for st in range(pp):
-            mem = np.zeros((lps, S), np.int32)
-            for k, s in enumerate(cands):
-                mc = layer_memory_cost(
-                    lt0, s, world, pp, global_bsz, chunks, stage_idx=st,
-                    pipeline_type=pipeline_type, mixed_precision=self.mp,
-                )
-                mem[:, k] = max(1, int(np.ceil(mc.total_mb / self.unit)))
+            poss = stage_positions[st]
+            if not poss:  # a stage holding only masked padding
+                stage_ms.append(0.0)
+                per_stage.append([])
+                continue
+            n_pos = len(poss)
+            mem = np.zeros((n_pos, S), np.int32)
+            intra = np.zeros((n_pos, S), np.float64)
+            for j, (lt, stash, n_lay) in enumerate(poss):
+                intra[j] = intra_row(lt) * n_lay
+                mem[j] = mem_row(lt, stash, n_lay, st)
             cost, res, _ = run_dp(mem, intra, inter, V)
             if not np.isfinite(cost) or (res < 0).any():
                 return None
             stage_ms.append(self._stage_tick_ms(intra, inter, res, chunks))
             per_stage.append([form_strategy(cands[k], pp, world // (pp * cands[k].tp * cands[k].cp)) for k in res])
-        unrestricted = pipeline_time_cost(
-            stage_ms, self._boundary_msg_mb(lt0, global_bsz, chunks), pp, chunks, self.hw
-        )
+        if mode == "single":
+            unrestricted = pipeline_time_cost(
+                stage_ms, self._boundary_msg_mb(lt0, global_bsz, chunks), pp, chunks, self.hw
+            )
+        else:
+            unrestricted = self._coupled_total_ms(
+                max(stage_ms), pp, chunks, pipeline_type, global_bsz,
+                (groups[0][1], groups[1][1]) if mode == "encdec" else None,
+                [(cnt, lt) for _, cnt, lt in groups] if mode == "swin" else None,
+            )
         unrestricted += other_time_cost(
             self.costs, self.hw, world, pp, vt, et, global_bsz, self.mp,
             use_measured=self._vocab_use_measured(),
@@ -859,5 +952,7 @@ class SearchEngine:
             # structural bail-outs that really excluded a schedule/shape
             # class from the sweep that produced this result
             d["search_restrictions"] = rs
+        if "homogeneity_gap_pct" in result.details:
+            d["homogeneity_gap_pct"] = result.details["homogeneity_gap_pct"]
         with open(path, "w") as f:
             json.dump(d, f, indent=2)

@@ -41,9 +41,18 @@ def test_two_process_cpu_cluster(tmp_path):
         for i in range(2)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=420)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        # a worker dying pre-initialize leaves its peer blocked in the
+        # coordinator barrier — never leak it past the test
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                out, _ = p.communicate()
+                outs.append(out)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
         assert f"worker {i} OK" in out

@@ -446,3 +446,41 @@ def test_search_restrictions_labeled_in_saved_config(tmp_path):
     r2 = eng.search([8], max_chunks=8)
     eng.save_result(r2, str(out))
     assert "search_restrictions" not in json.loads(out.read_text())
+
+
+def test_homogeneity_gap_multi_type_zero_by_construction():
+    """Extend the homogeneity-gap quantification to multi-type models: for
+    the tick-synchronous coupled schedules (enc-dec gpipe/1F1B, Swin
+    sections) the per-stage-unrestricted optimum equals the restricted one
+    BY CONSTRUCTION — the pipeline tick is bottlenecked by the max-position
+    stage, whose per-stage subproblem is exactly the restricted DP; light
+    stages' headroom cannot shave the bottleneck. Verified numerically on a
+    ragged T5 (E=10/D=22, pp=4) and the Swin-large pyramid across budgets."""
+    from galvatron_tpu.models.modeling import PRESETS
+    from galvatron_tpu.search.theoretical import analytic_model_costs
+
+    hw = ProfiledHardware(
+        allreduce_bw={"16_1": 45.7, "8_1": 153.5, "8_0": 32.1, "4_1": 152.4,
+                      "4_0": 19.3, "2_1": 151.2, "2_0": 9.3},
+        p2p_bw={2: 7.97, 4: 8.82, 8: 8.90, 16: 8.81}, overlap_coe=1.146,
+    )
+    t5 = PRESETS["t5-3b"].replace(enc_layers=10, num_layers=22)
+    costs = analytic_model_costs(t5)
+    for ptype in ("gpipe", "pipedream_flush"):
+        eng = SearchEngine(
+            costs, hw, num_layers=t5.total_layers,
+            space=SearchSpace(world_size=16, pp_choices=[4]),
+            memory_budget_mb=8000.0,
+        )
+        g = eng.homogeneity_gap(4, 64, 16, ptype)
+        assert g is not None, ptype
+        assert abs(g["delta_pct"]) < 1e-6, (ptype, g)
+        assert len(g["per_stage"]) == 4
+    sw = PRESETS["swin-large"]
+    eng = SearchEngine(
+        analytic_model_costs(sw), hw, num_layers=sw.total_layers,
+        space=SearchSpace(world_size=16, pp_choices=[4]),
+        memory_budget_mb=4000.0, section_pipeline=True,
+    )
+    g = eng.homogeneity_gap(4, 64, 16, "gpipe")
+    assert g is not None and abs(g["delta_pct"]) < 1e-6, g
