@@ -140,7 +140,7 @@ def flash_v1b(q, k, v, causal=True, sm_scale=None, block_q=1024, block_k=1024, r
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=fa._compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
         ),
     )(qt, kt, vt, cqs, sqs, cos, sin)
@@ -234,7 +234,7 @@ def flash_v2c(q, k, v, causal=True, sm_scale=None, block_q=1024, block_k=1024, r
                 jax.ShapeDtypeStruct((b, n, block_q, d), q.dtype),
                 jax.ShapeDtypeStruct((b, n, block_q, 1), jnp.float32),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=fa._compiler_params(
                 dimension_semantics=("parallel", "parallel")
             ),
         )(qt, kt, vt, cqs, sqs, cos, sin, tri)
@@ -317,7 +317,7 @@ def make_flash_v2d(block=1024):
                 jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
                 jax.ShapeDtypeStruct((b, n, s, 1), jnp.float32),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=fa._compiler_params(
                 dimension_semantics=("parallel", "parallel")
             ),
         )(qt, kt, vt, cqs, sqs, cos, sin, tri)
@@ -436,7 +436,7 @@ def make_flash_v2e(block_q=1024, block_k=512, hoist_all=False):
                     jax.ShapeDtypeStruct((b, n, bq, d), q.dtype),
                     jax.ShapeDtypeStruct((b, n, bq, 1), jnp.float32),
                 ],
-                compiler_params=pltpu.CompilerParams(
+                compiler_params=fa._compiler_params(
                     dimension_semantics=("parallel", "parallel")
                 ),
             )(qt, kt, vt, cqs, sqs, cos, sin, tri0, tri1)
@@ -542,7 +542,7 @@ def flash_v3(q, k, v, causal=True, sm_scale=None, rope=None, **_):
                 jax.ShapeDtypeStruct((b, n, bq, d), q.dtype),
                 jax.ShapeDtypeStruct((b, n, bq, 1), jnp.float32),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=fa._compiler_params(
                 dimension_semantics=("parallel", "parallel")
             ),
         )(qt, kt, vt, cqs, sqs, cos, sin, tri)
@@ -635,7 +635,7 @@ def make_flash_v4(hb=2, block=1024):
                     jax.ShapeDtypeStruct((b, n, bq, d), q.dtype),
                     jax.ShapeDtypeStruct((b, n, bq, 1), jnp.float32),
                 ],
-                compiler_params=pltpu.CompilerParams(
+                compiler_params=fa._compiler_params(
                     dimension_semantics=("parallel", "parallel")
                 ),
             )(qt, kt, vt, cqs, sqs, cos, sin, tri)
@@ -725,7 +725,7 @@ def make_flash_probe(mode):
                     jax.ShapeDtypeStruct((b, n, bq, d), q.dtype),
                     jax.ShapeDtypeStruct((b, n, bq, 1), jnp.float32),
                 ],
-                compiler_params=pltpu.CompilerParams(
+                compiler_params=fa._compiler_params(
                     dimension_semantics=("parallel", "parallel")
                 ),
             )(qt, kt, vt, cqs, sqs, cos, sin, tri)
@@ -881,7 +881,7 @@ def make_flash_v5(block=1024, interleave=False):
                 pltpu.VMEM((2, block, d), q.dtype),
                 pltpu.SemaphoreType.DMA((2, 2)),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=fa._compiler_params(
                 dimension_semantics=("parallel", "parallel")
             ),
         )(qt, kt, vt, cqs, sqs, cos, sin, tri)

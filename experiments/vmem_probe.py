@@ -1,0 +1,43 @@
+"""Probe the chip's usable VMEM: compile+run a kernel whose resident block
+footprint is N MB with vmem_limit_bytes raised, and report where it breaks.
+
+The pallas/Mosaic default scoped limit is ~16 MB; physical VMEM may be
+larger. This measures ground truth on the attached chip.
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def try_mb(mb: int) -> str:
+    # one input block of `mb` MB (bf16), touched so it can't be elided
+    rows = mb * (1 << 20) // (512 * 2)
+    x = jnp.ones((rows, 512), jnp.bfloat16)
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = jnp.sum(x_ref[...].astype(jnp.float32), axis=0, keepdims=True)
+
+    try:
+        out = pl.pallas_call(
+            kern,
+            in_specs=[pl.BlockSpec((rows, 512), lambda: (0, 0))],
+            out_specs=pl.BlockSpec((1, 512), lambda: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((1, 512), jnp.float32),
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=(mb + 8) << 20,
+            ),
+        )(x)
+        out.block_until_ready()
+        return f"OK sum={float(out[0,0]):.3e}"
+    except Exception as e:  # noqa: BLE001
+        return f"FAIL {type(e).__name__}: {str(e)[:200]}"
+
+
+if __name__ == "__main__":
+    sizes = [int(a) for a in sys.argv[1:]] or [16, 24, 32, 48, 64, 96, 110, 120]
+    for mb in sizes:
+        print(f"{mb:4d} MB: {try_mb(mb)}", flush=True)

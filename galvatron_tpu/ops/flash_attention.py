@@ -19,6 +19,7 @@ tests) and for shapes that don't tile (seq % block != 0).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -34,6 +35,21 @@ LN2 = 0.6931471805599453  # 1/log2(e)
 
 def _use_interpret() -> bool:
     return jax.default_backend() == "cpu"
+
+
+# Mosaic's default per-kernel scoped-VMEM budget is ~16 MB, but the v5e chip
+# runs kernels with >=120 MB resident blocks when vmem_limit_bytes is raised
+# (experiments/vmem_probe.py, measured on-chip). The kernels here request a
+# larger budget so the combined blocked backward serves the 7B shape
+# (s=4096: 21.4 MB scoped) and bigger block configs become legal.
+# GALVATRON_FLASH_VMEM_MB=0 restores the Mosaic default.
+_VMEM_LIMIT_MB = int(os.environ.get("GALVATRON_FLASH_VMEM_MB", "64"))
+
+
+def _compiler_params(**kw) -> pltpu.CompilerParams:
+    if _VMEM_LIMIT_MB:
+        kw.setdefault("vmem_limit_bytes", _VMEM_LIMIT_MB << 20)
+    return pltpu.CompilerParams(**kw)
 
 
 def _rope_rows(x, c, s):
@@ -202,7 +218,7 @@ def _flash_fwd(q, k, v, rope, sm_scale, causal, block_q, block_k, interpret,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -322,9 +338,15 @@ def flash_qkv_supported(s: int, d: int, causal: bool, rope, block_q: int = 1024)
 
 # The last q-block call keeps the full k prefix resident in VMEM (k, v, rope
 # rows, fp32 rope intermediates scale with s*d) and statically unrolls nq k
-# iterations; both must stay bounded. 4096*128 is the measured v5e budget at
-# the 1024-block default.
-_BLOCKED_MAX_SEQ_X_DIM = 4096 * 128
+# iterations; both must stay bounded. With the raised vmem_limit_bytes
+# (see _compiler_params: the 16 MB figure was Mosaic's default, not the
+# chip's — experiments/vmem_probe.py) the envelope extends to s=8192 at
+# d=128, measured −15% on the full train step vs the grid kernels at that
+# shape (experiments/ab_flash_bwd.py, v5e). When the env knob shrinks the
+# budget below what the wide envelopes charge (s=8192 fwd ~24 MB scoped),
+# the envelopes shrink back with it so shapes route to the grid kernels
+# instead of failing Mosaic's VMEM check at compile time.
+_BLOCKED_MAX_SEQ_X_DIM = 8192 * 128 if _VMEM_LIMIT_MB >= 32 else 4096 * 128
 _BLOCKED_MAX_UNROLL = 8
 
 
@@ -399,7 +421,7 @@ def _flash_fwd_blocked(
                 jax.ShapeDtypeStruct((b, h, block_q, d), out_dtype or dtype),
                 jax.ShapeDtypeStruct((b, h, block_q, 1), jnp.float32),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(
                 dimension_semantics=("parallel", "parallel")
             ),
             interpret=interpret,
@@ -600,7 +622,7 @@ def _flash_bwd_blocked(
         ],
         out_specs=out_specs,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel")
         ),
         interpret=interpret,
@@ -609,17 +631,22 @@ def _flash_bwd_blocked(
 
 
 # VMEM budget for the combined backward: resident operands + the (bq_sub, bk)
-# fp32 score/p/dp/ds transients. The backward picks its own (smaller) blocks
-# than the forward's 1024: the remat/while train-step context charges ~1M
-# more scoped VMEM than a standalone compile of the same kernel, so the
-# margin must survive both. (512, 1024) measured 17.4M in-context, (256,
-# 1024) 16.3M; (256, 512) fits with margin.
+# fp32 score/p/dp/ds transients. (256, 512) was originally forced by
+# Mosaic's 16 MB default budget; with the raised limit, (512, 512) and
+# (512, 1024) are legal but measure FLAT on the full train step at s=2048
+# and within noise at s=4096 (experiments/ab_flash_bwd.py) — per-block
+# bookkeeping is not what bounds this kernel — so the proven config stays.
 _BWD_BQ_SUB = 256
 _BWD_BK = 512
 # the combined backward keeps ALL slabs + dq accumulators resident per
-# invocation, so its envelope is tighter than the forward's: s=4096/d=128
-# measured 21.4M scoped even standalone. Beyond this the grid kernels serve.
-_BWD_MAX_SEQ_X_DIM = 2048 * 128
+# invocation (s=4096/d=128 measures 21.4M scoped), which overflowed Mosaic's
+# 16 MB default budget beyond s=2048; under the raised vmem_limit_bytes the
+# envelope extends to s=8192, measured −9% (s=4096) / −15% (s=8192, with the
+# forward extension) on the full train step vs the grid kernels
+# (experiments/ab_flash_bwd.py, v5e). Beyond this — or whenever the env
+# knob shrinks the budget below what the wide envelope charges — the grid
+# kernels serve.
+_BWD_MAX_SEQ_X_DIM = 8192 * 128 if _VMEM_LIMIT_MB >= 32 else 2048 * 128
 
 
 def _bwd_blocks(block_q):
@@ -822,7 +849,7 @@ def _flash_bwd_parts(
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -846,7 +873,7 @@ def _flash_bwd_parts(
         out_specs=pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
