@@ -16,7 +16,11 @@ delivers section k's finished output to device 0 exactly when that chunk
 enters section k+1 there — device 0 applies the patch-merge projection
 (replicated, token-local) to form the next section's input. The last useful
 write is chunk chunks-1 at section K-1 on device pp-1 → T = chunks + K·pp - 1
-ticks. Backward is autodiff through the clocked scan (GPipe ordering).
+ticks. Backward under ``pipeline_type='gpipe'`` is autodiff through the
+clocked scan; ``'pipedream_flush'`` runs the hand-written coupled 1F1B below
+(the enc-dec two-section 1F1B of pipeline_encdec.py generalized to K
+sections), whose stash rings are bounded by the schedule depth instead of
+growing with chunks.
 
 Stacking unit = layer PAIR (plain + shifted window): Swin alternates the
 window shift by position parity within a stage, so single-layer stacking
@@ -80,10 +84,11 @@ class SwinLayout:
             )
         if hp.vpp > 1:
             raise ValueError("swin pipeline does not compose with vpp>1")
-        if hp.pipeline_type != "gpipe":
+        if hp.pipeline_type not in ("gpipe", "pipedream_flush"):
             raise ValueError(
-                "swin pipeline implements the gpipe-ordered coupled-sections "
-                f"schedule only (got {hp.pipeline_type!r})"
+                "swin pipeline implements the coupled-sections schedule in "
+                f"gpipe and pipedream_flush (1F1B) orderings (got "
+                f"{hp.pipeline_type!r})"
             )
         if hp.chunks % pp:
             raise ValueError(
@@ -367,7 +372,7 @@ def build_swin_pipeline_runtime(
     fp16 = hp.mixed_precision == "fp16"
     scaler_cfg = LossScalerConfig()
 
-    def train_step(state, batch):
+    def gpipe_train_step(state, batch):
         if fp16:
             loss, grads = scaled_value_and_grad(loss_fn, state["scaler"]["scale"])(
                 state["params"], batch
@@ -376,6 +381,227 @@ def build_swin_pipeline_runtime(
         loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
         new_params, new_opt = adamw_update(state["params"], grads, state["opt"], adam)
         return {"params": new_params, "opt": new_opt, "step": state["step"] + 1}, loss
+
+    # ------------------------------------------------------------------
+    # 1F1B (pipedream_flush) ordering: the enc-dec coupled 1F1B
+    # (pipeline_encdec.py) generalized to K sections. The coupled pipeline is
+    # an interleaved virtual pipeline of depth K*pp (section k's virtual
+    # stage s lives on device s), so the backward mirrors pipeline_1f1b: the
+    # section-(K-1) backward wave starts at the last device in the SAME tick
+    # as that chunk's final forward, each wave rides the down-chain, and at
+    # device 0 the wave wraps (down-ring) to seed the previous section's
+    # backward at device pp-1 one tick later. Backward recomputes each
+    # section from stashed inputs — ring buffers bounded by the schedule
+    # depth, independent of chunks (the 1F1B property the gpipe-ordered
+    # autodiff backward lacks).
+    #
+    # Patch-merge placement flips versus the gpipe body: the SENDER merges
+    # (every device computes section k then its merge; device 0 consumes the
+    # wrapped, already-merged output) so every device's section-k input — and
+    # therefore the one stash ring per section — has the uniform section-k
+    # shape. The cotangent seed of the composed (section, merge) vjp is the
+    # pair (dy_section, dy_merged): the down-chain recv fills the first on
+    # s < pp-1, the down-ring wrap recv (device 0's section-(k+1) input
+    # cotangent) fills the second on the last device; vjp linearity zeroes
+    # the unused half. Numerically identical to merge-on-consumer (ppermute
+    # is exact).
+    #
+    #   sec k fwd: m = t - k*pp - s
+    #   sec k bwd: m = t - ((2K-k)*pp - 2) + s
+    #   T = chunks + 2K*pp - 2;  stash[k]: min(chunks, 2*(K-k)*pp - 1)
+    # ------------------------------------------------------------------
+    from galvatron_tpu.parallel.pipeline_1f1b import _head_loss
+
+    n_s = [min(chunks, 2 * (K - k) * pp - 1) for k in range(K)]
+    off = [(2 * K - k) * pp - 2 for k in range(K)]
+    T_1f1b = chunks + 2 * K * pp - 2
+    n_static = mb  # loss-carrying positions per micro-batch (cls: one/sample)
+    ring_wrap_down = [(i, (i - 1) % pp) for i in range(pp)]
+
+    def sec_merge_fn(k):
+        if k == K - 1:
+            return section_fns[k]
+
+        def f(stacks_k, merge_k, x):
+            out = section_fns[k](stacks_k, x)
+            return out, modeling.patch_merge(out, merge_k, cfg, k)
+
+        return f
+
+    sec_fns_1f1b = [sec_merge_fn(k) for k in range(K)]
+
+    def pipeline_body_1f1b(sections, merges, head_sub, emb_mbs, labels_mbs, scale):
+        sections = jax.tree.map(lambda a: jnp.squeeze(a, 0), sections)
+        s = jax.lax.axis_index("pp")
+        is_last = s == pp - 1
+        is_first = s == 0
+        dt = emb_mbs.dtype
+        shp = [(mb, sec_len[k], sec_c[k]) for k in range(K)]
+        f32 = lambda tree: jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), tree)
+
+        carry0 = {"loss_sum": jnp.zeros((), jnp.float32), "tok": jnp.zeros((), jnp.float32)}
+        for k in range(K):
+            carry0[f"f{k}"] = jnp.zeros(shp[k], dt)         # fwd send (wrapped ring)
+            carry0[f"b{k}"] = jnp.zeros(shp[k], dt)         # bwd dx send (down ring)
+            carry0[f"stash{k}"] = jnp.zeros((n_s[k] + 1,) + shp[k], dt)
+            carry0[f"dw{k}"] = f32(sections[k])
+            if k < K - 1:
+                carry0[f"fm{k}"] = jnp.zeros(shp[k + 1], dt)  # merged send (wrap)
+                carry0[f"dm{k}"] = f32(merges[k])
+        carry0["dhead"] = f32(head_sub)
+        carry0["dxe"] = jnp.zeros((chunks + 1,) + shp[0], jnp.float32)
+
+        def tick(carry, t):
+            rf = [jax.lax.ppermute(carry[f"f{k}"], "pp", ring_wrap) for k in range(K)]
+            rfm = [
+                jax.lax.ppermute(carry[f"fm{k}"], "pp", ring_wrap) for k in range(K - 1)
+            ]
+            rb = [
+                jax.lax.ppermute(carry[f"b{k}"], "pp", ring_wrap_down) for k in range(K)
+            ]
+            new_carry = dict(carry)
+
+            # ---- forwards (stash the section input, send out + merged out)
+            for k in range(K):
+                m_f = t - k * pp - s
+                f_valid = (m_f >= 0) & (m_f < chunks)
+                mf_c = jnp.clip(m_f, 0, chunks - 1)
+                if k == 0:
+                    first_in = jax.lax.dynamic_index_in_dim(emb_mbs, mf_c, keepdims=False)
+                else:
+                    first_in = rfm[k - 1]
+                x_in = jnp.where(is_first, first_in, rf[k])
+                slot = jnp.where(f_valid, jnp.mod(mf_c, n_s[k]), n_s[k])
+                new_carry[f"stash{k}"] = jax.lax.dynamic_update_index_in_dim(
+                    carry[f"stash{k}"], x_in, slot, 0
+                )
+                if k < K - 1:
+                    out, mout = sec_fns_1f1b[k](sections[k], merges[k], x_in)
+                    new_carry[f"fm{k}"] = mout
+                else:
+                    out = sec_fns_1f1b[k](sections[k], x_in)
+                new_carry[f"f{k}"] = out
+
+            # ---- backwards (recompute from the updated stash; the last
+            # device backwards section K-1 of a chunk in the same tick as
+            # its forward — for valid pairs the ring slots never collide)
+            for k in range(K - 1, -1, -1):
+                m_b = t - off[k] + s
+                b_valid = (m_b >= 0) & (m_b < chunks)
+                mb_c = jnp.clip(m_b, 0, chunks - 1)
+                x_saved = jax.lax.dynamic_index_in_dim(
+                    new_carry[f"stash{k}"], jnp.mod(mb_c, n_s[k]), keepdims=False
+                )
+                if k == K - 1:
+                    out_rec, sec_vjp = jax.vjp(sec_fns_1f1b[k], sections[k], x_saved)
+                    labels = jax.lax.dynamic_index_in_dim(
+                        labels_mbs, mb_c, keepdims=False
+                    )
+                    nll, head_vjp, cnt = jax.vjp(
+                        lambda hs, y: _head_loss(hs, y, labels, cfg),
+                        head_sub, out_rec, has_aux=True,
+                    )
+                    head_mask = (is_last & b_valid).astype(jnp.float32)
+                    dhead_mb, dy_head = head_vjp(head_mask * scale / n_static)
+                    dy = jnp.where(is_last, dy_head, rb[k])
+                    dy = jnp.where(b_valid, dy, jnp.zeros_like(dy))
+                    dw_mb, dx = sec_vjp(dy.astype(dt))
+                    new_carry["dhead"] = jax.tree.map(
+                        lambda a, g: a + g.astype(jnp.float32), carry["dhead"], dhead_mb
+                    )
+                    new_carry["loss_sum"] = carry["loss_sum"] + nll * head_mask
+                    new_carry["tok"] = carry["tok"] + cnt * head_mask
+                else:
+                    _, sec_vjp = jax.vjp(
+                        sec_fns_1f1b[k], sections[k], merges[k], x_saved
+                    )
+                    dy_sec = jnp.where(
+                        b_valid & jnp.logical_not(is_last), rb[k],
+                        jnp.zeros_like(rb[k]),
+                    )
+                    dy_mout = jnp.where(
+                        b_valid & is_last, rb[k + 1], jnp.zeros_like(rb[k + 1])
+                    )
+                    dw_mb, dmerge_mb, dx = sec_vjp((dy_sec.astype(dt), dy_mout.astype(dt)))
+                    new_carry[f"dm{k}"] = jax.tree.map(
+                        lambda a, g: a + g.astype(jnp.float32), carry[f"dm{k}"], dmerge_mb
+                    )
+                new_carry[f"dw{k}"] = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), carry[f"dw{k}"], dw_mb
+                )
+                new_carry[f"b{k}"] = dx.astype(dt)
+                if k == 0:
+                    new_carry["dxe"] = jax.lax.dynamic_update_index_in_dim(
+                        carry["dxe"], dx.astype(jnp.float32),
+                        jnp.where(b_valid & is_first, mb_c, chunks), 0,
+                    )
+            return new_carry, None
+
+        carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T_1f1b))
+        stack = lambda tree: jax.tree.map(lambda a: a[None], tree)
+        return (
+            carry["loss_sum"][None],
+            carry["tok"][None],
+            stack([carry[f"dw{k}"] for k in range(K)]),
+            stack([carry[f"dm{k}"] for k in range(K - 1)]),
+            stack(carry["dhead"]),
+            carry["dxe"][None, :chunks],
+        )
+
+    body_1f1b_sm = jax.shard_map(
+        pipeline_body_1f1b,
+        mesh=mesh,
+        in_specs=(P("pp"), P(), P(), P(), P(), P()),
+        out_specs=tuple([P("pp")] * 6),
+        axis_names={"pp"},
+        check_vma=False,
+    )
+
+    def train_step_1f1b(state, batch):
+        params = state["params"]
+        scale = state["scaler"]["scale"] if fp16 else jnp.ones((), jnp.float32)
+        pixels, labels = modeling.split_batch(batch, cfg)
+        head_sub = {"final_norm": params["final_norm"], "head": params["head"]}
+
+        def embed_fn(embed_params):
+            x = modeling.vision_embed(pixels, {"embed": embed_params}, cfg)
+            return constrain(x, mesh, full_spec)
+
+        x, embed_vjp = jax.vjp(embed_fn, params["embed"])
+        emb_mbs = x.reshape(chunks, mb, sec_len[0], sec_c[0])
+        labels_mbs = labels.reshape(chunks, mb)
+
+        loss_s, tok_s, dw_s, dmerge_s, dhead_s, dxe_s = body_1f1b_sm(
+            params["sections"], params["merges"], head_sub, emb_mbs, labels_mbs, scale
+        )
+        loss_sum = loss_s[-1]
+        tok = jnp.maximum(tok_s[-1], 1.0)
+        d_head = jax.tree.map(lambda a: a[-1], dhead_s)
+        # merge grads are nonzero only where the wrap cotangent lands (the
+        # last device) — sum the pp stack, like enc_final_norm in enc-dec
+        d_merge = jax.tree.map(lambda a: a.sum(axis=0), dmerge_s)
+        dxe_full = dxe_s[0].reshape(global_batch_size, sec_len[0], sec_c[0])
+        (d_embed,) = embed_vjp(dxe_full.astype(x.dtype))
+
+        grads: Dict[str, Any] = {
+            "sections": dw_s,
+            "merges": d_merge,
+            "embed": d_embed,
+            "final_norm": d_head["final_norm"],
+            "head": d_head["head"],
+        }
+        gdenom = tok * scale / n_static
+        grads = {k: jax.tree.map(lambda g: g / gdenom, v) for k, v in grads.items()}
+        loss = loss_sum / tok
+
+        if fp16:
+            return apply_update_with_scaler(state, loss, grads, adam, scaler_cfg)
+        new_params, new_opt = adamw_update(params, grads, state["opt"], adam)
+        return {"params": new_params, "opt": new_opt, "step": state["step"] + 1}, loss
+
+    train_step = (
+        train_step_1f1b if hp.pipeline_type == "pipedream_flush" else gpipe_train_step
+    )
 
     def init_state(key):
         params = init_swin_pipeline_params(key, cfg, hp)

@@ -262,10 +262,16 @@ class SearchEngine:
                 else chunks + 2 * pp - 1
             )
         else:
-            p2p_mb = sum(
-                lt.boundary_activation_mb_per_sample for _, lt in swin_groups
-            ) * (global_bsz / chunks) * bf
-            T = chunks + len(swin_groups) * pp - 1
+            bs = [lt.boundary_activation_mb_per_sample for _, lt in swin_groups]
+            Ks = len(swin_groups)
+            if pipeline_type == "pipedream_flush":
+                # per tick: K section-output sends + K-1 merged sends (next
+                # section's size) + K backward dx sends (pipeline_swin.py)
+                p2p_mb = (2.0 * sum(bs) + sum(bs[1:])) * (global_bsz / chunks) * bf
+                T = chunks + 2 * Ks * pp - 2
+            else:
+                p2p_mb = sum(bs) * (global_bsz / chunks) * bf
+                T = chunks + Ks * pp - 1
         return T * (tick_ms + p2p_mb / self.hw.p2p(pp))
 
     # -- single (pp, bsz, chunks, pipeline_type) evaluation ------------------
@@ -305,9 +311,11 @@ class SearchEngine:
                 # T = chunks + 4pp - 2, input-stash ring + section
                 # recompute, bounded memory)
             elif all(cnt % 2 == 0 for _, cnt, _ in groups):
-                if pipeline_type != "gpipe":
-                    self._restrictions.add("section_pipeline_gpipe_only")
-                    return None
+                # both coupled schedules exist for K-section models too:
+                # gpipe (T = chunks + K*pp - 1, autodiff backward) and the
+                # coupled 1F1B (pipeline_swin.py: T = chunks + 2K*pp - 2,
+                # per-section input-stash rings min(chunks, 2(K-k)pp - 1),
+                # per-tick section recompute)
                 swin_groups = [(cnt, lt) for _, cnt, lt in groups]
             else:
                 self._restrictions.add("section_pipeline_odd_pair_count_pp1_only")
@@ -381,6 +389,10 @@ class SearchEngine:
             stash_bound = None
             if multi_type is not None and pipeline_type == "pipedream_flush":
                 stash_bound = (4 * pp - 1) if j < lpe else (2 * pp - 1)
+            elif swin_groups is not None and pipeline_type == "pipedream_flush":
+                # section k's input-stash ring (pipeline_swin.py):
+                # min(chunks, 2(K-k)pp - 1) boundary slots
+                stash_bound = 2 * (len(swin_groups) - pos_sec[j]) * pp - 1
             # coupled 1F1B: every backward tick recomputes its section from
             # the stashed input ONCE regardless of the layer's own ckpt
             # setting — layer_time_cost prices compute at
@@ -388,7 +400,8 @@ class SearchEngine:
             # without inflating the once-per-iteration DP reduction
             recompute = (
                 REMAT_FULL_FACTOR
-                if multi_type is not None and pipeline_type == "pipedream_flush"
+                if (multi_type is not None or swin_groups is not None)
+                and pipeline_type == "pipedream_flush"
                 else None
             )
             for k, s in enumerate(cands):
@@ -437,6 +450,14 @@ class SearchEngine:
             rows = global_bsz / max(1, world // (pp * max(s.tp for s in cands)))
             pf_overhead = (enc_b + dec_b) * rows * ((chunks + 1) / chunks) * fp32x
             pf_overhead += enc_b * (rows / chunks) * (min(chunks, 2 * pp - 1) + 1)
+        elif swin_groups is not None and pipeline_type == "pipedream_flush":
+            # the coupled K-section 1F1B's per-device constant beyond the
+            # per-position stash rings: the dxe fp32 input-cotangent buffer
+            # holds chunks+1 section-0 micro-batch boundaries
+            sec0_b = self._layer_type(0).boundary_activation_mb_per_sample
+            fp32x = 2.0 if self.mp in ("bf16", "fp16") else 1.0
+            rows = global_bsz / max(1, world // (pp * max(s.tp for s in cands)))
+            pf_overhead = sec0_b * rows * ((chunks + 1) / chunks) * fp32x
         for vt, et in pairs:
             other_mb = other_memory_cost(
                 self.costs, world, pp, vocab_tp=vt, embed_dp_type=et,
@@ -484,8 +505,6 @@ class SearchEngine:
 
         if multi_type is not None:
             self._restriction_ok.add("multi_type_pp")
-        elif swin_groups is not None:
-            self._restriction_ok.add("section_pp")
 
         chosen = [cands[k] for k in res]
         if pp > 1:
@@ -544,10 +563,10 @@ class SearchEngine:
                 "pp": pp, "vpp": vpp, "chunks": chunks,
                 "pipeline_type": pipeline_type,
                 "vocab_tp": vocab_tp, "embed_dp_type": embed_dp_type,
-                # includes encdec_1f1b_overhead_mb when that schedule is priced
+                # includes coupled_1f1b_overhead_mb when that schedule is priced
                 "other_memory_mb": float(other_mb),
                 **(
-                    {"encdec_1f1b_overhead_mb": float(pf_overhead)}
+                    {"coupled_1f1b_overhead_mb": float(pf_overhead)}
                     if pf_overhead else {}
                 ),
                 # non-empty => comm terms priced from built-in defaults, not
@@ -595,7 +614,6 @@ class SearchEngine:
     # standing exclusions and always reported once fired)
     _RESTRICTION_CLEARED_BY = {
         "multi_type_pp_needs_chunks_divisible_by_pp": "multi_type_pp",
-        "section_pipeline_gpipe_only": "section_pp",
     }
 
     def _active_restrictions(self) -> List[str]:
@@ -750,7 +768,7 @@ class SearchEngine:
         other_mb = other_memory_cost(
             self.costs, world, pp, vocab_tp=vt, embed_dp_type=et,
             global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,
-        ) + r.details.get("encdec_1f1b_overhead_mb", 0.0)
+        ) + r.details.get("coupled_1f1b_overhead_mb", 0.0)
         budget = self.budget_mb - other_mb
         if budget <= 0:
             return None
@@ -787,15 +805,21 @@ class SearchEngine:
                 [(lte, se, 1)] * div_e[st] + [(ltd, sd, 1)] * div_d[st]
                 for st in range(pp)
             ]
-        elif all(cnt % 2 == 0 for _, cnt, _ in groups) and pipeline_type == "gpipe":
+        elif all(cnt % 2 == 0 for _, cnt, _ in groups):
+            if pipeline_type not in ("gpipe", "pipedream_flush") or chunks % pp:
+                return None
             from galvatron_tpu.parallel.pipeline_swin import _spread_pairs
 
             mode = "swin"
+            Kg = len(groups)
+            pf = pipeline_type == "pipedream_flush"
+            if pf:
+                recompute = REMAT_FULL_FACTOR
             sec_div = [_spread_pairs(cnt // 2, pp) for _, cnt, _ in groups]
             stage_positions = [
                 [
-                    (groups[k][2], None, 2)
-                    for k in range(len(groups))
+                    (groups[k][2], (2 * (Kg - k) * pp - 1) if pf else None, 2)
+                    for k in range(Kg)
                     for _ in range(sec_div[k][st])
                 ]
                 for st in range(pp)

@@ -444,10 +444,10 @@ def test_encdec_search_emits_1f1b_and_trains():
     # budget just above the 1F1B footprint leaves no feasible gpipe and the
     # search emits the 1F1B schedule. (With ckpt allowed, gpipe+full-remat
     # is often lighter than the coupled 1F1B, whose fp32 dx cotangent
-    # buffers are charged via encdec_1f1b_overhead_mb — the search prices
+    # buffers are charged via coupled_1f1b_overhead_mb — the search prices
     # all three and picks the real winner.)
     r_f2 = make_eng(2000.0, allow_ckpt=False).evaluate(2, 64, 64, "pipedream_flush")
-    assert "encdec_1f1b_overhead_mb" in r_f2.details
+    assert "coupled_1f1b_overhead_mb" in r_f2.details
     tight = make_eng(r_f2.memory_mb * 1.05, allow_ckpt=False)
     assert tight.evaluate(2, 64, 64, "gpipe") is None
     r = tight.search([64], max_chunks=64)

@@ -192,6 +192,169 @@ def test_swin_pp2_parity(swin_ref, pp, tp):
     assert len(flat2["layers"]) == 4 and all(l is not None for l in flat2["layers"])
 
 
+def test_swin_1f1b_parity(swin_ref):
+    """The coupled-sections 1F1B (pipedream_flush): hand-written backward
+    with per-section stash rings bounded by the schedule depth — must
+    reproduce the flat single-device trajectory exactly like the
+    gpipe-ordered engine (merge-on-sender placement is numerically identical
+    to the gpipe body's merge-on-consumer; ppermute is exact)."""
+    batches, ref_traj = swin_ref
+    hp = HybridParallelConfig.uniform(
+        4, pp=2, chunks=2, mixed_precision="fp32",
+        pipeline_type="pipedream_flush",
+    )
+    rt = build_runtime(SWIN_CFG, hp, adam=ADAM, global_batch_size=8)
+    flat = modeling.init_model_params(jax.random.key(0), SWIN_CFG)
+    state = rt.init_state_from(flat)
+    losses = []
+    for b in batches:
+        state, loss = rt.train_step(state, b)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, ref_traj, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow  # edge coverage; the pp=2 parity stays default
+def test_swin_1f1b_sections_zero_pair_tp_fp16(swin_ref):
+    """1F1B edge coverage: K=3 sections (chunks=4), pp=4 zero-pair stages,
+    tp=2 composition, and fp16 dynamic scaling — each against the flat
+    trajectory on identical weights."""
+    batches, ref_traj = swin_ref
+    # K=3 sections, chunks > pp
+    cfg3 = SWIN_CFG.replace(num_layers=6, swin_depths=(2, 2, 2))
+    b3 = make_batches(cfg3, seed=3, n=2)
+    ref3 = reference_losses(cfg3, b3)
+    hp3 = HybridParallelConfig.uniform(
+        6, pp=2, chunks=4, mixed_precision="fp32", pipeline_type="pipedream_flush"
+    )
+    rt3 = build_runtime(cfg3, hp3, adam=ADAM, global_batch_size=8)
+    s3 = rt3.init_state_from(modeling.init_model_params(jax.random.key(0), cfg3))
+    l3 = []
+    for b in b3:
+        s3, loss = rt3.train_step(s3, b)
+        l3.append(float(loss))
+    np.testing.assert_allclose(l3, ref3, rtol=2e-4, atol=2e-4)
+    # pp=4 on the 2-pair pyramid: zero-pair (masked) stages in every section
+    hp4 = HybridParallelConfig.uniform(
+        4, pp=4, chunks=4, mixed_precision="fp32", pipeline_type="pipedream_flush"
+    )
+    rt4 = build_runtime(SWIN_CFG, hp4, adam=ADAM, global_batch_size=8)
+    s4 = rt4.init_state_from(modeling.init_model_params(jax.random.key(0), SWIN_CFG))
+    s4, l4 = rt4.train_step(s4, batches[0])
+    np.testing.assert_allclose(float(l4), ref_traj[0], rtol=2e-4, atol=2e-4)
+    # tp=2 composition
+    hpt = HybridParallelConfig.uniform(
+        4, pp=2, tp=2, chunks=2, vocab_tp=2, mixed_precision="fp32",
+        pipeline_type="pipedream_flush",
+    )
+    rtt = build_runtime(SWIN_CFG, hpt, adam=ADAM, global_batch_size=8)
+    st = rtt.init_state_from(modeling.init_model_params(jax.random.key(0), SWIN_CFG))
+    st, lt = rtt.train_step(st, batches[0])
+    np.testing.assert_allclose(float(lt), ref_traj[0], rtol=2e-4, atol=2e-4)
+    # fp16 dynamic scaling
+    hpf = HybridParallelConfig.uniform(
+        4, pp=2, chunks=2, mixed_precision="fp16", pipeline_type="pipedream_flush"
+    )
+    rtf = build_runtime(SWIN_CFG, hpf, adam=ADAM, global_batch_size=8)
+    sf = rtf.init_state_from(modeling.init_model_params(jax.random.key(0), SWIN_CFG))
+    sf, lf = rtf.train_step(sf, batches[0])
+    assert np.isfinite(float(lf)) and abs(float(lf) - ref_traj[0]) < 0.05
+    assert float(sf["scaler"]["scale"]) == 65536.0
+
+
+def test_swin_search_prices_1f1b_and_emits_it_under_tight_budget():
+    """The K-section search prices BOTH coupled schedules (the enc-dec
+    behavior extended to Swin): at equal (pp, bsz, chunks) pipedream_flush
+    must predict LESS activation memory (per-section stash rings
+    min(chunks, 2(K-k)pp - 1) vs act x chunks) at higher-or-equal predicted
+    time (2K*pp - 2 extra ticks + section recompute); with remat disallowed
+    and a budget only the 1F1B fits, search() emits it — and the emitted
+    config trains through the hand-written coupled backward."""
+    from galvatron_tpu.search.cost_model import (
+        ProfiledHardware,
+        ProfiledLayerType,
+        ProfiledModelCosts,
+    )
+    from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+
+    lt0 = ProfiledLayerType(
+        fwd_ms_per_sample=1.0, parameter_mb=10.0,
+        activation_mb_per_sample={1: 8.0, 2: 4.0},
+        boundary_activation_mb_per_sample=1.0,
+    )
+    lt1 = ProfiledLayerType(
+        fwd_ms_per_sample=1.5, parameter_mb=30.0,
+        activation_mb_per_sample={1: 6.0, 2: 3.0},
+        boundary_activation_mb_per_sample=0.5,
+    )
+    costs = ProfiledModelCosts(
+        layer_types={0: lt0, 1: lt0, 2: lt1, 3: lt1},
+        other_param_mb=5.0, other_act_mb_per_sample=1.0,
+        other_fwd_ms_per_sample=0.1,
+    )
+
+    def make_eng(budget, allow_ckpt=True):
+        return SearchEngine(
+            costs, ProfiledHardware(), num_layers=SWIN_CFG.num_layers,
+            space=SearchSpace(world_size=4, pp_choices=[2], max_tp=2,
+                              allow_ckpt=allow_ckpt),
+            memory_budget_mb=budget, mixed_precision="fp32",
+            mem_unit_mb=0.0625, section_pipeline=True,
+        )
+
+    eng = make_eng(2000.0)
+    r_g = eng.evaluate(2, 64, 64, "gpipe")
+    r_f = eng.evaluate(2, 64, 64, "pipedream_flush")
+    assert r_g is not None and r_f is not None
+    assert r_f.config.pipeline_type == "pipedream_flush"
+    assert r_f.memory_mb < r_g.memory_mb  # bounded stash vs act x chunks
+    assert r_f.cost_ms >= r_g.cost_ms  # more ticks + section recompute
+
+    r_f2 = make_eng(2000.0, allow_ckpt=False).evaluate(2, 64, 64, "pipedream_flush")
+    assert r_f2 is not None
+    tight = make_eng(r_f2.memory_mb * 1.05, allow_ckpt=False)
+    assert tight.evaluate(2, 64, 64, "gpipe") is None
+    r = tight.search([64], max_chunks=64)
+    assert r is not None and r.config.pipeline_type == "pipedream_flush"
+
+    rt = build_runtime(SWIN_CFG, r.config, adam=ADAM, global_batch_size=64)
+    state = rt.init_state(jax.random.key(0))
+    b = make_batches(SWIN_CFG, seed=11, n=1, batch=64)[0]
+    losses = []
+    for _ in range(3):
+        state, loss = rt.train_step(state, rt.shard_batch(b))
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+@pytest.mark.slow
+def test_swin_1f1b_activation_footprint_measured():
+    """The per-section stash bound min(chunks, 2(K-k)pp - 1), MEASURED on the
+    compiled program: XLA's memory analysis of the actual train_step shows
+    the 1F1B temp footprint plateaus as chunks grow while the gpipe-ordered
+    autodiff backward grows with chunks (measured on the sim: 1.6M->2.3M
+    [ratio 1.42, batch buffers only] vs 29.7M->80.6M [2.72])."""
+    from galvatron_tpu.core.checkpoint import abstract_state_of
+
+    cfg = SWIN_CFG.replace(image_size=32)  # longer maps so activations dominate
+
+    def temp_bytes(ptype, chunks):
+        hp = HybridParallelConfig.uniform(
+            4, pp=2, chunks=chunks, mixed_precision="fp32", pipeline_type=ptype
+        )
+        rt = build_runtime(cfg, hp, adam=ADAM, global_batch_size=2 * chunks)
+        batch = jax.ShapeDtypeStruct(
+            (2 * chunks, cfg.sample_len + 1), jnp.int32, sharding=rt.batch_sharding
+        )
+        ma = rt.train_step.lower(abstract_state_of(rt), batch).compile().memory_analysis()
+        if ma is None:
+            pytest.skip("memory_analysis unavailable on this backend")
+        return ma.temp_size_in_bytes
+
+    r_1f1b = temp_bytes("pipedream_flush", 16) / temp_bytes("pipedream_flush", 4)
+    r_gpipe = temp_bytes("gpipe", 16) / temp_bytes("gpipe", 4)
+    assert r_1f1b < 2.0 < r_gpipe, (r_1f1b, r_gpipe)
+
+
 @pytest.mark.slow  # edge coverage; the pp=2 parity + constraints stay default
 def test_swin_pp4_zero_pair_stages_and_three_sections(swin_ref):
     """pp wider than a section's pair count leaves zero-pair (masked) stages;
