@@ -263,6 +263,14 @@ def profile_model(
         return _profile_encdec_model(
             cfg, bsz, layernums or (2, 4), measure_time, out_prefix
         )
+    if cfg.swin_depths:
+        if seq is not None or layernums is not None:
+            raise ValueError(
+                "seq/layernums do not apply to swin profiles (the pyramid "
+                "fixes per-section resolutions; the sweep varies section "
+                "depths)"
+            )
+        return _profile_swin_model(cfg, bsz, measure_time, out_prefix)
     seq = seq or cfg.max_seq_len
     adaptive = layernums is None
     l1, l2 = layernums or _default_layernums(cfg.total_layers)
@@ -352,6 +360,80 @@ def profile_model(
         costs.measured_vocab_slope_ms = vslope
         costs.measured_vocab_const_ms = vconst
         costs.measured_vocab_mp = vmp
+    _maybe_save(costs, out_prefix)
+    return costs
+
+
+def _profile_swin_model(
+    cfg: ModelConfig,
+    bsz: int,
+    measure_time: bool,
+    out_prefix: Optional[str],
+) -> ProfiledModelCosts:
+    """Swin difference profile: one layer type PER SECTION from a (K+1)-point
+    sweep — a base pyramid of one PAIR (two layers) per section, then +1
+    pair in section k holding the others fixed (the reference's
+    multi-layer-type layernum launch matrix, core/profiler.py:194-240, for
+    its legacy swin branch; pairs because Swin alternates plain/shifted
+    windows per position parity, models/modeling.py::swin_layer)."""
+    from galvatron_tpu.models.modeling import swin_geometry, vision_layer_cfg
+
+    K = len(cfg.swin_depths)
+
+    def with_depths(d):
+        return cfg.replace(num_layers=sum(d), swin_depths=tuple(d))
+
+    cfg_base = with_depths((2,) * K)
+    var_cfgs = [
+        with_depths(tuple(4 if j == k else 2 for j in range(K))) for k in range(K)
+    ]
+    if measure_time:
+        t_base = _iter_time_ms(cfg_base, bsz, None)
+        t_var = [_iter_time_ms(c, bsz, None) for c in var_cfgs]
+        sec_ms = [max(1e-4, (t - t_base) / 2.0 / bsz / 3.0) for t in t_var]
+        other_ms = max(0.0, (t_base - sum(sec_ms) * 2.0 * 3.0 * bsz) / bsz / 3.0)
+    else:
+        sec_ms = [1.0] * K
+        other_ms = 0.1
+
+    S = cfg.sample_len
+    b_base = _temp_bytes(cfg_base, bsz, S)
+    b_var = [_temp_bytes(c, bsz, S) for c in var_cfgs]
+    base_idx = np.cumsum([0] + list(cfg.swin_depths[:-1]))
+
+    sec_lts = []
+    for k in range(K):
+        h, w, c_k, _ = swin_geometry(cfg, k)
+        S_k = h * w
+        lcfg = vision_layer_cfg(cfg, int(base_idx[k]))
+        if b_base is not None and b_var[k] is not None and b_var[k] > b_base:
+            act_mb = (b_var[k] - b_base) / 2.0 / bsz / 1e6
+        else:
+            act_mb = _act_fallback_mb(lcfg, S_k)
+        curve = {t: float(act_mb / t) for t in (1, 2, 4, 8) if c_k % t == 0}
+        sec_lts.append(
+            ProfiledLayerType(
+                fwd_ms_per_sample=float(sec_ms[k]),
+                parameter_mb=float(layer_param_count(lcfg) * 4 / 1e6),
+                activation_mb_per_sample=curve,
+                boundary_activation_mb_per_sample=float(S_k * c_k * 2 / 1e6),
+            )
+        )
+    layer_types = {}
+    i = 0
+    for k, d in enumerate(cfg.swin_depths):
+        for _ in range(d):
+            layer_types[i] = sec_lts[k]
+            i += 1
+    costs = ProfiledModelCosts(
+        layer_types=layer_types,
+        other_param_mb=float(other_param_count(cfg) * 4 / 1e6),
+        # patch-embedding output dominates "other" activations (cls logits
+        # are tiny) — same structural term the analytic path uses
+        other_act_mb_per_sample=float(cfg.n_patches * cfg.hidden_size * 2 / 1e6),
+        other_fwd_ms_per_sample=float(other_ms),
+        hidden_size=cfg.hidden_size,
+    )
     _maybe_save(costs, out_prefix)
     return costs
 

@@ -249,8 +249,10 @@ class SearchEngine:
         priced in the intra table); three ppermutes per tick — enc out and
         ctx at the encoder boundary size, dec y at the decoder's.
         Swin (pipeline_swin.py): every tick runs one virtual stage of EVERY
-        section; T = chunks + K*pp - 1; each section's output rides its own
-        ring ppermute."""
+        section; T = chunks + K*pp - 1 (gpipe autodiff, K ring ppermutes) or
+        chunks + 2K*pp - 2 (coupled 1F1B: per-tick section recompute priced
+        in the intra table, 3K-1 ring sends — K section outputs + K-1 merged
+        outputs + K backward cotangents)."""
         bf = 0.5 if self.mp in ("bf16", "fp16") else 1.0
         if multi_type is not None:
             enc_b = self._layer_type(0).boundary_activation_mb_per_sample

@@ -240,3 +240,53 @@ def test_multislice_hardware_profile_dcn_keying(tmp_path):
     )
     r2 = eng2.evaluate(2, 16, 2, "gpipe")
     assert r2 is not None and r2.details["fallback_bandwidths"] == []
+
+
+def test_swin_profile_per_section_types_and_search_consume():
+    """The measured profile path covers Swin: a (K+1)-point depth sweep
+    yields one layer type per SECTION (the pyramid makes widths/resolutions
+    section-dependent — the reference's legacy-swin multi-layer-type
+    launch matrix, core/profiler.py:194-240), and the profiled costs feed
+    the K-section search end to end."""
+    import jax.numpy as jnp
+
+    from galvatron_tpu.models.modeling import ModelConfig
+    from galvatron_tpu.profiling.model import profile_model
+    from galvatron_tpu.search.cost_model import ProfiledHardware
+    from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+
+    swin = ModelConfig(
+        vocab_size=1, hidden_size=16, num_layers=4, num_heads=2, max_seq_len=0,
+        pos_embed="learned", norm_type="layernorm", act_fn="gelu", causal=False,
+        objective="cls", image_size=16, patch_size=2, num_classes=16,
+        swin_depths=(2, 2), swin_window=4, dtype=jnp.float32,
+    )
+    costs = profile_model(swin, bsz=8, measure_time=False)
+    assert len(costs.layer_types) == 4
+    lt0, lt1 = costs.layer_types[0], costs.layer_types[2]
+    assert costs.layer_types[1] is lt0 and costs.layer_types[3] is lt1
+    # pyramid structure: resolution quarters / width doubles per section →
+    # boundary halves, params grow ~4x
+    assert abs(
+        lt1.boundary_activation_mb_per_sample
+        - lt0.boundary_activation_mb_per_sample / 2
+    ) < 1e-9
+    assert lt1.parameter_mb > 2 * lt0.parameter_mb
+    # per-section memory is measured (XLA temp-bytes difference), not the
+    # analytic fallback, and the per-tp curve follows the section width
+    assert set(lt0.activation_mb_per_sample) == {1, 2, 4, 8}
+
+    eng = SearchEngine(
+        costs, ProfiledHardware(), num_layers=4,
+        space=SearchSpace(world_size=4, pp_choices=[1, 2], max_tp=2),
+        memory_budget_mb=2000.0, mem_unit_mb=0.0625, section_pipeline=True,
+    )
+    for ptype in ("gpipe", "pipedream_flush"):
+        r = eng.evaluate(2, 16, 4, ptype)
+        assert r is not None and r.config.pp == 2, ptype
+
+    # seq/layernums are pyramid-structural for swin — rejected, not ignored
+    import pytest
+
+    with pytest.raises(ValueError, match="swin"):
+        profile_model(swin, bsz=8, seq=64, measure_time=False)
