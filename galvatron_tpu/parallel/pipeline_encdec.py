@@ -128,11 +128,6 @@ def validate_encdec_pipeline(
     """Schedule constraints + the per-sub-stack stage layout."""
     if hp.vpp > 1:
         raise ValueError("enc-dec pipeline does not compose with vpp>1")
-    if hp.chunks % hp.pp:
-        raise ValueError(
-            f"enc-dec pipeline needs chunks ({hp.chunks}) divisible by "
-            f"pp={hp.pp} (micro-batches flow in groups of pp on the ring)"
-        )
     if hp.pipeline_type not in ("gpipe", "pipedream_flush"):
         raise ValueError(
             f"unknown pipeline_type {hp.pipeline_type!r} for the enc-dec "

@@ -90,11 +90,6 @@ class SwinLayout:
                 f"gpipe and pipedream_flush (1F1B) orderings (got "
                 f"{hp.pipeline_type!r})"
             )
-        if hp.chunks % pp:
-            raise ValueError(
-                f"swin pipeline needs chunks ({hp.chunks}) divisible by "
-                f"pp={pp} (micro-batches flow in groups of pp on the ring)"
-            )
         # the layout derives its per-section divisions from swin_depths; a
         # user-provided pp_division that differs from the auto-filled
         # balanced default is rejected instead of silently ignored (the

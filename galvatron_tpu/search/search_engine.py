@@ -160,13 +160,13 @@ class SearchEngine:
         # structural bail-outs that fired during the last sweep (multi-type
         # schedule/shape classes the engines cannot realize) — written into
         # the emitted config as `search_restrictions` the way
-        # fallback_bandwidths already labels unmeasured bandwidths. A tag is
-        # dropped when its class nonetheless produced feasible pp>1 results
-        # in the same sweep (e.g. chunks=1 grid points always trip the
-        # divisibility bail; that is not a degradation when chunks=2.. were
-        # searched), so a present tag means the class was REALLY excluded.
+        # fallback_bandwidths already labels unmeasured bandwidths. Every
+        # remaining tag is a standing exclusion (interleaved vpp for
+        # multi-type, odd section pair counts), so a fired tag is always
+        # reported. (The former chunks-divisibility tag — the one case a
+        # later grid point could "clear" — is gone: the coupled engines run
+        # any chunk count.)
         self._restrictions: set = set()
-        self._restriction_ok: set = set()
         # True = multi-type groups are a vision pyramid (pipeline_swin's
         # K-section pair-stacked engine) even at K=2 — a 2-stage Swin profile
         # is otherwise indistinguishable from an enc-dec one (the CLI sets
@@ -293,11 +293,9 @@ class SearchEngine:
             # enc-dec coupled sub-pipelines (parallel/pipeline_encdec.py,
             # ragged counts via per-sub-stack padded divisions); K>2 groups
             # with even counts ride the K-section pair-stacked pipeline
-            # (parallel/pipeline_swin.py). Both gpipe-ordered, chunks % pp.
+            # (parallel/pipeline_swin.py); any chunk count (ring alignment
+            # is per-chunk — measured parity at chunks % pp != 0).
             groups = self._type_groups()
-            if chunks % pp:
-                self._restrictions.add("multi_type_pp_needs_chunks_divisible_by_pp")
-                return None
             if vpp > 1:
                 self._restrictions.add("multi_type_pp_no_interleaved_vpp")
                 return None
@@ -505,9 +503,6 @@ class SearchEngine:
             return None
         total_ms, res, mem_used, vocab_tp, embed_dp_type, other_mb = best
 
-        if multi_type is not None:
-            self._restriction_ok.add("multi_type_pp")
-
         chosen = [cands[k] for k in res]
         if pp > 1:
             # same per-position pattern in every (virtual) stage; uneven
@@ -583,7 +578,6 @@ class SearchEngine:
         """Yield every feasible SearchResult in the (bsz, pp, chunks,
         schedule, vpp) sweep."""
         self._restrictions.clear()
-        self._restriction_ok.clear()
         pps = self.space.pp_choices or [
             p for p in _pow2s(self.space.world_size) if p <= self.L
         ]
@@ -594,9 +588,13 @@ class SearchEngine:
                     for ptype in self.space.pipeline_types if pp > 1 else ("gpipe",):
                         vpps = [1]
                         if pp > 1:
-                            vpps = [
+                            # the L % (pp*vpp) constraint is interleaving's
+                            # (strategy.validate) — vpp=1 must stay in the
+                            # sweep for ANY L: evaluate() handles uneven
+                            # divisions via pp_division_memory_balanced
+                            vpps = [1] + [
                                 v for v in _pow2s(self.space.max_vpp)
-                                if self.L % (pp * v) == 0
+                                if v > 1 and self.L % (pp * v) == 0
                             ]
                         for vpp in vpps:
                             r = self.evaluate(pp, bsz, chunks, ptype, vpp=vpp)
@@ -612,17 +610,8 @@ class SearchEngine:
                                 )
                             yield r
 
-    # which sweep success unclears a fired tag (tags absent here are
-    # standing exclusions and always reported once fired)
-    _RESTRICTION_CLEARED_BY = {
-        "multi_type_pp_needs_chunks_divisible_by_pp": "multi_type_pp",
-    }
-
     def _active_restrictions(self) -> List[str]:
-        return sorted(
-            t for t in self._restrictions
-            if self._RESTRICTION_CLEARED_BY.get(t) not in self._restriction_ok
-        )
+        return sorted(self._restrictions)
 
     def search_topk(
         self, global_bsz_list: Sequence[int], k: int, max_chunks: int = 64,
@@ -790,7 +779,7 @@ class SearchEngine:
             lps = -(-self.L // pp)
             stage_positions = [[(lt0, None, 1)] * lps for _ in range(pp)]
         elif len(groups) == 2 and not self.section_pipeline:
-            if pipeline_type not in ("gpipe", "pipedream_flush") or chunks % pp:
+            if pipeline_type not in ("gpipe", "pipedream_flush"):
                 return None
             from galvatron_tpu.core.strategy import balanced_division
 
@@ -808,7 +797,7 @@ class SearchEngine:
                 for st in range(pp)
             ]
         elif all(cnt % 2 == 0 for _, cnt, _ in groups):
-            if pipeline_type not in ("gpipe", "pipedream_flush") or chunks % pp:
+            if pipeline_type not in ("gpipe", "pipedream_flush"):
                 return None
             from galvatron_tpu.parallel.pipeline_swin import _spread_pairs
 

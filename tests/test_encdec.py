@@ -82,10 +82,10 @@ def test_encdec_rejects_cp_and_bad_pipeline_shapes():
     hp2 = HybridParallelConfig.uniform(4, cp=2, mixed_precision="fp32")
     with pytest.raises(ValueError, match="enc-dec"):
         build_runtime(T5, hp2, adam=AdamConfig(), global_batch_size=8)
-    # pipeline constraints: chunks must flow in groups of pp
+    # ANY chunk count is legal (ring alignment is per-chunk) — the former
+    # chunks % pp requirement was vestigial; chunks=1 at pp=2 builds
     hp3 = HybridParallelConfig.uniform(4, pp=2, chunks=1, mixed_precision="fp32")
-    with pytest.raises(ValueError, match="chunks"):
-        build_runtime(T5, hp3, adam=AdamConfig(), global_batch_size=8)
+    build_runtime(T5, hp3, adam=AdamConfig(), global_batch_size=8)
     # sub-stacks smaller than pp are legal (zero-layer masked stages) — only
     # an EMPTY stack is rejected
     from galvatron_tpu.parallel.pipeline_encdec import validate_encdec_pipeline
@@ -510,3 +510,42 @@ def test_encdec_small_encoder_stack_below_pp():
     s4 = rt4.init_state(jax.random.key(1))
     s4, l4 = rt4.train_step(s4, rt4.shard_batch(b))
     assert np.isfinite(float(l4))
+
+
+def test_encdec_any_chunks_parity():
+    """The coupled engines run ANY chunk count — ring alignment is per-chunk
+    (chunk m's section-k output wraps into device 0 exactly at its
+    section-(k+1) slot for every m), so the former chunks % pp requirement
+    was vestigial. Train-trajectory parity at chunks=3 and chunks=1 on pp=2,
+    both schedules, against the flat single-device AdamW loop."""
+    from galvatron_tpu.core.optim import adamw_update, init_opt_state
+
+    flat = modeling.init_model_params(jax.random.key(0), T5)
+    rng = np.random.RandomState(7)
+    batches = [
+        jnp.asarray(rng.randint(0, 128, (24, T5.sample_len + 1)), jnp.int32)
+        for _ in range(2)
+    ]
+    adam = AdamConfig(lr=1e-3)
+    params, opt = flat, init_opt_state(flat)
+    step = jax.jit(jax.value_and_grad(lambda p, b: modeling.lm_loss(p, b, T5)))
+    ref = []
+    for b in batches:
+        loss, grads = step(params, b)
+        params, opt = adamw_update(params, grads, opt, adam)
+        ref.append(float(loss))
+    for chunks, ptype in [(3, "gpipe"), (3, "pipedream_flush"), (1, "pipedream_flush")]:
+        hp = HybridParallelConfig.uniform(
+            T5.total_layers, pp=2, chunks=chunks, mixed_precision="fp32",
+            pipeline_type=ptype,
+        )
+        rt = build_runtime(T5, hp, adam=adam, global_batch_size=24)
+        st = rt.init_state_from(flat)
+        losses = []
+        for b in batches:
+            st, loss = rt.train_step(st, rt.shard_batch(b))
+            losses.append(float(loss))
+        np.testing.assert_allclose(
+            losses, ref, rtol=2e-4, atol=2e-4,
+            err_msg=f"chunks={chunks} {ptype}",
+        )

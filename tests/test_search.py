@@ -414,11 +414,42 @@ def test_recommend_min_bsz_prunes_sweep():
 
 def test_search_restrictions_labeled_in_saved_config(tmp_path):
     """When a structural bail-out silently narrows the sweep (e.g. a
-    multi-type model at pp>1 with chunks not divisible by pp), the emitted
+    K=3-section model whose group counts cannot pair-stack), the emitted
     config JSON records it in `search_restrictions` — the same provenance
-    labeling fallback_bandwidths gives unmeasured bandwidths."""
+    labeling fallback_bandwidths gives unmeasured bandwidths. (The former
+    chunks-divisibility trigger is gone: the coupled engines run ANY chunk
+    count — ring alignment is per-chunk, measured parity at chunks=3/pp=2.)"""
     import json
 
+    from galvatron_tpu.search.cost_model import ProfiledLayerType, ProfiledModelCosts
+
+    def lt(ms):
+        return ProfiledLayerType(
+            fwd_ms_per_sample=ms, parameter_mb=10.0,
+            activation_mb_per_sample={1: 8.0}, boundary_activation_mb_per_sample=1.0,
+        )
+
+    # 3 layer-type groups with ODD counts: not an enc-dec pair, cannot
+    # pair-stack as sections — pp>1 is structurally excluded
+    costs3 = ProfiledModelCosts(
+        layer_types={0: lt(1.0), 1: lt(1.5), 2: lt(2.0)},
+        other_param_mb=5.0, other_act_mb_per_sample=1.0,
+        other_fwd_ms_per_sample=0.1,
+    )
+    eng = SearchEngine(
+        costs3, ProfiledHardware(), num_layers=3,
+        space=SearchSpace(world_size=4, pp_choices=[1, 2], max_tp=1),
+        memory_budget_mb=2000.0, mixed_precision="fp32",
+    )
+    r = eng.search([8], max_chunks=4)
+    assert r is not None and r.config.pp == 1
+    out = tmp_path / "cfg.json"
+    eng.save_result(r, str(out))
+    d = json.loads(out.read_text())
+    assert "section_pipeline_odd_pair_count_pp1_only" in d["search_restrictions"]
+
+    # an enc-dec 2-group model searches pp>1 across the whole chunk grid
+    # (incl. chunks=1 and chunks not divisible by pp) — no restriction fires
     from galvatron_tpu.models.modeling import ModelConfig
     from galvatron_tpu.profiling.model import profile_model
 
@@ -428,23 +459,14 @@ def test_search_restrictions_labeled_in_saved_config(tmp_path):
         tie_word_embeddings=True,
     )
     costs = profile_model(cfg, bsz=8, measure_time=False)
-    eng = SearchEngine(
+    eng2 = SearchEngine(
         costs, ProfiledHardware(), num_layers=cfg.total_layers,
         space=SearchSpace(world_size=4, pp_choices=[1, 2], max_tp=1),
         memory_budget_mb=2000.0, mixed_precision="fp32",
     )
-    # max_chunks=1: every pp=2 multi-type evaluation bails on chunks % pp
-    # and NO multi-type pp>1 config exists — the class was really excluded
-    r = eng.search([8], max_chunks=1)
-    assert r is not None and r.config.pp == 1
-    out = tmp_path / "cfg.json"
-    eng.save_result(r, str(out))
-    d = json.loads(out.read_text())
-    assert "multi_type_pp_needs_chunks_divisible_by_pp" in d["search_restrictions"]
-    # a full sweep still trips the chunks=1 grid point, but pp>1 multi-type
-    # configs DID search — the tag is cleared, no field written
-    r2 = eng.search([8], max_chunks=8)
-    eng.save_result(r2, str(out))
+    assert eng2.evaluate(2, 8, 1, "gpipe") is not None  # chunks=1 at pp=2
+    r2 = eng2.search([8], max_chunks=8)
+    eng2.save_result(r2, str(out))
     assert "search_restrictions" not in json.loads(out.read_text())
 
 
@@ -484,3 +506,26 @@ def test_homogeneity_gap_multi_type_zero_by_construction():
     )
     g = eng.homogeneity_gap(4, 64, 16, "gpipe")
     assert g is not None and abs(g["delta_pct"]) < 1e-6, g
+
+
+def test_sweep_searches_uneven_layer_counts_at_vpp1():
+    """Regression: the sweep's interleaving divisibility filter
+    (L % (pp*vpp) == 0) must not exclude vpp=1 — evaluate() supports uneven
+    divisions via pp_division_memory_balanced, but the sweep never reached
+    pp=2 for L=3 (any L % pp != 0)."""
+    lt = ProfiledLayerType(
+        fwd_ms_per_sample=1.0, parameter_mb=10.0,
+        activation_mb_per_sample={1: 8.0}, boundary_activation_mb_per_sample=1.0,
+    )
+    costs = ProfiledModelCosts(
+        layer_types={0: lt}, other_param_mb=5.0, other_act_mb_per_sample=1.0,
+        other_fwd_ms_per_sample=0.1,
+    )
+    eng = SearchEngine(
+        costs, ProfiledHardware(), num_layers=3,
+        space=SearchSpace(world_size=4, pp_choices=[2], max_tp=1, max_vpp=2),
+        memory_budget_mb=2000.0, mixed_precision="fp32",
+    )
+    r = eng.search([8], max_chunks=4)
+    assert r is not None and r.config.pp == 2 and r.config.vpp == 1
+    assert sorted(r.config.pp_division) == [1, 2]

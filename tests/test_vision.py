@@ -548,3 +548,22 @@ def test_swin_search_emits_pp2_and_runtime_trains():
     b = make_batches(SWIN_CFG, seed=5, n=1)[0]
     state, loss = rt.train_step(state, b)
     assert np.isfinite(float(loss))
+
+
+@pytest.mark.slow  # the enc-dec any-chunks test is the default-suite guard
+def test_swin_any_chunks_parity(swin_ref):
+    """chunks % pp lifted for the K-section engine too (same per-chunk ring
+    alignment argument as enc-dec): trajectory parity at chunks=3, pp=2."""
+    batches = make_batches(SWIN_CFG, n=2, batch=24)
+    ref = reference_losses(SWIN_CFG, batches)
+    for ptype in ("gpipe", "pipedream_flush"):
+        hp = HybridParallelConfig.uniform(
+            4, pp=2, chunks=3, mixed_precision="fp32", pipeline_type=ptype
+        )
+        rt = build_runtime(SWIN_CFG, hp, adam=ADAM, global_batch_size=24)
+        st = rt.init_state_from(modeling.init_model_params(jax.random.key(0), SWIN_CFG))
+        losses = []
+        for b in batches:
+            st, loss = rt.train_step(st, b)
+            losses.append(float(loss))
+        np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-4, err_msg=ptype)
