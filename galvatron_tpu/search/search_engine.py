@@ -173,6 +173,30 @@ class SearchEngine:
         # this from cfg.swin_depths)
         self.section_pipeline = section_pipeline
 
+    def _ring_mb(
+        self, lt: ProfiledLayerType, s: LayerStrategy, slots: int,
+        world: int, pp: int, global_bsz: int, chunks: int,
+        stage_idx: int = 0, vpp: int = 1,
+    ) -> float:
+        """Per-device MB of ONE coupled-1F1B input-stash ring of ``slots``
+        boundary micro-batch slots, priced at strategy ``s`` (which
+        approximates the section input's sharding). Isolated as the
+        difference of layer_memory_cost at bounds (slots, 0) so the formula
+        stays the cost model's — the states terms cancel exactly."""
+        if not slots:
+            return 0.0
+        kw = dict(
+            stage_idx=stage_idx, pipeline_type="pipedream_flush",
+            mixed_precision=self.mp, vpp=vpp,
+        )
+        hi = layer_memory_cost(
+            lt, s, world, pp, global_bsz, chunks, stash_boundary_bound=slots, **kw
+        ).total_mb
+        lo = layer_memory_cost(
+            lt, s, world, pp, global_bsz, chunks, stash_boundary_bound=0, **kw
+        ).total_mb
+        return hi - lo
+
     def _layer_type(self, i: int) -> ProfiledLayerType:
         lts = self.costs.layer_types
         return lts.get(i, lts[0]) if len(lts) > 1 else lts[0]
@@ -383,16 +407,23 @@ class SearchEngine:
         intra = np.zeros((n_pos, S), np.float64)
         for j in range(n_pos):
             lt = pos_lt(j)
-            # coupled enc-dec 1F1B: input-stash ring bounds from the
-            # schedule (pipeline_encdec.py: enc min(chunks, 4pp-1),
-            # dec/ctx min(chunks, 2pp-1))
-            stash_bound = None
+            # coupled 1F1B input-stash rings (pipeline_encdec.py: enc
+            # min(chunks, 4pp-1), dec/ctx 2pp-1; pipeline_swin.py: section
+            # k min(chunks, 2(K-k)pp - 1)) are PER SECTION, not per
+            # position: the ring charges only the group's FIRST position
+            # (whose strategy approximates the section input's sharding);
+            # later positions keep one live micro-batch
+            # (stash_boundary_bound=0 bypasses the single-stack in-flight
+            # bound without adding ring slots)
+            stash_bound, ring = None, 0
             if multi_type is not None and pipeline_type == "pipedream_flush":
-                stash_bound = (4 * pp - 1) if j < lpe else (2 * pp - 1)
+                stash_bound = 0
+                if j in (0, lpe):
+                    ring = (4 * pp - 1) if j < lpe else (2 * pp - 1)
             elif swin_groups is not None and pipeline_type == "pipedream_flush":
-                # section k's input-stash ring (pipeline_swin.py):
-                # min(chunks, 2(K-k)pp - 1) boundary slots
-                stash_bound = 2 * (len(swin_groups) - pos_sec[j]) * pp - 1
+                stash_bound = 0
+                if j == 0 or pos_sec[j] != pos_sec[j - 1]:
+                    ring = 2 * (len(swin_groups) - pos_sec[j]) * pp - 1
             # coupled 1F1B: every backward tick recomputes its section from
             # the stashed input ONCE regardless of the layer's own ckpt
             # setting — layer_time_cost prices compute at
@@ -411,10 +442,13 @@ class SearchEngine:
                     vpp=vpp, stash_boundary_bound=stash_bound,
                 )
                 # a device holds vpp layers per searched position
-                # (interleaved) or 2 (swin pairs)
-                mem[j, k] = max(
-                    1, int(np.ceil(pos_layers * vpp * mc.total_mb / self.unit))
+                # (interleaved) or 2 (swin pairs); the ring term is
+                # per-section and does NOT scale with the position's layer
+                # multiplicity
+                total_mb = pos_layers * vpp * mc.total_mb + self._ring_mb(
+                    lt, s, ring, world, pp, global_bsz, chunks, vpp=vpp
                 )
+                mem[j, k] = max(1, int(np.ceil(total_mb / self.unit)))
                 intra[j, k] = pos_layers * layer_time_cost(
                     lt, s, self.hw, world, pp, global_bsz, mixed_precision=self.mp,
                     recompute_factor=recompute,
@@ -774,10 +808,28 @@ class SearchEngine:
         # per-stage position descriptors: (layer_type, stash_bound, layers)
         groups = self._type_groups()
         recompute = None
+        # position entries are (layer_type, stash_flag, n_layers, rings);
+        # rings = ((ring_layer_type, slots), ...) charged at that position.
+        # Under the coupled 1F1B the SPMD scan carry allocates EVERY
+        # section's ring on EVERY device — including stages holding zero
+        # layers of that section — so each stage charges every group's
+        # ring: at the group's first position on that stage when it has
+        # one, else at the stage's first position (a fully idle stage runs
+        # only padding and is not priced — it chooses no strategy).
+        def attach_rings(poss, gids, ring_list):
+            out = [[lt_, stash_, n_, []] for (lt_, stash_, n_) in poss]
+            if out and ring_list:
+                first = {}
+                for j, g in enumerate(gids):
+                    first.setdefault(g, j)
+                for g, ring in enumerate(ring_list):
+                    out[first.get(g, 0)][3].append(ring)
+            return [(a, b, c, tuple(r)) for a, b, c, r in out]
+
         if len(groups) == 1:
             mode = "single"
             lps = -(-self.L // pp)
-            stage_positions = [[(lt0, None, 1)] * lps for _ in range(pp)]
+            stage_positions = [[(lt0, None, 1, ())] * lps for _ in range(pp)]
         elif len(groups) == 2 and not self.section_pipeline:
             if pipeline_type not in ("gpipe", "pipedream_flush"):
                 return None
@@ -788,12 +840,16 @@ class SearchEngine:
             div_e, div_d = balanced_division(E, pp), balanced_division(D, pp)
             lte, ltd = self._layer_type(0), self._layer_type(E)
             pf = pipeline_type == "pipedream_flush"
-            se = (4 * pp - 1) if pf else None
-            sd = (2 * pp - 1) if pf else None
             if pf:
                 recompute = REMAT_FULL_FACTOR
+            stash = 0 if pf else None
+            ring_list = [(lte, 4 * pp - 1), (ltd, 2 * pp - 1)] if pf else []
             stage_positions = [
-                [(lte, se, 1)] * div_e[st] + [(ltd, sd, 1)] * div_d[st]
+                attach_rings(
+                    [(lte, stash, 1)] * div_e[st] + [(ltd, stash, 1)] * div_d[st],
+                    [0] * div_e[st] + [1] * div_d[st],
+                    ring_list,
+                )
                 for st in range(pp)
             ]
         elif all(cnt % 2 == 0 for _, cnt, _ in groups):
@@ -807,12 +863,21 @@ class SearchEngine:
             if pf:
                 recompute = REMAT_FULL_FACTOR
             sec_div = [_spread_pairs(cnt // 2, pp) for _, cnt, _ in groups]
+            stash = 0 if pf else None
+            ring_list = (
+                [(groups[k][2], 2 * (Kg - k) * pp - 1) for k in range(Kg)]
+                if pf else []
+            )
             stage_positions = [
-                [
-                    (groups[k][2], (2 * (Kg - k) * pp - 1) if pf else None, 2)
-                    for k in range(Kg)
-                    for _ in range(sec_div[k][st])
-                ]
+                attach_rings(
+                    [
+                        (groups[k][2], stash, 2)
+                        for k in range(Kg)
+                        for _ in range(sec_div[k][st])
+                    ],
+                    [k for k in range(Kg) for _ in range(sec_div[k][st])],
+                    ring_list,
+                )
                 for st in range(pp)
             ]
         else:
@@ -834,18 +899,26 @@ class SearchEngine:
 
         mem_rows: Dict[tuple, np.ndarray] = {}
 
-        def mem_row(lt, stash, n_lay, st) -> np.ndarray:
-            key = (id(lt), stash, n_lay, st)
+        def mem_row(lt, stash, n_lay, st, rings) -> np.ndarray:
+            key = (id(lt), stash, n_lay, st, tuple((id(r), n) for r, n in rings))
             if key not in mem_rows:
+                def total(s):
+                    mc = layer_memory_cost(
+                        lt, s, world, pp, global_bsz, chunks, stage_idx=st,
+                        pipeline_type=pipeline_type, mixed_precision=self.mp,
+                        stash_boundary_bound=stash,
+                    ).total_mb
+                    # rings are per-section, charged once (evaluate() rule)
+                    return n_lay * mc + sum(
+                        self._ring_mb(
+                            rlt, s, slots, world, pp, global_bsz, chunks,
+                            stage_idx=st,
+                        )
+                        for rlt, slots in rings
+                    )
+
                 mem_rows[key] = np.array([
-                    max(1, int(np.ceil(
-                        n_lay * layer_memory_cost(
-                            lt, s, world, pp, global_bsz, chunks, stage_idx=st,
-                            pipeline_type=pipeline_type, mixed_precision=self.mp,
-                            stash_boundary_bound=stash,
-                        ).total_mb / self.unit
-                    )))
-                    for s in cands
+                    max(1, int(np.ceil(total(s) / self.unit))) for s in cands
                 ], np.int32)
             return mem_rows[key]
 
@@ -859,9 +932,9 @@ class SearchEngine:
             n_pos = len(poss)
             mem = np.zeros((n_pos, S), np.int32)
             intra = np.zeros((n_pos, S), np.float64)
-            for j, (lt, stash, n_lay) in enumerate(poss):
+            for j, (lt, stash, n_lay, rings) in enumerate(poss):
                 intra[j] = intra_row(lt) * n_lay
-                mem[j] = mem_row(lt, stash, n_lay, st)
+                mem[j] = mem_row(lt, stash, n_lay, st, rings)
             cost, res, _ = run_dp(mem, intra, inter, V)
             if not np.isfinite(cost) or (res < 0).any():
                 return None
@@ -912,10 +985,14 @@ class SearchEngine:
                 f"{'total MB':>8} | {'time ms':>8}"
             )
             # same stash-ring pricing evaluate() applies to the coupled
-            # enc-dec 1F1B (enc group stashes 4pp-1 slots, dec 2pp-1)
+            # 1F1B schedules: enc-dec groups stash 4pp-1 / 2pp-1 slots,
+            # K-section (swin) groups 2(K-gi)pp - 1
             stash_bound = None
-            if len(groups) == 2 and pp > 1 and pipeline_type == "pipedream_flush":
-                stash_bound = (4 * pp - 1) if gi == 0 else (2 * pp - 1)
+            if pp > 1 and pipeline_type == "pipedream_flush" and len(groups) > 1:
+                if len(groups) == 2 and not self.section_pipeline:
+                    stash_bound = (4 * pp - 1) if gi == 0 else (2 * pp - 1)
+                else:
+                    stash_bound = 2 * (len(groups) - gi) * pp - 1
             for s in cands:
                 dp = world // (pp * s.tp * s.cp)
                 mc = layer_memory_cost(
