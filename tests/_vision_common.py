@@ -1,0 +1,25 @@
+"""Shared vision-test fixtures: the tiny Swin pyramid config and the
+pixel‖label cls batch builder (one definition — test_vision, test_profiling
+and test_checkpoint previously carried copies that could drift)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from galvatron_tpu.models.modeling import ModelConfig
+
+SWIN_TINY = ModelConfig(
+    vocab_size=1, hidden_size=16, num_layers=4, num_heads=2, max_seq_len=0,
+    pos_embed="learned", norm_type="layernorm", act_fn="gelu", causal=False,
+    objective="cls", image_size=16, patch_size=2, num_classes=16,
+    swin_depths=(2, 2), swin_window=4, dtype=jnp.float32,
+)
+
+
+def make_vision_batches(cfg: ModelConfig, seed=0, n=3, batch=8):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        pixels = rng.randint(0, 256, (batch, cfg.sample_len), np.int32)
+        labels = rng.randint(0, cfg.num_classes, (batch, 1), np.int32)
+        out.append(jnp.asarray(np.concatenate([pixels, labels], 1)))
+    return out

@@ -109,3 +109,50 @@ def test_portable_checkpoint_cross_layout_resume(tmp_path):
         st2, _ = rt.train_step(restored, batch)
         st2, l2 = rt.train_step(st2, batch)
         assert np.isfinite(float(l2)) and float(l2) < ref_loss
+
+
+def test_portable_checkpoint_swin_cross_schedule_resume(tmp_path):
+    """The K-section engines save the same flat-layers portable layout in
+    both schedule orderings: a Swin run trained under the coupled 1F1B
+    resumes under gpipe (and flat pp=1) with the exact eval loss."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from galvatron_tpu.core.checkpoint import (
+        restore_checkpoint_portable,
+        save_checkpoint_portable,
+    )
+    from galvatron_tpu.core.optim import AdamConfig
+    from galvatron_tpu.core.strategy import HybridParallelConfig
+    from galvatron_tpu.models.modeling import ModelConfig
+    from galvatron_tpu.parallel.hybrid import build_runtime
+
+    from _vision_common import SWIN_TINY as cfg, make_vision_batches
+
+    adam = AdamConfig(lr=1e-3)
+    batch = make_vision_batches(cfg, seed=0, n=1)[0]
+
+    def rt_for(**kw):
+        hp = HybridParallelConfig.uniform(4, mixed_precision="fp32", **kw)
+        return build_runtime(cfg, hp, adam=adam, global_batch_size=8)
+
+    src = rt_for(pp=2, chunks=2, pipeline_type="pipedream_flush")
+    state = src.init_state(jax.random.key(0))
+    for _ in range(2):
+        state, _ = src.train_step(state, batch)
+    ref_loss = float(src.eval_loss(state, batch))
+    ck = str(tmp_path / "portable_swin")
+    save_checkpoint_portable(ck, state, 2, src)
+
+    for name, rt in {
+        "gpipe_pp2": rt_for(pp=2, chunks=2, pipeline_type="gpipe"),
+        "pp1": rt_for(tp=2, vocab_tp=2),
+    }.items():
+        restored = restore_checkpoint_portable(ck, rt, step=2)
+        assert int(np.asarray(restored["step"])) == 2
+        got = float(rt.eval_loss(restored, batch))
+        np.testing.assert_allclose(got, ref_loss, rtol=3e-5, atol=3e-5, err_msg=name)
+        st2, _ = rt.train_step(restored, batch)
+        st2, l2 = rt.train_step(st2, batch)
+        assert np.isfinite(float(l2)) and float(l2) < ref_loss

@@ -255,12 +255,7 @@ def test_swin_profile_per_section_types_and_search_consume():
     from galvatron_tpu.search.cost_model import ProfiledHardware
     from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
 
-    swin = ModelConfig(
-        vocab_size=1, hidden_size=16, num_layers=4, num_heads=2, max_seq_len=0,
-        pos_embed="learned", norm_type="layernorm", act_fn="gelu", causal=False,
-        objective="cls", image_size=16, patch_size=2, num_classes=16,
-        swin_depths=(2, 2), swin_window=4, dtype=jnp.float32,
-    )
+    from _vision_common import SWIN_TINY as swin
     costs = profile_model(swin, bsz=8, measure_time=False)
     assert len(costs.layer_types) == 4
     lt0, lt1 = costs.layer_types[0], costs.layer_types[2]

@@ -24,24 +24,10 @@ VIT_CFG = ModelConfig(
     objective="cls", image_size=16, patch_size=4, num_classes=16,
     dtype=jnp.float32,
 )
-SWIN_CFG = ModelConfig(
-    vocab_size=1, hidden_size=16, num_layers=4, num_heads=2, max_seq_len=0,
-    pos_embed="learned", norm_type="layernorm", act_fn="gelu", causal=False,
-    objective="cls", image_size=16, patch_size=2, num_classes=16,
-    swin_depths=(2, 2), swin_window=4, dtype=jnp.float32,
-)
+from _vision_common import SWIN_TINY as SWIN_CFG, make_vision_batches as make_batches
+
 ADAM = AdamConfig(lr=1e-3, grad_clip=1.0)
 STEPS = 3
-
-
-def make_batches(cfg, seed=0, n=STEPS, batch=8):
-    rng = np.random.RandomState(seed)
-    out = []
-    for _ in range(n):
-        pixels = rng.randint(0, 256, (batch, cfg.sample_len), np.int32)
-        labels = rng.randint(0, cfg.num_classes, (batch, 1), np.int32)
-        out.append(jnp.asarray(np.concatenate([pixels, labels], 1)))
-    return out
 
 
 def reference_losses(cfg, batches):
