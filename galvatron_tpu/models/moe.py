@@ -15,6 +15,15 @@ dimension over the ``ep`` mesh axes makes XLA insert the all-to-all that
 Megatron's expert-parallel ``gather_from_sequence_parallel_region`` hand
 codes. Tokens overflowing an expert's capacity pass through on the residual
 path (standard switch-transformer semantics).
+
+Router normalization is batch-dependent (sinkhorn balances over the routed
+token group), so micro-batched execution — pipeline engines and chunked
+accumulation route per micro-batch — yields slightly different assignments
+than one full-batch forward (measured ~0.2% on a tiny model's eval loss at
+chunks=2). This is inherent to capacity-style MoE under micro-batching (the
+reference's SwitchMLP normalizes per forward call the same way), not an
+engine discrepancy: at chunks=1 the pipeline path is exact against the flat
+model (pinned in test_moe.py::test_moe_pipeline_parallel_parity).
 """
 
 from __future__ import annotations

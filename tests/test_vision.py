@@ -27,7 +27,6 @@ VIT_CFG = ModelConfig(
 from _vision_common import SWIN_TINY as SWIN_CFG, make_vision_batches as make_batches
 
 ADAM = AdamConfig(lr=1e-3, grad_clip=1.0)
-STEPS = 3
 
 
 def reference_losses(cfg, batches):
