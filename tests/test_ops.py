@@ -474,3 +474,31 @@ def test_flash_non_causal_matches_reference():
     cfg = ModelConfig(num_heads=2, hidden_size=64, causal=False)
     ref = modeling.attention_xla(q, k, v, cfg)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["ring", "a2a"])
+def test_cp_composes_with_pipeline_parallelism(impl):
+    """cp=2 layers under pp=2 (chunks=2) reproduce the flat single-device
+    AdamW trajectory on identical weights — context parallelism composes
+    with the pipeline engines, both implementations (the fix that pinned
+    the attention-context sharding inside the pipelined stage fns)."""
+    from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+    from galvatron_tpu.parallel.hybrid import build_runtime
+    from tests.test_hybrid_runtime import ADAM, CFG, make_batches, reference_losses
+
+    batches = make_batches()
+    flat = modeling.init_model_params(jax.random.key(0), CFG)
+    ref = reference_losses(CFG, batches)
+
+    hp = HybridParallelConfig(
+        pp=2, chunks=2,
+        layer_strategies=[LayerStrategy(cp=2, cp_impl=impl)] * 4,
+        vocab_tp=1, mixed_precision="fp32",
+    )
+    rt = build_runtime(CFG, hp, adam=ADAM, global_batch_size=8, seq_len=32)
+    st = rt.init_state_from(flat)
+    losses = []
+    for b in batches:
+        st, loss = rt.train_step(st, rt.shard_batch(b))
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-4)
