@@ -891,7 +891,7 @@ def make_flash_v5(block=1024, interleave=False):
 
 
 # NOTE: "base" now means the transposing flash_attention wrapper with
-# FLASH_HEADMAJOR disabled; the full production path (head-major wiring) is
+# flash_headmajor=False; the full production path (head-major wiring) is
 # the "xlahm"-equivalent in ATTN_VARIANTS / make_window_attnblock.
 
 
@@ -947,35 +947,35 @@ def make_window(variant_fn, num_layers, bsz=8, seq=2048, iters=6):
     famod.flash_attention = variant_fn
     try:
         # the head-major production wiring bypasses the flash_attention
-        # symbol — disable it or every kernel variant (even ident) benches
-        # the same path
-        with modeling.flash_headmajor(False):
-            cfg = modeling.ModelConfig(
-                vocab_size=32000, hidden_size=4096, num_layers=num_layers,
-                num_heads=32, ffn_dim=11008, max_seq_len=seq,
-                dtype=jnp.bfloat16, param_dtype=jnp.bfloat16, attn_impl="flash",
-            )
-            params = modeling.init_model_params(jax.random.key(0), cfg)
-            tokens = jnp.zeros((bsz, seq), jnp.int32)
+        # symbol — disable it (flash_headmajor=False) or every kernel
+        # variant (even ident) benches the same path
+        cfg = modeling.ModelConfig(
+            vocab_size=32000, hidden_size=4096, num_layers=num_layers,
+            num_heads=32, ffn_dim=11008, max_seq_len=seq,
+            dtype=jnp.bfloat16, param_dtype=jnp.bfloat16, attn_impl="flash",
+            flash_headmajor=False,
+        )
+        params = modeling.init_model_params(jax.random.key(0), cfg)
+        tokens = jnp.zeros((bsz, seq), jnp.int32)
 
-            def fwd(params, tokens, c):
-                x = modeling.embed(tokens, params, cfg)
-                x = x + c.astype(x.dtype)
-                cos_sin = modeling.rope_tables(cfg, seq)
-                for lp in params["layers"]:
-                    x = modeling.decoder_layer(x, lp, cfg, cos_sin, None)
-                return jnp.sum(x.astype(jnp.float32))
+        def fwd(params, tokens, c):
+            x = modeling.embed(tokens, params, cfg)
+            x = x + c.astype(x.dtype)
+            cos_sin = modeling.rope_tables(cfg, seq)
+            for lp in params["layers"]:
+                x = modeling.decoder_layer(x, lp, cfg, cos_sin, None)
+            return jnp.sum(x.astype(jnp.float32))
 
-            @jax.jit
-            def window(params, tokens):
-                def body(c, _):
-                    out = fwd(params, tokens, c * 1e-30)
-                    return out * 1e-30, None
+        @jax.jit
+        def window(params, tokens):
+            def body(c, _):
+                out = fwd(params, tokens, c * 1e-30)
+                return out * 1e-30, None
 
-                c, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=iters)
-                return c
+            c, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=iters)
+            return c
 
-            _ = float(window(params, tokens))
+        _ = float(window(params, tokens))
     finally:
         famod.flash_attention = famod_orig
 

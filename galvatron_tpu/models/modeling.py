@@ -22,7 +22,6 @@ Attention dispatch mirrors the reference's core-vs-flash switch
 
 from __future__ import annotations
 
-import contextlib
 import dataclasses
 from dataclasses import dataclass
 from functools import partial
@@ -121,6 +120,14 @@ class ModelConfig:
     # between stages. Empty → plain ViT encoder.
     swin_depths: Tuple[int, ...] = ()
     swin_window: int = 7
+    # Head-major flash dataflow (einsum projections straight to (b, 3, n, s,
+    # hd) + head-major kernels — the production flash path; see
+    # _attn_block_headmajor). False routes flash layers through the legacy
+    # project→transpose→flash_attention wrapper instead — used by kernel A/B
+    # harnesses (experiments/ab_flash.py) that monkeypatch
+    # ops.flash_attention.flash_attention, which the head-major wiring
+    # bypasses.
+    flash_headmajor: bool = True
 
     @property
     def kv_heads(self) -> int:
@@ -646,28 +653,6 @@ def attention(q, k, v, cfg: ModelConfig, bias=None, rope=None):
     return attention_xla(q, k, v, cfg, bias=bias)
 
 
-# escape hatch for A/B harnesses (experiments/ab_flash.py) that monkeypatch
-# ops.flash_attention.flash_attention: the head-major wiring below bypasses
-# that symbol, so kernel-level experiments must disable it for the window
-# they build or every variant silently benches this path. Use the
-# flash_headmajor() context manager — a crash between a bare set and its
-# restore would silently leave every later attn_block on the legacy path.
-FLASH_HEADMAJOR = True
-
-
-@contextlib.contextmanager
-def flash_headmajor(enabled: bool):
-    """Temporarily force the head-major flash wiring on/off (restores the
-    previous value even on error)."""
-    global FLASH_HEADMAJOR
-    prev = FLASH_HEADMAJOR
-    FLASH_HEADMAJOR = enabled
-    try:
-        yield
-    finally:
-        FLASH_HEADMAJOR = prev
-
-
 def _repeat_kv_hm(x, n_rep: int):
     """Head-major GQA repeat: (b, kvh, s, hd) -> (b, kvh*n_rep, s, hd),
     kv-major head order (matches _repeat_kv's interleaving)."""
@@ -773,7 +758,7 @@ def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: boo
     (reference: galvatron/core/tensor_parallel/transformer.py:597,615-636)."""
     b, s, h = x.shape
     hd = cfg.head_dim
-    if cfg.attn_impl == "flash" and cfg.pos_embed != "alibi" and FLASH_HEADMAJOR:
+    if cfg.attn_impl == "flash" and cfg.pos_embed != "alibi" and cfg.flash_headmajor:
         from galvatron_tpu.ops.flash_attention import flash_tileable
 
         if flash_tileable(s) and ("wqkv_b" not in p or cfg.qkv_blocked):

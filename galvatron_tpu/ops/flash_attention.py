@@ -343,10 +343,31 @@ def flash_qkv_supported(s: int, d: int, causal: bool, rope, block_q: int = 1024)
 # chip's — experiments/vmem_probe.py) the envelope extends to s=8192 at
 # d=128, measured −15% on the full train step vs the grid kernels at that
 # shape (experiments/ab_flash_bwd.py, v5e). When the env knob shrinks the
-# budget below what the wide envelopes charge (s=8192 fwd ~24 MB scoped),
-# the envelopes shrink back with it so shapes route to the grid kernels
-# instead of failing Mosaic's VMEM check at compile time.
-_BLOCKED_MAX_SEQ_X_DIM = 8192 * 128 if _VMEM_LIMIT_MB >= 32 else 4096 * 128
+# budget below what a wide envelope actually charges, that envelope shrinks
+# back so shapes route to the grid kernels instead of failing Mosaic's VMEM
+# check at compile time. Each envelope's threshold is derived from its
+# measured scoped-VMEM anchor (charges scale ~linearly in s·d): fwd ~24 MB
+# at s=8192·d=128; bwd 21.4 MB at s=4096·d=128 ⇒ ~43 MB at s=8192 — so the
+# bwd 8k extension needs a ≥ ~48 MB budget, not the fwd's ≥ 32 (a budget in
+# [32, 42] passed the old shared gate but would fail the bwd compile).
+_VMEM_EFF_MB = _VMEM_LIMIT_MB if _VMEM_LIMIT_MB else 16  # 0 → Mosaic default
+
+
+def _seq_envelope(mb_per_sxd, candidates, floor, budget_mb=None):
+    """Largest s·d envelope whose estimated scoped charge (with a 1.1×
+    safety factor) fits the effective VMEM budget. The floor is the envelope
+    proven under Mosaic's 16 MB default; a budget squeezed below even that
+    disables the blocked path entirely (0) rather than risking a
+    compile-time VMEM failure."""
+    budget = _VMEM_EFF_MB if budget_mb is None else budget_mb
+    for sxd in candidates + (floor,):
+        if budget >= mb_per_sxd * sxd * 1.1:
+            return sxd
+    return 0
+
+
+_FWD_MB_PER_SXD = 24.0 / (8192 * 128)
+_BLOCKED_MAX_SEQ_X_DIM = _seq_envelope(_FWD_MB_PER_SXD, (8192 * 128,), 4096 * 128)
 _BLOCKED_MAX_UNROLL = 8
 
 
@@ -644,9 +665,13 @@ _BWD_BK = 512
 # envelope extends to s=8192, measured −9% (s=4096) / −15% (s=8192, with the
 # forward extension) on the full train step vs the grid kernels
 # (experiments/ab_flash_bwd.py, v5e). Beyond this — or whenever the env
-# knob shrinks the budget below what the wide envelope charges — the grid
-# kernels serve.
-_BWD_MAX_SEQ_X_DIM = 8192 * 128 if _VMEM_LIMIT_MB >= 32 else 2048 * 128
+# knob shrinks the budget below what the wide envelope charges (per-shape
+# thresholds derived from the 21.4 MB s=4096 anchor; see _seq_envelope) —
+# the grid kernels serve.
+_BWD_MB_PER_SXD = 21.4 / (4096 * 128)
+_BWD_MAX_SEQ_X_DIM = _seq_envelope(
+    _BWD_MB_PER_SXD, (8192 * 128, 4096 * 128), 2048 * 128
+)
 
 
 def _bwd_blocks(block_q):

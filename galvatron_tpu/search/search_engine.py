@@ -182,7 +182,11 @@ class SearchEngine:
         boundary micro-batch slots, priced at strategy ``s`` (which
         approximates the section input's sharding). Isolated as the
         difference of layer_memory_cost at bounds (slots, 0) so the formula
-        stays the cost model's — the states terms cancel exactly."""
+        stays the cost model's — the states terms cancel exactly. The
+        runtime allocates one extra sacrificial slot per ring beyond the
+        useful ones (pipeline_swin.py `(n_s[k] + 1,) + shp[k]`, same in
+        pipeline_encdec), so the charge is min(chunks, slots) useful slots
+        plus one unconditional."""
         if not slots:
             return 0.0
         kw = dict(
@@ -195,7 +199,8 @@ class SearchEngine:
         lo = layer_memory_cost(
             lt, s, world, pp, global_bsz, chunks, stash_boundary_bound=0, **kw
         ).total_mb
-        return hi - lo
+        useful = min(chunks, slots)
+        return (hi - lo) * (useful + 1) / useful
 
     def _layer_type(self, i: int) -> ProfiledLayerType:
         lts = self.costs.layer_types

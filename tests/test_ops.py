@@ -130,6 +130,20 @@ def test_flash_blocked_causal_path_matches_reference():
     assert fa._use_blocked_bwd(4096, 128, True, (cos, sin), 1024, 1024)
     assert fa._use_blocked_bwd(8192, 128, True, (cos, sin), 1024, 1024)
     assert not fa._use_blocked_bwd(16384, 128, True, (cos, sin), 1024, 1024)
+    # each envelope's threshold is derived from its own measured scoped
+    # charge: the bwd 8k extension charges ~43 MB (21.4 MB at s=4096 anchor),
+    # so a 32-42 MB budget must NOT admit it (it passes the fwd's ~24 MB
+    # gate but would fail the bwd compile), while s=4096 (21.4 MB) fits
+    bwd_cands = (8192 * 128, 4096 * 128)
+    assert fa._seq_envelope(fa._BWD_MB_PER_SXD, bwd_cands, 2048 * 128, budget_mb=35) == 4096 * 128
+    assert fa._seq_envelope(fa._BWD_MB_PER_SXD, bwd_cands, 2048 * 128, budget_mb=48) == 8192 * 128
+    assert fa._seq_envelope(fa._BWD_MB_PER_SXD, bwd_cands, 2048 * 128, budget_mb=16) == 2048 * 128
+    assert fa._seq_envelope(fa._FWD_MB_PER_SXD, (8192 * 128,), 4096 * 128, budget_mb=35) == 8192 * 128
+    assert fa._seq_envelope(fa._FWD_MB_PER_SXD, (8192 * 128,), 4096 * 128, budget_mb=16) == 4096 * 128
+    # a budget below even the floor's charge disables the blocked path
+    # instead of risking a compile-time Mosaic VMEM failure
+    assert fa._seq_envelope(fa._FWD_MB_PER_SXD, (8192 * 128,), 4096 * 128, budget_mb=12) == 0
+    assert fa._seq_envelope(fa._BWD_MB_PER_SXD, bwd_cands, 2048 * 128, budget_mb=5) == 0
 
 
 def test_headmajor_attn_block_matches_legacy_path():
@@ -150,13 +164,9 @@ def test_headmajor_attn_block_matches_legacy_path():
             p["wo_b"] = jax.random.normal(jax.random.key(14), p["wo_b"].shape)
         x = jax.random.normal(jax.random.key(12), (2, 64, 64), jnp.float32)
         cos_sin = modeling.rope_tables(cfg, 64)
-        assert modeling.FLASH_HEADMAJOR
+        assert cfg.flash_headmajor
         got = modeling.attn_block(x, p, cfg, cos_sin)
-        try:
-            modeling.FLASH_HEADMAJOR = False
-            ref = modeling.attn_block(x, p, cfg, cos_sin)
-        finally:
-            modeling.FLASH_HEADMAJOR = True
+        ref = modeling.attn_block(x, p, cfg.replace(flash_headmajor=False), cos_sin)
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5,
             err_msg=f"kvh={kvh} bias={bias}",
