@@ -156,3 +156,80 @@ def test_portable_checkpoint_swin_cross_schedule_resume(tmp_path):
         st2, _ = rt.train_step(restored, batch)
         st2, l2 = rt.train_step(st2, batch)
         assert np.isfinite(float(l2)) and float(l2) < ref_loss
+
+
+def test_positive_layout_detection_survives_reworded_exceptions(tmp_path, monkeypatch):
+    """Flat-vs-stacked restore is chosen STRUCTURALLY from the orbax
+    checkpoint metadata (_checkpoint_layout), with exception-text
+    classification only as a last-resort guard for unreadable metadata — so
+    an orbax release that rewords its structure-mismatch message cannot flip
+    restore behavior. Adversarial setup: any restore attempted against the
+    WRONG layout raises a message sharing no words with the classifier's
+    mismatch vocabulary; both layouts must still restore correctly, and a
+    checkpoint matching neither layout must fail with the actionable
+    migration message rather than the gibberish."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from galvatron_tpu.core import checkpoint as ck
+    from galvatron_tpu.core.optim import AdamConfig
+    from galvatron_tpu.core.strategy import HybridParallelConfig
+    from galvatron_tpu.models.modeling import ModelConfig
+    from galvatron_tpu.parallel.hybrid import build_runtime
+
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=64, num_layers=4, num_heads=4,
+        ffn_dim=128, max_seq_len=16, dtype=jnp.float32,
+    )
+    hp = HybridParallelConfig.uniform(4, pp=2, chunks=2, mixed_precision="fp32")
+    rt = build_runtime(cfg, hp, adam=AdamConfig(lr=1e-3), global_batch_size=8, seq_len=16)
+    state = rt.init_state(jax.random.key(0))
+    flat_dir, stacked_dir = str(tmp_path / "flat"), str(tmp_path / "stacked")
+    ck.save_checkpoint_portable(flat_dir, state, 1, rt)
+    ck.save_checkpoint(stacked_dir, state, 1)  # engine-native stacked layout
+
+    flat_keys = ck._tree_keypaths(ck.flat_abstract_state_of(rt))
+    stacked_keys = ck._tree_keypaths(ck.abstract_state_of(rt))
+    assert flat_keys != stacked_keys  # pp=2 stacks stages; layouts differ
+    # positive structural detection fires on real metadata for BOTH layouts
+    assert ck._checkpoint_layout(flat_dir, 1, ck.flat_abstract_state_of(rt),
+                                 ck.abstract_state_of(rt)) == "flat"
+    assert ck._checkpoint_layout(stacked_dir, 1, ck.flat_abstract_state_of(rt),
+                                 ck.abstract_state_of(rt)) == "stacked"
+
+    on_disk = {flat_dir: flat_keys, stacked_dir: stacked_keys}
+    orig_restore = ck.restore_checkpoint
+
+    def adversarial_restore(ckpt_dir, abstract_state, step=None):
+        want = ck._tree_keypaths(abstract_state)
+        have = on_disk[ckpt_dir.rstrip("/")]
+        if want != have:
+            # no 'missing'/'mismatch'/'shape'/... vocabulary — the substring
+            # guard cannot classify this
+            raise RuntimeError("qux kaboom, incompatible trees (code 77)")
+        return orig_restore(ckpt_dir, abstract_state, step)
+
+    monkeypatch.setattr(ck, "restore_checkpoint", adversarial_restore)
+
+    ref = float(rt.eval_loss(state, jnp.zeros((8, 17), jnp.int32)))
+    for d in (flat_dir, stacked_dir):
+        restored = ck.restore_checkpoint_portable(d, rt, step=1)
+        got = float(rt.eval_loss(restored, jnp.zeros((8, 17), jnp.int32)))
+        np.testing.assert_allclose(got, ref, rtol=3e-5, atol=3e-5, err_msg=d)
+
+    # a checkpoint matching NEITHER layout (different depth) fails with the
+    # actionable message from positive detection, not the reworded gibberish
+    cfg6 = cfg.replace(num_layers=6)
+    rt6 = build_runtime(
+        cfg6, HybridParallelConfig.uniform(6, pp=2, chunks=2, mixed_precision="fp32"),
+        adam=AdamConfig(lr=1e-3), global_batch_size=8, seq_len=16,
+    )
+    other_dir = str(tmp_path / "other")
+    ck.save_checkpoint_portable(other_dir, rt6.init_state(jax.random.key(1)), 1, rt6)
+    on_disk[other_dir] = ck._tree_keypaths(ck.flat_abstract_state_of(rt6))
+    try:
+        ck.restore_checkpoint_portable(other_dir, rt, step=1)
+        raise AssertionError("expected ValueError for neither-layout checkpoint")
+    except ValueError as e:
+        assert "neither" in str(e)
