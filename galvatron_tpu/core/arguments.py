@@ -90,7 +90,7 @@ def _add_training_args(p: argparse.ArgumentParser):
                    "divisions supported; default: balanced split)")
     g.add_argument("--vpp_deg", type=int, default=1,
                    help="virtual pipeline chunks per device (interleaved "
-                   "schedule; needs layers % (pp*vpp) == 0 and chunks % pp == 0)")
+                   "schedule; needs layers %% (pp*vpp) == 0 and chunks %% pp == 0)")
     g.add_argument("--global_tp_deg", type=int, default=1)
     g.add_argument("--global_tp_consec", type=int, default=1)
     g.add_argument("--sdp", type=int, default=0, help="1 = zero3 on all layers")

@@ -29,6 +29,21 @@ def _np(t) -> np.ndarray:
     return np.asarray(t, np.float32)
 
 
+def _getter(sd: Mapping[str, Any], family: str):
+    """Missing-key accessor shared by every importer (one copy of the
+    diagnostics instead of one per family)."""
+
+    def get(name: str) -> np.ndarray:
+        if name not in sd:
+            raise KeyError(
+                f"HF state dict is missing '{name}' — not a {family} "
+                f"checkpoint? (keys like {list(sd)[:3]})"
+            )
+        return _np(sd[name])
+
+    return get
+
+
 def config_from_hf_llama(hf_config) -> ModelConfig:
     """ModelConfig from a ``transformers.LlamaConfig``-shaped object.
 
@@ -90,14 +105,7 @@ def from_hf_llama(model_or_state_dict: Any, cfg: ModelConfig) -> Params:
     # runtime's jitted init_state_from places them per its out_shardings.
     # (numpy handles bfloat16 via the ml_dtypes registration jax ships.)
     dt = cfg.param_dtype
-
-    def get(name: str) -> np.ndarray:
-        if name not in sd:
-            raise KeyError(
-                f"HF state dict is missing '{name}' — not a LLaMA-architecture "
-                f"checkpoint? (keys like {list(sd)[:3]})"
-            )
-        return _np(sd[name])
+    get = _getter(sd, "LLaMA-architecture")
 
     params: Params = {
         "embed": {"tok": get("model.embed_tokens.weight").astype(dt)},
@@ -129,6 +137,108 @@ def from_hf_llama(model_or_state_dict: Any, cfg: ModelConfig) -> Params:
                 },
                 "mlp": {
                     "w13": w13.astype(dt),
+                    "w2": np.ascontiguousarray(
+                        get(pre + "mlp.down_proj.weight").T
+                    ).astype(dt),
+                },
+            }
+        )
+    if not cfg.tie_word_embeddings:
+        params["head"] = {"w": np.ascontiguousarray(get("lm_head.weight").T).astype(dt)}
+    return params
+
+
+def config_from_hf_baichuan(hf_config) -> ModelConfig:
+    """ModelConfig from a Baichuan-1 HF config (model_type 'baichuan' —
+    trust_remote_code architecture, so there is no transformers config class
+    to type-check against; the reference's baichuan family builds from these
+    HF configs the same way, models/baichuan/BaiChuanModel_sequential.py:6-25).
+
+    The 7B checkpoint uses rotary positions and carries
+    ``max_position_embeddings``; the 13B checkpoint uses ALiBi and carries
+    ``model_max_length`` instead — that field difference is the published
+    config discriminator between the two architectures."""
+    if hf_config.vocab_size > 100000:
+        # Baichuan-2 shares model_type 'baichuan' but normalizes the lm_head
+        # rows at forward time (NormHead) and its 7B uses RoPE despite
+        # carrying only model_max_length — importing it with Baichuan-1 math
+        # would silently produce wrong logits. Its 125696-token vocab (vs
+        # Baichuan-1's 64000) is the reliable config discriminator.
+        raise ValueError(
+            f"vocab_size {hf_config.vocab_size} indicates a Baichuan-2 "
+            "checkpoint (NormHead + different position-scheme config "
+            "encoding), which this importer does not implement — refusing "
+            "to silently import it with Baichuan-1 math"
+        )
+    mpe = getattr(hf_config, "max_position_embeddings", None)
+    alibi = mpe is None
+    if alibi and getattr(hf_config, "model_max_length", None) is None:
+        raise ValueError(
+            "baichuan config carries neither max_position_embeddings (7B, "
+            "rotary) nor model_max_length (13B, ALiBi) — cannot infer the "
+            "position-embedding scheme"
+        )
+    return ModelConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        ffn_dim=hf_config.intermediate_size,
+        max_seq_len=mpe if mpe is not None else hf_config.model_max_length,
+        pos_embed="alibi" if alibi else "rope",
+        norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-6)),
+        tie_word_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+    )
+
+
+def from_hf_baichuan(model_or_state_dict: Any, cfg: ModelConfig) -> Params:
+    """HF Baichuan-1 state dict (or model) → parameter pytree. Baichuan is
+    LLaMA-architecture (RMSNorm/SwiGLU, untied head, no biases) except the
+    attention input projection is already fused: ``self_attn.W_pack.weight``
+    is (3·h, h) in [Q; K; V] row order — transposing gives input-major
+    [Q | K | V] columns, which is exactly the blocked wqkv layout (no GQA in
+    either published size)."""
+    sd: Mapping[str, Any] = (
+        model_or_state_dict
+        if isinstance(model_or_state_dict, Mapping)
+        else model_or_state_dict.state_dict()
+    )
+    dt = cfg.param_dtype
+    h, nd = cfg.hidden_size, cfg.num_heads * cfg.head_dim
+    get = _getter(sd, "Baichuan")
+
+    params: Params = {
+        "embed": {"tok": get("model.embed_tokens.weight").astype(dt)},
+        "layers": [],
+        "final_norm": {"scale": get("model.norm.weight").astype(dt)},
+    }
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        wpack = get(pre + "self_attn.W_pack.weight").T  # (h, 3*nd)
+        params["layers"].append(
+            {
+                "attn_norm": {
+                    "scale": get(pre + "input_layernorm.weight").astype(dt)
+                },
+                "attn": {
+                    "wqkv": np.ascontiguousarray(
+                        wpack.reshape(h, 3, nd)
+                    ).astype(dt),
+                    "wo": np.ascontiguousarray(
+                        get(pre + "self_attn.o_proj.weight").T
+                    ).astype(dt),
+                },
+                "mlp_norm": {
+                    "scale": get(pre + "post_attention_layernorm.weight").astype(dt)
+                },
+                "mlp": {
+                    "w13": np.concatenate(
+                        [
+                            get(pre + "mlp.gate_proj.weight").T,
+                            get(pre + "mlp.up_proj.weight").T,
+                        ],
+                        axis=1,
+                    ).astype(dt),
                     "w2": np.ascontiguousarray(
                         get(pre + "mlp.down_proj.weight").T
                     ).astype(dt),
@@ -180,11 +290,7 @@ def from_hf_gpt2(model_or_state_dict: Any, cfg: ModelConfig) -> Params:
     )
     dt = cfg.param_dtype
     h, nd = cfg.hidden_size, cfg.num_heads * cfg.head_dim
-
-    def get(name: str) -> np.ndarray:
-        if name not in sd:
-            raise KeyError(f"HF state dict is missing '{name}'")
-        return _np(sd[name])
+    get = _getter(sd, "GPT-2")
 
     params: Params = {
         "embed": {
@@ -326,11 +432,7 @@ def from_hf_opt(model_or_state_dict: Any, cfg: ModelConfig) -> Params:
         else model_or_state_dict.state_dict()
     )
     dt = cfg.param_dtype
-
-    def get(name: str) -> np.ndarray:
-        if name not in sd:
-            raise KeyError(f"HF state dict is missing '{name}'")
-        return _np(sd[name])
+    get = _getter(sd, "OPT")
 
     pos = get("model.decoder.embed_positions.weight")[2 : 2 + cfg.max_seq_len]
     params: Params = {
@@ -416,28 +518,85 @@ def to_hf_gpt2(params: Params, cfg: ModelConfig) -> Dict[str, np.ndarray]:
     return sd
 
 
+def _state_dict_from_dir(path: str) -> Dict[str, Any]:
+    """Raw weight load from an HF checkpoint directory (safetensors or torch
+    .bin, sharded or not) WITHOUT instantiating the model class — required
+    for trust_remote_code architectures like Baichuan whose modeling code we
+    neither have nor want to execute."""
+    import json
+    import os
+
+    def load_file(fn):
+        full = os.path.join(path, fn)
+        if fn.endswith(".safetensors"):
+            from safetensors.numpy import load_file as st_load
+
+            return st_load(full)
+        import torch
+
+        return torch.load(full, map_location="cpu", weights_only=True)
+
+    sd: Dict[str, Any] = {}
+    for index in ("model.safetensors.index.json", "pytorch_model.bin.index.json"):
+        idx = os.path.join(path, index)
+        if os.path.exists(idx):
+            with open(idx) as f:
+                shards = sorted(set(json.load(f)["weight_map"].values()))
+            for fn in shards:
+                sd.update(load_file(fn))
+            return sd
+    for single in ("model.safetensors", "pytorch_model.bin"):
+        if os.path.exists(os.path.join(path, single)):
+            return dict(load_file(single))
+    raise FileNotFoundError(f"no model weights (safetensors/bin) under {path}")
+
+
 def load_hf_checkpoint(path_or_model: Any) -> tuple:
     """(params, cfg) from a local HF checkpoint directory or an in-memory HF
     model. Supported architectures: LLaMA family (RMSNorm/SwiGLU/RoPE, no
-    biases), GPT-2 (LayerNorm/GeLU/learned positions, biases) and OPT
-    (LayerNorm/ReLU/learned positions with the +2 offset, biases)."""
-    if isinstance(path_or_model, str):
-        from transformers import AutoConfig, AutoModelForCausalLM
+    biases), Baichuan-1 (7B rotary / 13B ALiBi, fused W_pack), GPT-2
+    (LayerNorm/GeLU/learned positions, biases) and OPT (LayerNorm/ReLU/
+    learned positions with the +2 offset, biases).
 
-        hf_cfg = AutoConfig.from_pretrained(path_or_model)
-        # exact model_type match — class-name substrings would misroute any
-        # future config class whose lowercase name happens to contain 'opt'
-        if getattr(hf_cfg, "model_type", None) not in ("llama", "gpt2", "opt"):
-            raise ValueError(
-                f"--load_hf supports LLaMA-architecture, GPT-2 and OPT "
-                f"checkpoints; got {type(hf_cfg).__name__} "
-                f"(model_type={getattr(hf_cfg, 'model_type', None)!r})"
+    Baichuan requires a LOCAL checkpoint directory (the config.json sniff +
+    raw weight read happen before transformers sees the path): a hub id
+    would fall through to AutoConfig, which refuses trust_remote_code
+    architectures. The other families accept whatever AutoModel resolves."""
+    if isinstance(path_or_model, str):
+        import json
+        import os
+        from types import SimpleNamespace
+
+        # sniff model_type from the raw config.json first: baichuan is a
+        # trust_remote_code architecture AutoConfig refuses to load (and
+        # whose bundled modeling code we must not execute)
+        raw_arch = None
+        cfg_json = os.path.join(path_or_model, "config.json")
+        if os.path.isfile(cfg_json):
+            with open(cfg_json) as f:
+                raw_cfg = json.load(f)
+            raw_arch = raw_cfg.get("model_type")
+        if raw_arch == "baichuan":
+            hf_cfg: Any = SimpleNamespace(**raw_cfg)
+            model: Any = _state_dict_from_dir(path_or_model)
+        else:
+            from transformers import AutoConfig, AutoModelForCausalLM
+
+            hf_cfg = AutoConfig.from_pretrained(path_or_model)
+            # exact model_type match — class-name substrings would misroute
+            # any future config class whose lowercase name contains 'opt'
+            arch = getattr(hf_cfg, "model_type", None)
+            if arch not in ("llama", "gpt2", "opt"):
+                raise ValueError(
+                    f"--load_hf supports LLaMA-architecture, Baichuan, GPT-2 "
+                    f"and OPT checkpoints; got {type(hf_cfg).__name__} "
+                    f"(model_type={arch!r})"
+                )
+            # low_cpu_mem_usage streams weights instead of materializing a
+            # full randomly-initialized module first (~halves host peak, 7B+)
+            model = AutoModelForCausalLM.from_pretrained(
+                path_or_model, low_cpu_mem_usage=True
             )
-        # low_cpu_mem_usage streams weights instead of materializing a full
-        # randomly-initialized module first (~halves host peak for 7B+)
-        model = AutoModelForCausalLM.from_pretrained(
-            path_or_model, low_cpu_mem_usage=True
-        )
     else:
         model = path_or_model
         hf_cfg = model.config
@@ -448,6 +607,9 @@ def load_hf_checkpoint(path_or_model: Any) -> tuple:
     if arch == "opt":
         cfg = config_from_hf_opt(hf_cfg)
         return from_hf_opt(model, cfg), cfg
+    if arch == "baichuan":
+        cfg = config_from_hf_baichuan(hf_cfg)
+        return from_hf_baichuan(model, cfg), cfg
     cfg = config_from_hf_llama(hf_cfg)
     return from_hf_llama(model, cfg), cfg
 
