@@ -103,6 +103,15 @@ class ModelConfig:
     # stack axis (padding it across tp x dp devices) and paying an
     # involuntary replicate-and-repartition. None → unconstrained.
     qkv_shard_ctx: Optional[Any] = None
+    # (mesh, batch_axes, head_axes) installed by the layer hooks for flash
+    # layers on ANY multi-device mesh: GSPMD cannot partition Mosaic custom
+    # calls ("Mosaic kernels cannot be automatically partitioned"), so every
+    # kernel invocation is wrapped in a shard_map over the batch (dp) and
+    # head (tp) axes — each device runs the kernel on its local shard. The
+    # CPU simulation never surfaces this (interpret-mode kernels are plain
+    # jnp ops GSPMD can partition); a real-TPU topology AOT compile does
+    # (tests/test_topology_aot.py). None → direct call (single device).
+    flash_shard_ctx: Optional[Any] = None
     # vision families (reference legacy vit/swin model_type branches,
     # galvatron/core/parallel.py:64-89, cost_model.py:76,87-106).
     # image_size > 0 switches the input pipeline from token ids to uint8
@@ -646,7 +655,24 @@ def attention(q, k, v, cfg: ModelConfig, bias=None, rope=None):
         nh = q.shape[2]
         k = _repeat_kv(k, nh // k.shape[2])
         v = _repeat_kv(v, nh // v.shape[2])
-        return flash_attention(q, k, v, causal=cfg.causal, rope=rope)
+        bsnd = (0, 2)  # (b, s, n, d) layout: batch dim 0, head dim 2
+        if rope is None:
+            kernel = _flash_shard_map(
+                cfg,
+                lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=cfg.causal),
+                [bsnd] * 3,
+                bsnd,
+            )
+            return kernel(q, k, v)
+        kernel = _flash_shard_map(
+            cfg,
+            lambda q_, k_, v_, c_, s_: flash_attention(
+                q_, k_, v_, causal=cfg.causal, rope=(c_, s_)
+            ),
+            [bsnd] * 3 + [(None, None)] * 2,
+            bsnd,
+        )
+        return kernel(q, k, v, *rope)
     if rope is not None:
         q = apply_rope(q, *rope)
         k = apply_rope(k, *rope)
@@ -662,6 +688,50 @@ def _repeat_kv_hm(x, n_rep: int):
     return jnp.broadcast_to(x[:, :, None], (b, kvh, n_rep, s, hd)).reshape(
         b, kvh * n_rep, s, hd
     )
+
+
+def _flash_shard_map(cfg: ModelConfig, fn, arg_dims, out_dims):
+    """Wrap a flash-kernel entry in a shard_map over the layer's (dp, tp)
+    axes when flash_shard_ctx is installed (multi-device mesh) — Mosaic
+    custom calls cannot be partitioned by GSPMD, so each device must invoke
+    the kernel on its local (batch, head) shard. ``arg_dims``/``out_dims``:
+    per-array (batch_dim, head_dim) positions; rope tables (replicated) are
+    passed through with empty dims. Nests inside the pp engines' manual
+    region via ambient_or. Identity when the ctx is absent."""
+    if cfg.flash_shard_ctx is None:
+        return fn
+    from jax.sharding import PartitionSpec as P
+
+    from galvatron_tpu.parallel.mesh import ambient_or
+
+    mesh, dp_ax, tp_ax = cfg.flash_shard_ctx
+    dp = tuple(dp_ax) if dp_ax else ()
+    tp = tuple(tp_ax) if tp_ax else ()
+    if not dp and not tp:
+        return fn
+
+    def spec(dims, ndim):
+        entries = [None] * ndim
+        b_dim, h_dim = dims
+        if b_dim is not None and dp:
+            entries[b_dim] = dp if len(dp) > 1 else dp[0]
+        if h_dim is not None and tp:
+            entries[h_dim] = tp if len(tp) > 1 else tp[0]
+        return P(*entries)
+
+    def wrapped(*args):
+        from galvatron_tpu.parallel.mesh import manual_axis_names
+
+        in_specs = tuple(spec(d, a.ndim) for d, a in zip(arg_dims, args))
+        out_shape = jax.eval_shape(fn, *args)
+        am = ambient_or(mesh)
+        return jax.shard_map(
+            fn, mesh=am, in_specs=in_specs,
+            out_specs=spec(out_dims, len(out_shape.shape)),
+            axis_names=manual_axis_names(am), check_vma=False,
+        )(*args)
+
+    return wrapped
 
 
 def _constrain_qkv(qkv, cfg: ModelConfig):
@@ -719,8 +789,15 @@ def _attn_block_headmajor(x, p, cfg: ModelConfig, rope, remat_attn: bool):
         if flash_qkv_supported(s, hd, cfg.causal, rope):
             # the kernels consume the STACKED projection output directly —
             # index-mapped block specs instead of q/k/v slice copies
+            kernel = _flash_shard_map(
+                cfg,
+                lambda qkv_, c_, s_: flash_attention_qkv(qkv_, rope=(c_, s_)),
+                [(0, 2), (None, None), (None, None)],
+                (0, 1),
+            )
+
             def core_qkv(qkv_):
-                return flash_attention_qkv(qkv_, rope=rope)
+                return kernel(qkv_, *rope)
 
             if remat_attn:
                 core_qkv = jax.checkpoint(core_qkv)
@@ -740,8 +817,29 @@ def _attn_block_headmajor(x, p, cfg: ModelConfig, rope, remat_attn: bool):
         k = _repeat_kv_hm(r[:, :, npg], npg)
         v = _repeat_kv_hm(r[:, :, npg + 1], npg)
 
-    def core(q_, k_, v_):
-        return flash_attention_hm(q_, k_, v_, causal=cfg.causal, rope=rope)
+    qkv_dim, rep_dim = (0, 1), (None, None)
+    if rope is None:
+        kernel = _flash_shard_map(
+            cfg,
+            lambda q_, k_, v_: flash_attention_hm(q_, k_, v_, causal=cfg.causal),
+            [qkv_dim] * 3,
+            qkv_dim,
+        )
+
+        def core(q_, k_, v_):
+            return kernel(q_, k_, v_)
+    else:
+        kernel = _flash_shard_map(
+            cfg,
+            lambda q_, k_, v_, c_, s_: flash_attention_hm(
+                q_, k_, v_, causal=cfg.causal, rope=(c_, s_)
+            ),
+            [qkv_dim] * 3 + [rep_dim, rep_dim],
+            qkv_dim,
+        )
+
+        def core(q_, k_, v_):
+            return kernel(q_, k_, v_, *rope)
 
     if remat_attn:
         core = jax.checkpoint(core)

@@ -50,7 +50,13 @@ from galvatron_tpu.parallel.mesh import (
     global_batch_spec,
     moe_token_axes,
 )
-from galvatron_tpu.parallel.sharding import constrain, param_spec, sharding_tree
+from galvatron_tpu.parallel.sharding import (
+    constrain,
+    cp_shard_axes,
+    param_spec,
+    sharding_tree,
+    with_flash_shard_ctx,
+)
 
 
 def activation_spec(axes: MeshAxes, s: LayerStrategy) -> P:
@@ -200,6 +206,9 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
                     axes.tp_axes(s.tp, s.tp_consec),
                 )
             )
+        # Mosaic kernels cannot be auto-partitioned by GSPMD — see
+        # sharding.with_flash_shard_ctx / modeling._flash_shard_map
+        layer_cfg = with_flash_shard_ctx(layer_cfg, s, mesh, axes)
         cos_sin = (
             modeling.rope_tables(layer_cfg, x.shape[1]) if layer_cfg.pos_embed == "rope" else None
         )
@@ -221,13 +230,18 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
                 )
             if s.cp > 1:
                 cp_axes = axes.cp_axes(s.tp, s.tp_consec, s.cp)
+                cp_kw = cp_shard_axes(s, axes)
                 if s.cp_impl == "a2a":
                     from galvatron_tpu.parallel.ulysses import ulysses_decoder_layer
 
-                    return ulysses_decoder_layer(x_, lp_, layer_cfg, mesh, cp_axes, cos_sin)
+                    return ulysses_decoder_layer(
+                        x_, lp_, layer_cfg, mesh, cp_axes, cos_sin, **cp_kw
+                    )
                 from galvatron_tpu.parallel.ring import ring_decoder_layer
 
-                return ring_decoder_layer(x_, lp_, layer_cfg, mesh, cp_axes, cos_sin)
+                return ring_decoder_layer(
+                    x_, lp_, layer_cfg, mesh, cp_axes, cos_sin, **cp_kw
+                )
             return modeling.decoder_layer(
                 x_, lp_, layer_cfg, cos_sin, alibi,
                 remat_attn=(s.ckpt == "selective"), enc_out=enc_out,

@@ -196,3 +196,20 @@ def ambient_or(mesh):
     if any(t == jax.sharding.AxisType.Manual for t in types):
         return am
     return mesh
+
+
+def manual_axis_names(am) -> set:
+    """Every ambient-mesh axis not already Manual — the axis_names set a
+    nested shard_map wrapping a Mosaic kernel must manualize. Any axis left
+    auto — including a size-1 'pp' axis at pp=1 or the dp axes carrying the
+    batch — keeps the body under GSPMD, which cannot partition Mosaic custom
+    calls on a real multi-chip TPU ("Mosaic kernels cannot be automatically
+    partitioned"; caught by tests/test_topology_aot.py — CPU interpret-mode
+    kernels never surface it). Axes already Manual (the pp engines' 'pp')
+    must not be re-bound."""
+    types = getattr(am, "axis_types", None) or ()
+    manual = {
+        n for n, t in zip(am.axis_names, types)
+        if t != jax.sharding.AxisType.Manual
+    }
+    return manual or set(am.axis_names)

@@ -70,7 +70,13 @@ from galvatron_tpu.core.strategy import (
 from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
 from galvatron_tpu.parallel.mesh import MeshAxes, batch_spec, moe_token_axes
-from galvatron_tpu.parallel.sharding import constrain, param_spec, sharding_tree
+from galvatron_tpu.parallel.sharding import (
+    constrain,
+    cp_shard_axes,
+    param_spec,
+    sharding_tree,
+    with_flash_shard_ctx,
+)
 
 def cpu_sim_compiler_options():
     """XLA:CPU's all-reduce-promotion pass check-fails (CreateBinary with a
@@ -359,19 +365,25 @@ def make_block_fn(
                 layer_cfg = layer_cfg.replace(
                     attn_out_shard_ctx=(mesh, axes.dp_axes(s.tp, s.tp_consec, s.cp))
                 )
+            layer_cfg = with_flash_shard_ctx(layer_cfg, s, mesh, axes)
 
             def run(x_, lp_):
                 if s.cp > 1:
                     cp_axes = axes.cp_axes(s.tp, s.tp_consec, s.cp)
+                    cp_kw = cp_shard_axes(s, axes)
                     # layer_cfg (not cfg): an MoE layer with cp>1 must keep
                     # its expert-dispatch sharding pins, as the pp=1 hook does
                     if s.cp_impl == "a2a":
                         from galvatron_tpu.parallel.ulysses import ulysses_decoder_layer
 
-                        return ulysses_decoder_layer(x_, lp_, layer_cfg, mesh, cp_axes, cos_sin)
+                        return ulysses_decoder_layer(
+                            x_, lp_, layer_cfg, mesh, cp_axes, cos_sin, **cp_kw
+                        )
                     from galvatron_tpu.parallel.ring import ring_decoder_layer
 
-                    return ring_decoder_layer(x_, lp_, layer_cfg, mesh, cp_axes, cos_sin)
+                    return ring_decoder_layer(
+                        x_, lp_, layer_cfg, mesh, cp_axes, cos_sin, **cp_kw
+                    )
                 return modeling.decoder_layer(
                     x_, lp_, layer_cfg, cos_sin, alibi,
                     remat_attn=(s.ckpt == "selective"),

@@ -53,7 +53,12 @@ from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
 from galvatron_tpu.parallel.mesh import MeshAxes, batch_spec
 from galvatron_tpu.parallel.pipeline import cpu_sim_compiler_options
-from galvatron_tpu.parallel.sharding import constrain, param_spec, sharding_tree
+from galvatron_tpu.parallel.sharding import (
+    constrain,
+    param_spec,
+    sharding_tree,
+    with_flash_shard_ctx,
+)
 
 
 class EncDecLayout:
@@ -315,8 +320,9 @@ def _make_section_fns(cfg: ModelConfig, hp: HybridParallelConfig, mesh, axes):
         )
         for q, s in enumerate(enc_pos):
             x = constrain(x, mesh, act_spec(s))
-            run = lambda x_, lp_: modeling.encoder_layer(
-                x_, lp_, cfg, cos_e, remat_attn=(s.ckpt == "selective")
+            lcfg = with_flash_shard_ctx(cfg, s, mesh, axes)
+            run = lambda x_, lp_, lcfg=lcfg: modeling.encoder_layer(
+                x_, lp_, lcfg, cos_e, remat_attn=(s.ckpt == "selective")
             )
             if s.ckpt == "full":
                 run = jax.checkpoint(run)
@@ -333,8 +339,9 @@ def _make_section_fns(cfg: ModelConfig, hp: HybridParallelConfig, mesh, axes):
         )
         for q, s in enumerate(dec_pos):
             x = constrain(x, mesh, act_spec(s))
-            run = lambda x_, lp_: modeling.decoder_layer(
-                x_, lp_, cfg, cos_d, None,
+            lcfg = with_flash_shard_ctx(cfg, s, mesh, axes)
+            run = lambda x_, lp_, lcfg=lcfg: modeling.decoder_layer(
+                x_, lp_, lcfg, cos_d, None,
                 remat_attn=(s.ckpt == "selective"), enc_out=ctx,
             )
             if s.ckpt == "full":

@@ -37,7 +37,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
-from galvatron_tpu.parallel.mesh import ambient_or
+from galvatron_tpu.parallel.mesh import ambient_or, manual_axis_names
 from galvatron_tpu.ops.flash_attention import (
     _flash_bwd_parts,
     _flash_fwd,
@@ -255,17 +255,23 @@ def _flash_block_size(s_local: int) -> int:
 
 
 def ring_attention(
-    q, k, v, mesh: Mesh, cp_axes: Sequence[str], sm_scale: float | None = None
+    q, k, v, mesh: Mesh, cp_axes: Sequence[str], sm_scale: float | None = None,
+    batch_axes: Sequence[str] = (), head_axes: Sequence[str] = (),
 ):
     """q/k/v: (B, S, n, d) global arrays; sequence ring-sharded over cp_axes.
 
     Uses the Pallas flash kernels per ring hop when the local sequence
-    tiles; otherwise the einsum online-softmax fallback."""
+    tiles; otherwise the einsum online-softmax fallback. ``batch_axes``/
+    ``head_axes``: the layer's dp/tp axes — the batch and head dims keep
+    their sharding through the (fully-manual) region instead of being
+    gathered."""
     cp = int(np.prod([mesh.shape[a] for a in cp_axes]))
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
     axis = tuple(cp_axes)
-    spec = P(None, axis, None, None)
+    b_ax = tuple(batch_axes) or None
+    h_ax = tuple(head_axes) or None
+    spec = P(b_ax, axis, h_ax, None)
     mesh = ambient_or(mesh)
     block = _flash_block_size(q.shape[1] // cp)
     if block:
@@ -287,13 +293,16 @@ def ring_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec, P(axis)),
         out_specs=spec,
-        axis_names=set(cp_axes),
+        axis_names=manual_axis_names(mesh),
         check_vma=False,
     )
     return fn(q, k, v, idx_arr)
 
 
-def ring_decoder_layer(x, p, cfg: ModelConfig, mesh, cp_axes, cos_sin):
+def ring_decoder_layer(
+    x, p, cfg: ModelConfig, mesh, cp_axes, cos_sin,
+    batch_axes: Sequence[str] = (), head_axes: Sequence[str] = (),
+):
     """Decoder layer with the attention core ring-parallelized (drop-in for
     modeling.decoder_layer when a layer strategy sets cp > 1)."""
 
@@ -307,7 +316,13 @@ def ring_decoder_layer(x, p, cfg: ModelConfig, mesh, cp_axes, cos_sin):
             k = modeling.apply_rope(k, cos, sin)
         k = modeling._repeat_kv(k, cfg.num_heads // k.shape[2])
         v = modeling._repeat_kv(v, cfg.num_heads // v.shape[2])
-        o = modeling._constrain_attn_out(ring_attention(q, k, v, mesh, cp_axes), cfg)
+        o = modeling._constrain_attn_out(
+            ring_attention(
+                q, k, v, mesh, cp_axes,
+                batch_axes=batch_axes, head_axes=head_axes,
+            ),
+            cfg,
+        )
         return modeling.attn_output(o, p["attn"], cfg, xn.dtype)
 
     x = x + attn(modeling.norm(x, p["attn_norm"], cfg))

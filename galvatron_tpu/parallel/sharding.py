@@ -112,3 +112,36 @@ def constrain(x, mesh: Mesh, spec: P):
     am = jax.sharding.get_abstract_mesh()
     target = am if (am is not None and not am.empty) else mesh
     return jax.lax.with_sharding_constraint(x, NamedSharding(target, spec))
+
+
+def with_flash_shard_ctx(layer_cfg, s: LayerStrategy, mesh: Mesh, axes: MeshAxes):
+    """Install ``flash_shard_ctx`` on a layer's ModelConfig for flash layers
+    on multi-device meshes: GSPMD cannot partition Mosaic custom calls, so
+    modeling._flash_shard_map must route each kernel invocation through a
+    shard_map over the layer's (dp, tp) axes. One shared installer for every
+    engine (pp=1 hook, make_block_fn, enc-dec sections) so the engines
+    cannot diverge. cp>1 layers are excluded — the ring/ulysses paths carry
+    their own shard_maps."""
+    if (
+        getattr(layer_cfg, "attn_impl", None) != "flash"
+        or mesh.devices.size <= 1
+        or s.cp > 1
+    ):
+        return layer_cfg
+    return layer_cfg.replace(
+        flash_shard_ctx=(
+            mesh,
+            axes.dp_axes(s.tp, s.tp_consec, s.cp),
+            axes.tp_axes(s.tp, s.tp_consec),
+        )
+    )
+
+
+def cp_shard_axes(s: LayerStrategy, axes: MeshAxes) -> dict:
+    """(batch_axes, head_axes) kwargs for the ring/ulysses CP entries — one
+    derivation shared by the pp=1 hook and the pipeline engines so they
+    cannot diverge (companion of with_flash_shard_ctx)."""
+    return dict(
+        batch_axes=axes.dp_axes(s.tp, s.tp_consec, s.cp),
+        head_axes=axes.tp_axes(s.tp, s.tp_consec),
+    )

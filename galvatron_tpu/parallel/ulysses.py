@@ -27,7 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from galvatron_tpu.models import modeling
 from galvatron_tpu.models.modeling import ModelConfig
-from galvatron_tpu.parallel.mesh import ambient_or
+from galvatron_tpu.parallel.mesh import ambient_or, manual_axis_names
 
 
 def _a2a_attn_local(q, k, v, cfg: ModelConfig, axis_name, cp: int):
@@ -44,35 +44,50 @@ def _a2a_attn_local(q, k, v, cfg: ModelConfig, axis_name, cp: int):
     return jax.lax.all_to_all(o, axis_name, 1, 2, tiled=True)
 
 
-def ulysses_attention(q, k, v, cfg: ModelConfig, mesh: Mesh, cp_axes: Sequence[str]):
+def ulysses_attention(
+    q, k, v, cfg: ModelConfig, mesh: Mesh, cp_axes: Sequence[str],
+    batch_axes: Sequence[str] = (), head_axes: Sequence[str] = (),
+):
     """q/k/v: (B, S, n, d) global arrays, sequence sharded over ``cp_axes``;
-    n must be divisible by the CP degree (the Ulysses head constraint)."""
+    n must be divisible by the CP degree (the Ulysses head constraint).
+    ``batch_axes``/``head_axes``: the layer's dp/tp axes — the region is
+    fully manual (see mesh.manual_axis_names: GSPMD cannot partition the
+    Mosaic attention core on a real multi-chip TPU), so the batch/head dims
+    must carry their sharding explicitly."""
     cp = int(np.prod([mesh.shape[a] for a in cp_axes]))
-    if q.shape[2] % cp != 0:
+    tp = int(np.prod([mesh.shape[a] for a in head_axes])) if head_axes else 1
+    # the head dim is tp-sharded inside the manual region, so the a2a splits
+    # the tp-LOCAL head count — validate that, not the global one
+    if q.shape[2] % tp or (q.shape[2] // tp) % cp:
         raise ValueError(
-            f"cp_impl='a2a' needs num_heads {q.shape[2]} divisible by cp={cp} "
+            f"cp_impl='a2a' needs the tp-local head count "
+            f"{q.shape[2]}/tp={tp} divisible by cp={cp} "
             "(use cp_impl='ring' for few-head models)"
         )
-    if k.shape[2] % cp != 0:  # grouped K/V can't split over cp — repeat first
-        k = modeling._repeat_kv(k, q.shape[2] // k.shape[2])
-        v = modeling._repeat_kv(v, q.shape[2] // v.shape[2])
+    kv = k.shape[2]
+    if kv % tp or (kv // tp) % cp:  # grouped K/V can't split over tp×cp — repeat
+        k = modeling._repeat_kv(k, q.shape[2] // kv)
+        v = modeling._repeat_kv(v, q.shape[2] // kv)
     if cfg.attn_impl == "ring":  # never recurse into the ring dispatch
         cfg = cfg.replace(attn_impl="xla")
     axis = tuple(cp_axes)
-    spec = P(None, axis, None, None)
+    spec = P(tuple(batch_axes) or None, axis, tuple(head_axes) or None, None)
     mesh = ambient_or(mesh)
     fn = jax.shard_map(
         functools.partial(_a2a_attn_local, cfg=cfg, axis_name=axis, cp=cp),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        axis_names=set(cp_axes),
+        axis_names=manual_axis_names(mesh),
         check_vma=False,
     )
     return fn(q, k, v)
 
 
-def ulysses_decoder_layer(x, p, cfg: ModelConfig, mesh, cp_axes, cos_sin):
+def ulysses_decoder_layer(
+    x, p, cfg: ModelConfig, mesh, cp_axes, cos_sin,
+    batch_axes: Sequence[str] = (), head_axes: Sequence[str] = (),
+):
     """Decoder layer with the attention core Ulysses-parallelized (drop-in for
     modeling.decoder_layer when a layer strategy sets cp > 1, cp_impl='a2a').
     Projections and RoPE run at the global level (GSPMD shards them over the
@@ -88,7 +103,13 @@ def ulysses_decoder_layer(x, p, cfg: ModelConfig, mesh, cp_axes, cos_sin):
             k = modeling.apply_rope(k, cos, sin)
         # K/V stay at kv_heads across the all-to-all (GQA repeat happens in
         # the local attention core) — group_factor× less CP traffic
-        o = modeling._constrain_attn_out(ulysses_attention(q, k, v, cfg, mesh, cp_axes), cfg)
+        o = modeling._constrain_attn_out(
+            ulysses_attention(
+                q, k, v, cfg, mesh, cp_axes,
+                batch_axes=batch_axes, head_axes=head_axes,
+            ),
+            cfg,
+        )
         return modeling.attn_output(o, p["attn"], cfg, xn.dtype)
 
     x = x + attn(modeling.norm(x, p["attn_norm"], cfg))
