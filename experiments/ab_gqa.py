@@ -1,0 +1,100 @@
+"""On-chip A/B: GQA-native flash kernels (grouped K/V, resident-block reuse)
+vs the materialized-repeat path, on a GQA 7B shape (32 q / 8 kv heads).
+
+Measures a full decoder-layer forward (the production _attn_block_headmajor
+GQA branch) via one-dispatch chained windows (BASELINE.md round-2
+methodology). Run alone on the chip: python experiments/ab_gqa.py
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from galvatron_tpu.models import modeling
+from galvatron_tpu.models.modeling import ModelConfig
+
+
+def make_window(cfg, bsz, seq, iters, layers=4):
+    params = modeling.init_model_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((bsz, seq), jnp.int32)
+
+    def fwd(params, tokens, c):
+        x = modeling.embed(tokens, params, cfg)
+        x = x + c.astype(x.dtype)
+        cos_sin = modeling.rope_tables(cfg, seq)
+        for lp in params["layers"]:
+            x = modeling.decoder_layer(x, lp, cfg, cos_sin, None)
+        return jnp.sum(x.astype(jnp.float32))
+
+    @jax.jit
+    def window(params, tokens):
+        def body(c, _):
+            out = fwd(params, tokens, c * 1e-30)
+            return out * 1e-30, None
+
+        c, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=iters)
+        return c
+
+    _ = float(window(params, tokens))  # compile + warm
+
+    def run():
+        t0 = time.perf_counter()
+        _ = float(window(params, tokens))
+        return (time.perf_counter() - t0) * 1e3 / iters
+
+    return run
+
+
+def main():
+    bsz, seq, iters, layers = 8, 2048, 6, 4
+    base = dict(
+        vocab_size=32000, hidden_size=4096, num_layers=layers, num_heads=32,
+        num_kv_heads=8, ffn_dim=11008, max_seq_len=seq,
+        dtype=jnp.bfloat16, param_dtype=jnp.bfloat16, attn_impl="flash",
+    )
+    native = make_window(ModelConfig(**base), bsz, seq, iters, layers)
+
+    # repeated baseline: monkeypatch the GQA branch back to materialized
+    # repeat + full-head kernels
+    orig = modeling._attn_block_headmajor
+
+    def patched(x, p, cfg, rope, remat_attn):
+        from galvatron_tpu.ops.flash_attention import flash_attention_hm
+
+        b, s, h = x.shape
+        hd, n = cfg.head_dim, cfg.num_heads
+        w = p["wqkv"].astype(x.dtype)
+        kv, group = modeling.qkv_dims(cfg)
+        npg = group // hd - 2
+        r = jnp.einsum("bsh,hknd->bknsd", x, w.reshape(h, kv, npg + 2, hd))
+        q = r[:, :, :npg].reshape(b, n, s, hd)
+        k = modeling._repeat_kv_hm(r[:, :, npg], npg)
+        v = modeling._repeat_kv_hm(r[:, :, npg + 1], npg)
+        o = flash_attention_hm(q, k, v, causal=cfg.causal, rope=rope)
+        y = jnp.einsum("bnsd,nde->bse", o, p["wo"].astype(x.dtype).reshape(n, hd, h))
+        return y
+
+    modeling._attn_block_headmajor = patched
+    try:
+        repeated = make_window(ModelConfig(**base), bsz, seq, iters, layers)
+    finally:
+        modeling._attn_block_headmajor = orig
+
+    for rnd in range(4):
+        tn = min(native() for _ in range(3))
+        tr = min(repeated() for _ in range(3))
+        print(
+            f"round {rnd}: native {tn / layers / bsz:.4f} repeated "
+            f"{tr / layers / bsz:.4f} ms/layer/sample (delta "
+            f"{(tr - tn) / layers / bsz:+.4f})",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

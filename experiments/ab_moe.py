@@ -1,0 +1,51 @@
+"""On-chip MoE measurement (VERDICT r4 ask 3): switch-layer cost vs dense,
+and the measured expert-time fraction that replaces the param-fraction
+compute proxy in the EP search dimension.
+
+Run alone on the chip: python experiments/ab_moe.py
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import jax.numpy as jnp
+
+from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.profiling.model import profile_model
+
+BASE = dict(
+    vocab_size=8192, hidden_size=2048, num_layers=4, num_heads=16,
+    max_seq_len=2048, dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+    attn_impl="flash",
+)
+
+
+def main():
+    dense = profile_model(ModelConfig(**BASE), bsz=8, measure_time=True)
+    lt_d = dense.layer_types[0]
+    print(f"dense layer fwd: {lt_d.fwd_ms_per_sample:.4f} ms/sample", flush=True)
+
+    moe = profile_model(
+        ModelConfig(**BASE, moe_experts=8), bsz=8, measure_time=True
+    )
+    lt_m = moe.layer_types[0]
+    print(
+        f"switch-8 layer fwd: {lt_m.fwd_ms_per_sample:.4f} ms/sample "
+        f"({lt_m.fwd_ms_per_sample / lt_d.fwd_ms_per_sample:.2f}x dense)",
+        flush=True,
+    )
+    print(
+        f"expert param fraction (analytic, exact): {lt_m.moe_expert_param_fraction:.3f}",
+        flush=True,
+    )
+    print(
+        f"expert TIME fraction (measured, ep-shardable): "
+        f"{lt_m.moe_expert_time_fraction}",
+        flush=True,
+    )
+    print(f"a2a MB/sample (analytic): {lt_m.moe_a2a_mb_per_sample:.3f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

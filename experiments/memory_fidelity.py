@@ -1,0 +1,70 @@
+"""Memory-fidelity sweep: predicted MemoryCost vs TPU-topology-compiled MB.
+
+Produces the BASELINE.md table (VERDICT r4 ask 1). Run from the repo root:
+    python experiments/memory_fidelity.py
+"""
+
+import sys
+
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.search.memory_fidelity import fidelity_row, format_rows
+from galvatron_tpu.search.theoretical import analytic_model_costs
+
+CFG = ModelConfig(
+    vocab_size=512, hidden_size=512, num_layers=4, num_heads=4,
+    max_seq_len=512, dtype=jnp.bfloat16, attn_impl="flash",
+)
+BSZ = 16
+
+
+def hp(s: LayerStrategy, **kw) -> HybridParallelConfig:
+    kw.setdefault("vocab_tp", s.tp)
+    kw.setdefault("mixed_precision", "bf16")
+    return HybridParallelConfig(
+        layer_strategies=[s] * CFG.num_layers, **kw
+    )
+
+
+CELLS = [
+    ("tp1 ddp", hp(LayerStrategy(tp=1))),
+    ("tp2 ddp", hp(LayerStrategy(tp=2))),
+    ("tp2 sp", hp(LayerStrategy(tp=2, sp=True))),
+    ("tp1 zero2", hp(LayerStrategy(tp=1, dp_type="zero2"))),
+    ("tp1 zero3", hp(LayerStrategy(tp=1, dp_type="zero3"))),
+    ("tp2 zero3 sp", hp(LayerStrategy(tp=2, dp_type="zero3", sp=True))),
+    ("tp1 ckpt", hp(LayerStrategy(tp=1, ckpt="full"))),
+    ("tp1 chunks2", hp(LayerStrategy(tp=1), chunks=2)),
+    ("pp2 gpipe ch2", hp(LayerStrategy(tp=1), pp=2, chunks=2, pipeline_type="gpipe")),
+    ("pp2 gpipe ch4", hp(LayerStrategy(tp=1), pp=2, chunks=4, pipeline_type="gpipe")),
+    ("pp2 1f1b ch4", hp(LayerStrategy(tp=1), pp=2, chunks=4,
+                        pipeline_type="pipedream_flush")),
+    ("pp2 1f1b ch4 ckpt", hp(LayerStrategy(tp=1, ckpt="full"), pp=2, chunks=4,
+                             pipeline_type="pipedream_flush")),
+    ("pp2 1f1b tp2 ch4", hp(LayerStrategy(tp=2), pp=2, chunks=4,
+                            pipeline_type="pipedream_flush")),
+    ("pp4 1f1b ch4", hp(LayerStrategy(tp=1), pp=4, chunks=4,
+                        pipeline_type="pipedream_flush")),
+]
+
+
+def main() -> None:
+    costs = analytic_model_costs(CFG)
+    rows = []
+    for label, h in CELLS:
+        r = fidelity_row(label, costs, CFG, h, BSZ)
+        if r is None:
+            print(f"{label}: topology AOT unavailable")
+            continue
+        rows.append(r)
+        print(format_rows([r]).splitlines()[-1], flush=True)
+    print()
+    print(format_rows(rows))
+
+
+if __name__ == "__main__":
+    main()

@@ -814,8 +814,21 @@ def _attn_block_headmajor(x, p, cfg: ModelConfig, rope, remat_attn: bool):
         npg = group // hd - 2  # query heads per kv group, per the stored layout
         r = jnp.einsum("bsh,hknd->bknsd", x, w.reshape(h, kv, npg + 2, hd))
         q = r[:, :, :npg].reshape(b, n, s, hd)
-        k = _repeat_kv_hm(r[:, :, npg], npg)
-        v = _repeat_kv_hm(r[:, :, npg + 1], npg)
+        # GQA-NATIVE: K/V stay at kv_heads — the flash kernels serve each kv
+        # group's queries from the resident grouped block (flash_attention_hm
+        # kv_rep index maps), group-factor less K/V HBM traffic than the old
+        # materialized _repeat_kv_hm copy. EXCEPT when the layer's tp degree
+        # does not divide kv_heads: _flash_shard_map shards the head dim
+        # over the tp axes, so grouped K/V must be repeated first (the same
+        # guard ulysses applies) — q heads always divide tp.
+        k = r[:, :, npg]
+        v = r[:, :, npg + 1]
+        if cfg.flash_shard_ctx is not None:
+            mesh_, _, tp_ax = cfg.flash_shard_ctx
+            tp_deg = int(np.prod([mesh_.shape[a] for a in (tp_ax or ())]))
+            if tp_deg > 1 and kv % tp_deg:
+                k = _repeat_kv_hm(k, npg)
+                v = _repeat_kv_hm(v, npg)
 
     qkv_dim, rep_dim = (0, 1), (None, None)
     if rope is None:

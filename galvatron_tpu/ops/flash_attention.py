@@ -178,9 +178,15 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks, rope):
 
 
 def _flash_fwd(q, k, v, rope, sm_scale, causal, block_q, block_k, interpret,
-               out_dtype=None):
+               out_dtype=None, kv_rep: int = 1):
     """``out_dtype`` overrides the output dtype (ring attention asks for fp32
-    so per-hop block outputs are not requantized before the lse recombine)."""
+    so per-hop block outputs are not requantized before the lse recombine).
+
+    ``kv_rep`` > 1: GQA-native serving — k/v carry kv_heads = h/kv_rep and
+    their index maps send head h to kv group h // kv_rep, so the group's
+    queries share the RESIDENT K/V block (consecutive grid steps with an
+    unchanged block index skip the re-fetch) instead of reading a
+    materialized group-times-repeated copy from HBM."""
     b, h, s, d = q.shape
     nq, nk = s // block_q, s // block_k
     grid = (b, h, nq, nk)
@@ -196,8 +202,10 @@ def _flash_fwd(q, k, v, rope, sm_scale, causal, block_q, block_k, interpret,
     rope_specs, rope_inputs = _rope_io(rope, block_q, block_k, d, "ij")
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
-        pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
-        pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b_, h_, i, j: (b_, h_ // kv_rep, j, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b_, h_, i, j: (b_, h_ // kv_rep, j, 0)),
     ] + rope_specs
     inputs = [q, k, v] + rope_inputs
     out, lse = pl.pallas_call(
@@ -383,11 +391,13 @@ def _use_blocked(s, d, causal, rope, block_q, block_k):
 
 
 def _flash_fwd_blocked(
-    q, k, v, rope, sm_scale, block_q, interpret, out_dtype=None, qkv=None
+    q, k, v, rope, sm_scale, block_q, interpret, out_dtype=None, qkv=None,
+    kv_rep: int = 1,
 ):
     """Blocked-causal forward. Either q/k/v (b, h, s, d) separately, or
     ``qkv`` stacked (b, 3, h, s, d) consumed via index-mapped block specs
-    (no slice copies). Returns (out, lse)."""
+    (no slice copies). Returns (out, lse). ``kv_rep`` > 1: GQA-native k/v at
+    kv_heads = h/kv_rep, index-mapped h -> h // kv_rep (see _flash_fwd)."""
     stacked = qkv is not None
     if stacked:
         b, _, h, s, d = qkv.shape
@@ -418,8 +428,8 @@ def _flash_fwd_blocked(
         else:
             qkv_specs = [
                 pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i=i: (b_, h_, i, 0)),
-                pl.BlockSpec((1, 1, kl, d), lambda b_, h_: (b_, h_, 0, 0)),
-                pl.BlockSpec((1, 1, kl, d), lambda b_, h_: (b_, h_, 0, 0)),
+                pl.BlockSpec((1, 1, kl, d), lambda b_, h_: (b_, h_ // kv_rep, 0, 0)),
+                pl.BlockSpec((1, 1, kl, d), lambda b_, h_: (b_, h_ // kv_rep, 0, 0)),
             ]
         out_i, lse_i = pl.pallas_call(
             functools.partial(
@@ -912,9 +922,18 @@ def _flash_bwd_parts(
 
 
 def _fwd_dispatch(q, k, v, rope, sm_scale, causal, block_q, block_k, interpret):
+    # GQA-native: k/v may carry kv_heads < heads; the kernels serve each kv
+    # group's queries from the resident grouped K/V block (h -> h // rep
+    # index maps) instead of a materialized repeated copy
+    kv_rep = q.shape[1] // k.shape[1]
     if _use_blocked(q.shape[2], q.shape[3], causal, rope, block_q, block_k):
-        return _flash_fwd_blocked(q, k, v, rope, sm_scale, block_q, interpret)
-    return _flash_fwd(q, k, v, rope, sm_scale, causal, block_q, block_k, interpret)
+        return _flash_fwd_blocked(
+            q, k, v, rope, sm_scale, block_q, interpret, kv_rep=kv_rep
+        )
+    return _flash_fwd(
+        q, k, v, rope, sm_scale, causal, block_q, block_k, interpret,
+        kv_rep=kv_rep,
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
@@ -930,6 +949,18 @@ def _flash_fwd_rule(q, k, v, rope, sm_scale, causal, block_q, block_k):
 
 def _flash_bwd_rule(sm_scale, causal, block_q, block_k, res, do):
     q, k, v, out, lse, rope = res
+    kv_rep = q.shape[1] // k.shape[1]
+    if kv_rep > 1:
+        # backward serves the repeated layout (the bwd kernels accumulate dk
+        # per full head); group gradients are the exact sum over the group
+        b, kvh, s, d = k.shape
+        k = jnp.broadcast_to(k[:, :, None], (b, kvh, kv_rep, s, d)).reshape(
+            b, kvh * kv_rep, s, d
+        )
+        v = jnp.broadcast_to(v[:, :, None], (b, kvh, kv_rep, s, d)).reshape(
+            b, kvh * kv_rep, s, d
+        )
+        res = (q, k, v, out, lse, rope)
     if _use_blocked_bwd(q.shape[2], q.shape[3], causal, rope, block_q, block_k):
         bk, bq_sub = _bwd_blocks(block_q)
         dq, dk, dv = _flash_bwd_blocked(
@@ -937,6 +968,10 @@ def _flash_bwd_rule(sm_scale, causal, block_q, block_k, res, do):
         )
     else:
         dq, dk, dv = _flash_bwd(res, do, sm_scale, causal, block_q, block_k, _use_interpret())
+    if kv_rep > 1:
+        b, h, s, d = dk.shape
+        dk = dk.reshape(b, h // kv_rep, kv_rep, s, d).sum(axis=2)
+        dv = dv.reshape(b, h // kv_rep, kv_rep, s, d).sum(axis=2)
     drope = None if rope is None else jax.tree.map(jnp.zeros_like, rope)
     return dq, dk, dv, drope
 
@@ -960,13 +995,27 @@ def flash_attention_hm(
     (B,H,S,D) boundary transposes entirely. Callers that can produce q/k/v
     head-major (modeling's einsum projection) should use this; measured
     ~0.32 ms/layer/sample on the v5e 7B-shape bench vs the transposing
-    wrapper. Untileable shapes fall back through the (B,S,H,D) path."""
+    wrapper. Untileable shapes fall back through the (B,S,H,D) path.
+
+    GQA-NATIVE: k/v may carry kv_heads < heads (heads % kv_heads == 0) —
+    the forward kernels serve each kv group's queries from the resident
+    grouped K/V block instead of a materialized repeated copy (group-factor
+    less K/V HBM traffic; reference serves GQA natively the same way via
+    head-group splitting, galvatron/core/tensor_parallel/transformer.py:
+    679-708)."""
     b, h, s, d = q.shape
+    if h % k.shape[1]:
+        raise ValueError(f"heads {h} not divisible by kv_heads {k.shape[1]}")
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(d))
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if not flash_tileable(s, block_q) or not flash_tileable(s, block_k):
+        rep = h // k.shape[1]
+        if rep > 1:  # the (B,S,H,D) fallback expects repeated K/V
+            kvh = k.shape[1]
+            k = jnp.broadcast_to(k[:, :, None], (b, kvh, rep, s, d)).reshape(b, h, s, d)
+            v = jnp.broadcast_to(v[:, :, None], (b, kvh, rep, s, d)).reshape(b, h, s, d)
         out = flash_attention(
             jnp.transpose(q, (0, 2, 1, 3)),
             jnp.transpose(k, (0, 2, 1, 3)),

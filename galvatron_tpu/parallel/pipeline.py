@@ -78,12 +78,22 @@ from galvatron_tpu.parallel.sharding import (
     with_flash_shard_ctx,
 )
 
-def cpu_sim_compiler_options():
+def cpu_sim_compiler_options(mesh=None):
     """XLA:CPU's all-reduce-promotion pass check-fails (CreateBinary with a
     copy opcode, hlo_instruction.cc:1585) on the copy-reduction all-reduces
     GSPMD emits for the sub-f32 pipeline backward — any bf16/fp16 GPipe or
     interleaved train step aborts the process on the CPU *simulation*. Real
-    TPU backends never run that pass. Disable it per-compile on CPU only."""
+    TPU backends never run that pass. Disable it per-compile on CPU only —
+    keyed on the TARGET mesh's device platform (when given), not the
+    process default backend: a TPU-topology AOT compile from a
+    JAX_PLATFORMS=cpu process must NOT get the flag (it measurably changes
+    the TPU buffer plan)."""
+    if mesh is not None:
+        try:
+            platform = mesh.devices.flat[0].platform
+        except Exception:
+            platform = jax.default_backend()
+        return {"xla_disable_hlo_passes": "all-reduce-promotion"} if platform == "cpu" else None
     if jax.default_backend() == "cpu":
         return {"xla_disable_hlo_passes": "all-reduce-promotion"}
     return None
@@ -594,7 +604,7 @@ def build_pipeline_runtime(
     shardings = sharding_tree(mesh, specs)
     batch_sharding = NamedSharding(mesh, P(("pp",) + axes.data_axes, None))
 
-    copts = cpu_sim_compiler_options()
+    copts = cpu_sim_compiler_options(mesh)
     jit_train = jax.jit(
         train_step,
         in_shardings=(shardings, batch_sharding),

@@ -339,7 +339,7 @@ def make_1f1b_train_step(
     shardings = sharding_tree(mesh, specs)
     batch_sharding = NamedSharding(mesh, P(("pp",) + axes.data_axes, None))
 
-    copts = cpu_sim_compiler_options()
+    copts = cpu_sim_compiler_options(mesh)
     jit_train = jax.jit(
         train_step,
         in_shardings=(shardings, batch_sharding),

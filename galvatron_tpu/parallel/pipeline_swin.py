@@ -626,7 +626,7 @@ def build_swin_pipeline_runtime(
         specs["scaler"] = jax.tree.map(lambda _: P(), state_shape["scaler"])
     shardings = sharding_tree(mesh, specs)
     batch_sharding = NamedSharding(mesh, P(("pp",) + axes.data_axes, None))
-    copts = cpu_sim_compiler_options()
+    copts = cpu_sim_compiler_options(mesh)
     jit_train = jax.jit(
         train_step,
         in_shardings=(shardings, batch_sharding),

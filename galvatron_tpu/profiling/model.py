@@ -303,6 +303,31 @@ def profile_model(
         other_ms = max(0.0, (t1 - fwd_ms * 3.0 * bsz * l1) / bsz / 3.0)
     else:
         fwd_ms, other_ms = 1.0, 0.1
+
+    # MoE: MEASURE the expert-time fraction (the ep-shardable share of the
+    # switch layer's time) by a two-point fit of the marginal layer time
+    # over the expert FFN width — t(f) = a + b*f, expert share = b*f/(a+b*f);
+    # the intercept a is the routing/sinkhorn/dispatch overhead that does
+    # NOT shard by ep (the param-fraction proxy overstated the ep win by
+    # pricing it as shardable). Measured on-chip (experiments/ab_moe.py).
+    moe_tfrac = None
+    if measure_time and cfg.moe_experts > 0:
+        try:
+            f1 = cfg.ffn
+            f2 = max(256, (f1 // 4 + 255) // 256 * 256)
+            if f2 < f1:
+                cfg_small = cfg.replace(ffn_dim=f2)
+                ts1 = _iter_time_ms(cfg_small.replace(num_layers=l1), bsz, seq)
+                ts2 = _iter_time_ms(cfg_small.replace(num_layers=l2), bsz, seq)
+                fwd_small = max(1e-4, (ts2 - ts1) / (l2 - l1) / bsz / 3.0)
+                b_slope = (fwd_ms - fwd_small) / (f1 - f2)
+                # a degenerate fit (non-positive slope: noise or a too-small
+                # model) must fall back to the param proxy, not price EP as
+                # zero benefit
+                if b_slope > 0:
+                    moe_tfrac = float(min(b_slope * f1 / fwd_ms, 0.99))
+        except Exception:
+            moe_tfrac = None  # leave the param-fraction proxy in place
     cfg1, cfg2 = cfg.replace(num_layers=l1), cfg.replace(num_layers=l2)
 
     b1, b2 = _temp_bytes(cfg1, bsz, seq), _temp_bytes(cfg2, bsz, seq)
@@ -344,6 +369,7 @@ def profile_model(
                 boundary_activation_mb_per_sample=float(boundary_mb),
                 moe_expert_param_fraction=float(moe_frac),
                 moe_a2a_mb_per_sample=float(moe_a2a),
+                moe_expert_time_fraction=moe_tfrac,
             )
         },
         other_param_mb=float(other_param_count(cfg) * 4 / 1e6),

@@ -57,6 +57,12 @@ class ProfiledLayerType:
     # never searches EP (SURVEY §2.3 ⚠) — this closes that gap.
     moe_expert_param_fraction: float = 0.0
     moe_a2a_mb_per_sample: float = 0.0
+    # MEASURED share of the switch layer's fwd time that scales with ep
+    # (the expert GEMMs; routing/sinkhorn/dispatch einsums do NOT shard by
+    # ep). None → fall back to the param-fraction proxy. Measured on-chip by
+    # profiling/model.py's two-point ffn fit (experiments/ab_moe.py,
+    # BASELINE.md round-5).
+    moe_expert_time_fraction: Optional[float] = None
 
     def __post_init__(self):
         if not (0.0 <= self.moe_expert_param_fraction < 1.0):
@@ -228,15 +234,30 @@ def layer_memory_cost(
     exp_mb = lt.parameter_mb * frac / (s.tp * ep)
     dp_exp = max(1, dp // ep)
     p_mb = dense_mb + exp_mb
-    # fp32 master + grad + two Adam moments = 4x; bf16 adds a half-weight cast
-    cast = 0.5 * p_mb if mixed_precision in ("bf16", "fp16") else 0.0
+    sharded_mb = dense_mb / dp + exp_mb / dp_exp
+    # Persistent states = fp32 master + two Adam moments = 3x. The naive
+    # 4th "gradient" copy does NOT persist in this runtime: the donated
+    # fused train step consumes grads layer-by-layer into the aliased
+    # update, so a full-model gradient never materializes — EXCEPT when the
+    # step accumulates (pp engines carry a per-stage fp32 dw in the tick
+    # carry; the pp=1 accumulation scan carries one across micro-batches),
+    # which adds one fp32 grad at the parameter's own sharding. The bf16
+    # working cast is likewise per-layer transient (cast → consume → free),
+    # not a persistent 0.5x copy — it is charged once per device as part of
+    # transient_overhead_mb, not per layer. Measured: memory-fidelity sweep
+    # vs the v5e:2x4 topology compiler, experiments/memory_fidelity.py
+    # (BASELINE.md round-5).
     if s.dp_type == "zero3":
-        # cast buffer = gathered working copy
-        states = 4.0 * (dense_mb / dp + exp_mb / dp_exp) + cast
+        states = 3.0 * sharded_mb
+        grad_acc = sharded_mb
     elif s.dp_type == "zero2":
-        states = 2.0 * p_mb + 2.0 * (dense_mb / dp + exp_mb / dp_exp) + cast
+        states = p_mb + 2.0 * sharded_mb
+        grad_acc = sharded_mb
     else:
-        states = 4.0 * p_mb + cast
+        states = 3.0 * p_mb
+        grad_acc = p_mb
+    if pp > 1 or chunks > 1:
+        states += grad_acc
     local_bsz = global_bsz / dp / max(1, s.cp)
     mb_bsz = local_bsz / chunks
     # 'full' remat stores only the layer-boundary activation; 'selective'
@@ -256,12 +277,103 @@ def layer_memory_cost(
             + act_per_mb
         )
     elif pipeline_type == "gpipe":
-        act = act_per_mb * chunks
-    else:  # 1F1B: bounded in-flight stash (interleaved 1F1B: the mirrored
-        # backward wave holds up to 3*pp+1 micro-batches per virtual stage)
-        bound = 2 * (pp - 1 - stage_idx) + 1 if vpp == 1 else 3 * pp + 1
-        act = act_per_mb * min(chunks, bound)
+        # the clocked scan's autodiff saves the stage residuals EVERY tick —
+        # bubble ticks included (invalid ticks compute on garbage but their
+        # residuals are stacked all the same) — so the charge is per tick
+        # (chunks + pp - 1), not per micro-batch (measured 0.58-0.71
+        # underprediction with the act x chunks charge; see the fidelity
+        # sweep table in BASELINE.md)
+        act = act_per_mb * (chunks + pp - 1)
+    else:
+        # 1F1B engines (single-stack pipeline_1f1b and interleaved
+        # pipeline_interleaved 1F1B) stash only (virtual-)stage INPUT
+        # boundaries in a ring and recompute the stage forward in the
+        # backward tick — the per-layer share is ONE live micro-batch of
+        # residuals; the boundary stash rings + fp32 cotangent ring are
+        # per-stage constants charged at the engine level
+        # (search_engine pf_overhead), exactly like the coupled engines'.
+        act = act_per_mb
     return MemoryCost(states, act, states + act)
+
+
+def transient_overhead_mb(
+    costs: ProfiledModelCosts,
+    min_tp: int = 1,
+    mixed_precision: str = "bf16",
+) -> float:
+    """Per-device transient working set charged ONCE (not per layer): the
+    bf16 weight cast (0.5x the layer's params) plus one in-flight fp32
+    gradient of the largest layer — the donated fused step keeps at most
+    ~one layer's cast+grad live at a time (measured: the fidelity sweep's
+    temp decomposition, BASELINE.md round-5). ``min_tp``: the smallest tp
+    any layer may choose (the worst per-device share)."""
+    if not costs.layer_types:
+        return 0.0
+    p_l = max(lt.parameter_mb for lt in costs.layer_types.values()) / max(1, min_tp)
+    cast = 0.5 * p_l if mixed_precision in ("bf16", "fp16") else 0.0
+    return cast + p_l
+
+
+def stash_ring_mb(
+    lt: ProfiledLayerType,
+    s: LayerStrategy,
+    slots: int,
+    world: int,
+    pp: int,
+    global_bsz: int,
+    chunks: int,
+    mixed_precision: str = "bf16",
+    stage_idx: int = 0,
+    vpp: int = 1,
+) -> float:
+    """Per-device MB of ONE coupled/single-stack 1F1B input-stash ring of
+    ``slots`` boundary micro-batch slots at strategy ``s``, isolated as the
+    difference of layer_memory_cost at bounds (slots, 0) so the formula
+    stays the cost model's (states cancel exactly). The runtime allocates
+    one sacrificial slot beyond the useful min(chunks, slots)."""
+    if not slots:
+        return 0.0
+    kw = dict(
+        stage_idx=stage_idx, pipeline_type="pipedream_flush",
+        mixed_precision=mixed_precision, vpp=vpp,
+    )
+    hi = layer_memory_cost(
+        lt, s, world, pp, global_bsz, chunks, stash_boundary_bound=slots, **kw
+    ).total_mb
+    lo = layer_memory_cost(
+        lt, s, world, pp, global_bsz, chunks, stash_boundary_bound=0, **kw
+    ).total_mb
+    useful = min(chunks, slots)
+    return (hi - lo) * (useful + 1) / useful
+
+
+def single_1f1b_rings_mb(
+    lt: ProfiledLayerType,
+    s: LayerStrategy,
+    world: int,
+    pp: int,
+    global_bsz: int,
+    chunks: int,
+    mixed_precision: str = "bf16",
+    vpp: int = 1,
+) -> float:
+    """Per-device constants of the single-stack/interleaved 1F1B engines
+    (pipeline_1f1b.py / pipeline_interleaved.py carries), priced at the
+    stage's own strategy sharding: the (virtual-)stage input stash ring —
+    (min(chunks, n_stash)+1) boundary micro-batch slots, vpp rings when
+    interleaved — plus the fp32 dx_embed input-cotangent buffer of chunks+1
+    slots (allocated on every stage: the SPMD carry is uniform). The ONE
+    pricing shared by the search (SearchEngine._1f1b_rings_mb) and the
+    fidelity harness (memory_fidelity.predicted_train_mb)."""
+    n_stash = (2 * pp - 1) if vpp == 1 else (3 * pp + 1)
+    stash = stash_ring_mb(
+        lt, s, n_stash, world, pp, global_bsz, chunks, mixed_precision, vpp=vpp
+    ) * max(1, vpp)
+    fp32x = 2.0 if mixed_precision in ("bf16", "fp16") else 1.0
+    dx = stash_ring_mb(
+        lt, s, chunks, world, pp, global_bsz, chunks, mixed_precision, vpp=vpp
+    )
+    return stash + dx * fp32x
 
 
 def other_memory_cost(
@@ -401,11 +513,19 @@ def layer_time_cost(
     grad reduction is NOT inflated."""
     dp = world // (pp * s.tp * s.cp)
     local_bsz = global_bsz / dp / max(1, s.cp)
-    # expert compute (≈ the expert param fraction of layer FLOPs) divides by
-    # ep on top of tp; the dense remainder divides by tp only
+    # expert compute divides by ep on top of tp; the dense remainder divides
+    # by tp only. The ep-shardable share is the MEASURED expert-time
+    # fraction when the profile carries one (routing/dispatch overhead does
+    # not shard by ep — the param-fraction proxy overstates the ep win);
+    # param fraction otherwise.
     frac = lt.moe_expert_param_fraction
+    tfrac = (
+        lt.moe_expert_time_fraction
+        if lt.moe_expert_time_fraction is not None
+        else frac
+    )
     per_sample = lt.fwd_ms_per_sample * (
-        (1.0 - frac) / s.tp + frac / (s.tp * max(1, s.ep))
+        (1.0 - tfrac) / s.tp + tfrac / (s.tp * max(1, s.ep))
     )
     fwd = per_sample * local_bsz
     factor = (
@@ -477,18 +597,30 @@ def pipeline_time_cost(
     chunks: int,
     hw: ProfiledHardware,
     vpp: int = 1,
+    pipeline_type: str = "gpipe",
 ) -> float:
     """Iteration time of the clocked pipeline (reference: pipeline_costmodel,
     galvatron/core/cost_model.py:372-427): fill + steady-state bottleneck.
-    stage_ms: per-stage per-micro-batch compute+TP time.
+    stage_ms: per-stage per-micro-batch compute+TP time (callers price
+    pipedream_flush's per-tick forward recompute into stage_ms via
+    REMAT_FULL_FACTOR — the hand-written 1F1B engines replay the stage
+    forward from the input stash in every backward tick).
 
     vpp>1 (interleaved schedule): ticks are one virtual stage (1/vpp of a
     physical stage) long, so the pp-1-tick fill bubble shrinks by vpp, while
     every micro-batch crosses vpp× more ring boundaries (p2p volume ×vpp).
-    The vpp=1 case reduces to the plain formula."""
+    The vpp=1 case reduces to the plain formula.
+
+    pipedream_flush tick counts come from the engines: single-stack
+    T = chunks + 2(pp-1) (pipeline_1f1b.py) vs gpipe's chunks + pp - 1;
+    interleaved 1F1B T = vpp*chunks + vpp*pp + pp - 1
+    (pipeline_interleaved.py:276) — its drain scales with vpp too."""
     if pp == 1:
         return sum(stage_ms)
     p2p_ms = boundary_msg_mb / hw.p2p(pp) if boundary_msg_mb else 0.0
     per_tick = [c / vpp + p2p_ms for c in stage_ms]
     bottleneck = max(per_tick)
-    return sum(per_tick) + bottleneck * (vpp * chunks - 1)
+    extra = 0
+    if pipeline_type == "pipedream_flush":
+        extra = (pp - 1) if vpp == 1 else vpp * pp
+    return sum(per_tick) + bottleneck * (vpp * chunks - 1 + extra)

@@ -27,6 +27,9 @@ import numpy as np
 from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy, form_strategy
 from galvatron_tpu.search.cost_model import (
     REMAT_FULL_FACTOR,
+    single_1f1b_rings_mb,
+    stash_ring_mb,
+    transient_overhead_mb,
     MemoryCost,
     ProfiledHardware,
     ProfiledLayerType,
@@ -187,20 +190,19 @@ class SearchEngine:
         useful ones (pipeline_swin.py `(n_s[k] + 1,) + shp[k]`, same in
         pipeline_encdec), so the charge is min(chunks, slots) useful slots
         plus one unconditional."""
-        if not slots:
-            return 0.0
-        kw = dict(
-            stage_idx=stage_idx, pipeline_type="pipedream_flush",
-            mixed_precision=self.mp, vpp=vpp,
+        return stash_ring_mb(
+            lt, s, slots, world, pp, global_bsz, chunks, self.mp,
+            stage_idx=stage_idx, vpp=vpp,
         )
-        hi = layer_memory_cost(
-            lt, s, world, pp, global_bsz, chunks, stash_boundary_bound=slots, **kw
-        ).total_mb
-        lo = layer_memory_cost(
-            lt, s, world, pp, global_bsz, chunks, stash_boundary_bound=0, **kw
-        ).total_mb
-        useful = min(chunks, slots)
-        return (hi - lo) * (useful + 1) / useful
+
+    def _1f1b_rings_mb(
+        self, lt: ProfiledLayerType, s: LayerStrategy, world: int, pp: int,
+        global_bsz: int, chunks: int, vpp: int = 1,
+    ) -> float:
+        """See cost_model.single_1f1b_rings_mb (the one shared pricing)."""
+        return single_1f1b_rings_mb(
+            lt, s, world, pp, global_bsz, chunks, self.mp, vpp=vpp
+        )
 
     def _layer_type(self, i: int) -> ProfiledLayerType:
         lts = self.costs.layer_types
@@ -420,7 +422,7 @@ class SearchEngine:
             # later positions keep one live micro-batch
             # (stash_boundary_bound=0 bypasses the single-stack in-flight
             # bound without adding ring slots)
-            stash_bound, ring = None, 0
+            stash_bound, ring, single_ring = None, 0, False
             if multi_type is not None and pipeline_type == "pipedream_flush":
                 stash_bound = 0
                 if j in (0, lpe):
@@ -429,15 +431,21 @@ class SearchEngine:
                 stash_bound = 0
                 if j == 0 or pos_sec[j] != pos_sec[j - 1]:
                     ring = 2 * (len(swin_groups) - pos_sec[j]) * pp - 1
-            # coupled 1F1B: every backward tick recomputes its section from
-            # the stashed input ONCE regardless of the layer's own ckpt
-            # setting — layer_time_cost prices compute at
-            # max(strategy factor, full-replay factor) and the TP replay,
-            # without inflating the once-per-iteration DP reduction
+            elif pp > 1 and pipeline_type == "pipedream_flush":
+                # single-stack/interleaved 1F1B: input stash ring + fp32
+                # dx_embed ring, charged once at the first position at the
+                # strategy's own sharding (_1f1b_rings_mb)
+                single_ring = j == 0
+            # EVERY pipedream_flush engine (single-stack pipeline_1f1b,
+            # interleaved, coupled enc-dec, Swin sections) recomputes its
+            # (virtual) stage forward from the stashed input in the backward
+            # tick, regardless of the layer's own ckpt setting —
+            # layer_time_cost prices compute at max(strategy factor,
+            # full-replay factor) and the TP replay, without inflating the
+            # once-per-iteration DP reduction
             recompute = (
                 REMAT_FULL_FACTOR
-                if (multi_type is not None or swin_groups is not None)
-                and pipeline_type == "pipedream_flush"
+                if pp > 1 and pipeline_type == "pipedream_flush"
                 else None
             )
             for k, s in enumerate(cands):
@@ -453,6 +461,10 @@ class SearchEngine:
                 total_mb = pos_layers * vpp * mc.total_mb + self._ring_mb(
                     lt, s, ring, world, pp, global_bsz, chunks, vpp=vpp
                 )
+                if single_ring:
+                    total_mb += self._1f1b_rings_mb(
+                        lt, s, world, pp, global_bsz, chunks, vpp=vpp
+                    )
                 mem[j, k] = max(1, int(np.ceil(total_mb / self.unit)))
                 intra[j, k] = pos_layers * layer_time_cost(
                     lt, s, self.hw, world, pp, global_bsz, mixed_precision=self.mp,
@@ -497,11 +509,18 @@ class SearchEngine:
             fp32x = 2.0 if self.mp in ("bf16", "fp16") else 1.0
             rows = global_bsz / max(1, world // (pp * max(s.tp for s in cands)))
             pf_overhead = sec0_b * rows * ((chunks + 1) / chunks) * fp32x
+        # (single-stack/interleaved 1F1B rings are charged per strategy in
+        # the mem table — _1f1b_rings_mb at the first position)
+        # one-off transient working set (bf16 cast + in-flight grad of the
+        # largest layer at the candidate worst-case tp)
+        trans_mb = transient_overhead_mb(
+            self.costs, min(s.tp for s in cands), self.mp
+        )
         for vt, et in pairs:
             other_mb = other_memory_cost(
                 self.costs, world, pp, vocab_tp=vt, embed_dp_type=et,
                 global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,
-            ) + pf_overhead
+            ) + pf_overhead + trans_mb
             budget = self.budget_mb - other_mb
             if budget <= 0:
                 continue
@@ -529,6 +548,7 @@ class SearchEngine:
                         [per_stage_ms] * pp,
                         self._boundary_msg_mb(lt0, global_bsz, chunks),
                         pp, chunks, self.hw, vpp=vpp,
+                        pipeline_type=pipeline_type,
                     )
             else:
                 total_ms = cost
@@ -798,7 +818,9 @@ class SearchEngine:
         other_mb = other_memory_cost(
             self.costs, world, pp, vocab_tp=vt, embed_dp_type=et,
             global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,
-        ) + r.details.get("coupled_1f1b_overhead_mb", 0.0)
+        ) + r.details.get("coupled_1f1b_overhead_mb", 0.0) + transient_overhead_mb(
+            self.costs, min(s.tp for s in cands), self.mp
+        )
         budget = self.budget_mb - other_mb
         if budget <= 0:
             return None
@@ -831,8 +853,12 @@ class SearchEngine:
                     out[first.get(g, 0)][3].append(ring)
             return [(a, b, c, tuple(r)) for a, b, c, r in out]
 
+        single_pf = False
         if len(groups) == 1:
             mode = "single"
+            if pipeline_type == "pipedream_flush":
+                recompute = REMAT_FULL_FACTOR  # same per-tick stage replay
+                single_pf = True
             lps = -(-self.L // pp)
             stage_positions = [[(lt0, None, 1, ())] * lps for _ in range(pp)]
         elif len(groups) == 2 and not self.section_pipeline:
@@ -904,8 +930,8 @@ class SearchEngine:
 
         mem_rows: Dict[tuple, np.ndarray] = {}
 
-        def mem_row(lt, stash, n_lay, st, rings) -> np.ndarray:
-            key = (id(lt), stash, n_lay, st, tuple((id(r), n) for r, n in rings))
+        def mem_row(lt, stash, n_lay, st, rings, first=False) -> np.ndarray:
+            key = (id(lt), stash, n_lay, st, tuple((id(r), n) for r, n in rings), first)
             if key not in mem_rows:
                 def total(s):
                     mc = layer_memory_cost(
@@ -914,13 +940,18 @@ class SearchEngine:
                         stash_boundary_bound=stash,
                     ).total_mb
                     # rings are per-section, charged once (evaluate() rule)
-                    return n_lay * mc + sum(
+                    out = n_lay * mc + sum(
                         self._ring_mb(
                             rlt, s, slots, world, pp, global_bsz, chunks,
                             stage_idx=st,
                         )
                         for rlt, slots in rings
                     )
+                    if first:  # single-stack 1F1B stash + dx_embed rings
+                        out += self._1f1b_rings_mb(
+                            lt, s, world, pp, global_bsz, chunks
+                        )
+                    return out
 
                 mem_rows[key] = np.array([
                     max(1, int(np.ceil(total(s) / self.unit))) for s in cands
@@ -939,7 +970,7 @@ class SearchEngine:
             intra = np.zeros((n_pos, S), np.float64)
             for j, (lt, stash, n_lay, rings) in enumerate(poss):
                 intra[j] = intra_row(lt) * n_lay
-                mem[j] = mem_row(lt, stash, n_lay, st, rings)
+                mem[j] = mem_row(lt, stash, n_lay, st, rings, first=single_pf and j == 0)
             cost, res, _ = run_dp(mem, intra, inter, V)
             if not np.isfinite(cost) or (res < 0).any():
                 return None
@@ -947,7 +978,8 @@ class SearchEngine:
             per_stage.append([form_strategy(cands[k], pp, world // (pp * cands[k].tp * cands[k].cp)) for k in res])
         if mode == "single":
             unrestricted = pipeline_time_cost(
-                stage_ms, self._boundary_msg_mb(lt0, global_bsz, chunks), pp, chunks, self.hw
+                stage_ms, self._boundary_msg_mb(lt0, global_bsz, chunks),
+                pp, chunks, self.hw, pipeline_type=pipeline_type,
             )
         else:
             unrestricted = self._coupled_total_ms(

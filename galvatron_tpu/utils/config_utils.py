@@ -65,6 +65,7 @@ def save_profiled_model(costs: ProfiledModelCosts, time_path=None, mem_path=None
                 "boundary_activation_mb_per_sample": lt.boundary_activation_mb_per_sample,
                 "moe_expert_param_fraction": lt.moe_expert_param_fraction,
                 "moe_a2a_mb_per_sample": lt.moe_a2a_mb_per_sample,
+                "moe_expert_time_fraction": lt.moe_expert_time_fraction,
             }
         mem["other"] = {
             "param_mb": costs.other_param_mb,
@@ -129,6 +130,11 @@ def _load_layer_type(t, m) -> ProfiledLayerType:
         boundary_activation_mb_per_sample=float(m["boundary_activation_mb_per_sample"]),
         moe_expert_param_fraction=float(m.get("moe_expert_param_fraction", 0.0)),
         moe_a2a_mb_per_sample=float(m.get("moe_a2a_mb_per_sample", 0.0)),
+        moe_expert_time_fraction=(
+            None
+            if m.get("moe_expert_time_fraction") is None
+            else float(m["moe_expert_time_fraction"])
+        ),
     )
 
 

@@ -1,0 +1,124 @@
+"""Memory-fidelity: MemoryCost predictions vs the TPU compiler's reality.
+
+Fast tests pin the refit model's STRUCTURE (engine semantics measured in
+round 5 — BASELINE.md fidelity tables); the slow test compiles real cells
+against the v5e:2x4 topology and pins predicted/measured bands.
+"""
+
+import numpy as np
+import pytest
+
+from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+from galvatron_tpu.search.cost_model import (
+    ProfiledLayerType,
+    ProfiledModelCosts,
+    layer_memory_cost,
+    transient_overhead_mb,
+)
+
+LT = ProfiledLayerType(
+    fwd_ms_per_sample=1.0, parameter_mb=100.0,
+    activation_mb_per_sample={1: 10.0, 2: 6.0},
+    boundary_activation_mb_per_sample=2.0,
+)
+
+
+def test_states_semantics_donated_step():
+    """Persistent states are 3x (master + two moments), NOT the naive 4x:
+    the donated fused step never materializes a full-model gradient — except
+    when accumulating (chunks>1 or pp>1), which adds one fp32 grad at the
+    param's sharding. The bf16 cast is a one-off transient, not 0.5x/layer."""
+    ddp1 = layer_memory_cost(LT, LayerStrategy(tp=1), 8, 1, 8, chunks=1)
+    assert ddp1.states_mb == pytest.approx(300.0)
+    ddp2 = layer_memory_cost(LT, LayerStrategy(tp=1), 8, 1, 8, chunks=2)
+    assert ddp2.states_mb == pytest.approx(400.0)  # + fp32 accumulator
+    z3 = layer_memory_cost(LT, LayerStrategy(tp=1, dp_type="zero3"), 8, 1, 8, chunks=1)
+    assert z3.states_mb == pytest.approx(3 * 100.0 / 8)
+    z3a = layer_memory_cost(LT, LayerStrategy(tp=1, dp_type="zero3"), 8, 1, 8, chunks=2)
+    assert z3a.states_mb == pytest.approx(4 * 100.0 / 8)  # sharded accumulator
+    z2 = layer_memory_cost(LT, LayerStrategy(tp=1, dp_type="zero2"), 8, 1, 8, chunks=1)
+    assert z2.states_mb == pytest.approx(100.0 + 2 * 100.0 / 8)
+    costs = ProfiledModelCosts(layer_types={0: LT})
+    # transient: 0.5x cast + one in-flight fp32 grad of the largest layer
+    assert transient_overhead_mb(costs, 1, "bf16") == pytest.approx(150.0)
+    assert transient_overhead_mb(costs, 2, "bf16") == pytest.approx(75.0)
+    assert transient_overhead_mb(costs, 1, "fp32") == pytest.approx(100.0)
+
+
+def test_pipeline_activation_semantics():
+    """gpipe: the clocked scan's autodiff saves stage residuals per TICK
+    (chunks + pp - 1), bubble ticks included. 1F1B: the engines stash only
+    stage-input boundaries and recompute (pipeline_1f1b.py), so the
+    per-layer share is ONE live micro-batch — the stash rings are engine
+    constants (search _1f1b_rings_mb), not per-layer terms."""
+    s = LayerStrategy(tp=1)
+    # pp=2, world 8 → dp=4; bsz 8, chunks 2 → mb_bsz 1; act 10/mb
+    gp = layer_memory_cost(LT, s, 8, 2, 8, chunks=2, pipeline_type="gpipe")
+    assert gp.activation_mb == pytest.approx(10.0 * (2 + 2 - 1))
+    f1 = layer_memory_cost(LT, s, 8, 2, 8, chunks=2, pipeline_type="pipedream_flush")
+    assert f1.activation_mb == pytest.approx(10.0)
+    # coupled branch (stash_boundary_bound) unchanged: bounded boundary
+    # stash + one live micro-batch
+    cp = layer_memory_cost(
+        LT, s, 8, 2, 8, chunks=4, pipeline_type="pipedream_flush",
+        stash_boundary_bound=3,
+    )
+    assert cp.activation_mb == pytest.approx(2.0 * 0.5 * 3 + 10.0 * 0.5)
+
+
+def test_1f1b_repriced_vs_gpipe_time():
+    """The 1F1B engines replay each stage forward (recompute), so their
+    compute prices at the full-remat factor and the schedule runs
+    chunks + 2(pp-1) ticks — the search must now see gpipe as the faster
+    schedule when memory allows, and 1F1B as the bounded-memory one."""
+    from galvatron_tpu.search.cost_model import ProfiledHardware, pipeline_time_cost
+
+    hw = ProfiledHardware(allreduce_bw={"2_1": 100.0}, p2p_bw={2: 50.0})
+    gp = pipeline_time_cost([10.0] * 2, 1.0, 2, 4, hw, pipeline_type="gpipe")
+    pf = pipeline_time_cost([10.0] * 2, 1.0, 2, 4, hw, pipeline_type="pipedream_flush")
+    assert pf > gp  # extra (pp-1) drain ticks at the same stage time
+
+
+@pytest.mark.slow
+def test_fidelity_bands_on_topology():
+    """Predicted vs TPU-topology-compiled per-device MB on four strategy
+    classes (the small fidelity shape; full tables incl. a 7B-representative
+    shape in BASELINE.md round 5). Bands are regression guards around the
+    measured ratios: the old 4x-states/act-x-inflight model priced these
+    cells at 1.4-2.5x — a return of that class of error blows the caps."""
+    import jax.numpy as jnp
+
+    from galvatron_tpu.models.modeling import ModelConfig
+    from galvatron_tpu.search.memory_fidelity import fidelity_row
+    from galvatron_tpu.search.theoretical import analytic_model_costs
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=512, num_layers=4, num_heads=4,
+        max_seq_len=512, dtype=jnp.bfloat16, attn_impl="flash",
+    )
+    costs = analytic_model_costs(cfg)
+
+    def hp(s, **kw):
+        kw.setdefault("vocab_tp", s.tp)
+        kw.setdefault("mixed_precision", "bf16")
+        return HybridParallelConfig(layer_strategies=[s] * 4, **kw)
+
+    cells = [
+        ("tp1 ddp", hp(LayerStrategy(tp=1)), (0.85, 1.35)),
+        ("tp1 ckpt", hp(LayerStrategy(tp=1, ckpt="full")), (0.80, 1.25)),
+        ("pp2 gpipe ch2",
+         hp(LayerStrategy(tp=1), pp=2, chunks=2, pipeline_type="gpipe"),
+         (0.55, 1.10)),  # documented underprediction: scan backward extras
+        # band upper edge: the measured temp of this small cell varies
+        # ~17% with process-level jax platform config (98-115 MB observed —
+        # XLA scheduling, not model error); the guard is against the old
+        # act-x-inflight model's 2.5x error class
+        ("pp2 1f1b ch4",
+         hp(LayerStrategy(tp=1), pp=2, chunks=4, pipeline_type="pipedream_flush"),
+         (0.75, 1.75)),
+    ]
+    for label, h, (lo, hi) in cells:
+        r = fidelity_row(label, costs, cfg, h, 16)
+        if r is None:
+            pytest.skip("TPU topology AOT unavailable")
+        assert lo <= r.ratio <= hi, (label, r.ratio, r.predicted_mb, r.measured_mb)

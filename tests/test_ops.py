@@ -512,3 +512,69 @@ def test_cp_composes_with_pipeline_parallelism(impl):
         st, loss = rt.train_step(st, rt.shard_batch(b))
         losses.append(float(loss))
     np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_gqa_native_matches_repeated():
+    """GQA-native kernels (grouped K/V, h -> h//rep index maps) must match
+    the repeated-K/V path exactly — forward AND gradients (whose dk/dv are
+    the exact group sums), blocked-causal and grid paths."""
+    from galvatron_tpu.ops.flash_attention import flash_attention_hm
+
+    b, n, kvh, s, d = 2, 4, 2, 128, 32
+    ks = jax.random.split(jax.random.key(21), 3)
+    q = jax.random.normal(ks[0], (b, n, s, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, kvh, s, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, kvh, s, d), jnp.float32)
+    cos, sin = _rope_tables(s, d)
+
+    def rep(x):
+        return jnp.broadcast_to(x[:, :, None], (b, kvh, n // kvh, s, d)).reshape(
+            b, n, s, d
+        )
+
+    for rope in [(cos, sin), None]:  # blocked-causal path / grid path
+        def f_native(q, k, v):
+            return (flash_attention_hm(q, k, v, causal=True, rope=rope) ** 2).sum()
+
+        def f_rep(q, k, v):
+            return (flash_attention_hm(q, rep(k), rep(v), causal=True, rope=rope) ** 2).sum()
+
+        np.testing.assert_allclose(
+            float(f_native(q, k, v)), float(f_rep(q, k, v)), rtol=2e-5
+        )
+        gn = jax.grad(f_native, argnums=(0, 1, 2))(q, k, v)
+        # rep() inside f_rep: autodiff through the broadcast group-sums the
+        # repeated-path dk/dv, so both sides are grouped (b, kvh, s, d)
+        gr = jax.grad(f_rep, argnums=(0, 1, 2))(q, k, v)
+        for a, bb in zip(gn, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(bb), rtol=5e-4, atol=5e-4
+            )
+
+
+def test_gqa_flash_tp_exceeding_kv_heads_trains():
+    """tp > kv_heads on a GQA flash model: the shard_map shards the head dim
+    over tp, so grouped K/V (kv_heads < tp) must be repeated first — the
+    guard in _attn_block_headmajor (review regression: the GQA-native change
+    initially broke every tp>kv_heads flash config)."""
+    from galvatron_tpu.core.optim import AdamConfig
+    from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+    from galvatron_tpu.parallel.hybrid import build_runtime
+
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=128, num_heads=8, num_kv_heads=2,
+        ffn_dim=256, max_seq_len=32, attn_impl="flash",
+    )
+    hp = HybridParallelConfig(
+        layer_strategies=[LayerStrategy(tp=4, dp_type="zero3")] * 2,
+        vocab_tp=4, mixed_precision="fp32",
+    )
+    cfg = cfg.replace(num_layers=2, dtype=jnp.float32)
+    rt = build_runtime(cfg, hp, adam=AdamConfig(lr=3e-3), global_batch_size=8, seq_len=32)
+    state = rt.init_state(jax.random.key(0))
+    batch = jnp.asarray(np.random.RandomState(0).randint(0, 128, (8, 33)), jnp.int32)
+    l0 = None
+    for _ in range(4):
+        state, loss = rt.train_step(state, batch)
+        l0 = l0 if l0 is not None else float(loss)
+    assert np.isfinite(float(loss)) and float(loss) < l0
