@@ -280,10 +280,14 @@ def layer_memory_cost(
         # the clocked scan's autodiff saves the stage residuals EVERY tick —
         # bubble ticks included (invalid ticks compute on garbage but their
         # residuals are stacked all the same) — so the charge is per tick
-        # (chunks + pp - 1), not per micro-batch (measured 0.58-0.71
-        # underprediction with the act x chunks charge; see the fidelity
-        # sweep table in BASELINE.md)
-        act = act_per_mb * (chunks + pp - 1)
+        # (chunks + pp - 1), not per micro-batch. Under bf16/fp16 compute
+        # the MEASURED per-tick residency is ~2x the compute-dtype estimate
+        # (TPU-topology fit: needed factors 1.7-2.6 across shapes, 2.0
+        # centers the class — consistent with fp32 widening of saved
+        # residuals in the manual-region backward; BASELINE.md round-5
+        # fidelity tables). fp32 compute is already wide.
+        widen = 2.0 if mixed_precision in ("bf16", "fp16") else 1.0
+        act = act_per_mb * (chunks + pp - 1) * widen
     else:
         # 1F1B engines (single-stack pipeline_1f1b and interleaved
         # pipeline_interleaved 1F1B) stash only (virtual-)stage INPUT
