@@ -402,15 +402,11 @@ def _load_or_init_params(ns, cfg):
     from galvatron_tpu.models import modeling
 
     if getattr(ns, "load", None):
-        import orbax.checkpoint as ocp
+        from galvatron_tpu.core.checkpoint import restore_raw_checkpoint
 
-        from galvatron_tpu.core.checkpoint import latest_step
-
-        load_dir = os.path.abspath(ns.load)
-        step = latest_step(load_dir)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {load_dir}")
-        raw = ocp.StandardCheckpointer().restore(os.path.join(load_dir, f"step_{step}"))
+        # verified restore with newest→oldest fallback: a corrupt latest
+        # checkpoint cannot silently serve garbage weights
+        raw, _step = restore_raw_checkpoint(os.path.abspath(ns.load))
         params = raw["params"] if isinstance(raw, dict) and "params" in raw else raw
         # validate against the model config before silently generating garbage
         abstract = jax.eval_shape(lambda k: modeling.init_model_params(k, cfg), jax.random.key(0))

@@ -1,0 +1,58 @@
+"""jax version compatibility shims.
+
+The runtime is written against the jax >= 0.6 stable surface
+(``jax.shard_map`` with ``axis_names=``/``check_vma=``,
+``jax.sharding.get_abstract_mesh``, ``jax.sharding.AxisType``); older jax
+only ships the experimental spellings (``jax.experimental.shard_map`` with
+``auto=``/``check_rep=``, no ambient AbstractMesh). One internal module
+adapts, and the repo's call sites import from here — the third-party jax
+namespace is never mutated, so other libraries' ``hasattr``-based jax
+feature detection (and their own calling conventions against the real
+APIs) keep working in the same process.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  axis_names=None, check_vma=None, **kw):
+        """jax < 0.6: ``axis_names=`` names the MANUAL axes; the experimental
+        API's ``auto=`` is the complement set. ``check_vma=`` is the old
+        ``check_rep=``. Only the conventions this repo uses are translated —
+        an explicit ``auto=``/``check_rep=`` passes through untouched."""
+        auto = kw.pop("auto", None)
+        check_rep = kw.pop("check_rep", None)
+        if auto is None and axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if check_rep is None:
+            check_rep = True if check_vma is None else bool(check_vma)
+        return _experimental_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_rep, auto=auto or frozenset(), **kw,
+        )
+
+if hasattr(jax.sharding, "get_abstract_mesh"):
+    get_abstract_mesh = jax.sharding.get_abstract_mesh
+else:
+    def get_abstract_mesh():
+        """Pre-typed-mesh jax has no ambient AbstractMesh with Manual-typed
+        axes. Returning None makes constrain()/ambient_or() fall back to the
+        concrete mesh — exactly the pre-AbstractMesh behavior on 0.4.x —
+        and manual_axis_names() to "manualize every axis"."""
+        return None
+
+if hasattr(jax.sharding, "AxisType"):
+    AxisType = jax.sharding.AxisType
+else:
+    class AxisType(enum.Enum):
+        Auto = "auto"
+        Explicit = "explicit"
+        Manual = "manual"

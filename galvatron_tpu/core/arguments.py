@@ -119,6 +119,14 @@ def _add_training_args(p: argparse.ArgumentParser):
     g.add_argument("--metrics_path", type=str, default=None,
                    help="JSONL structured metrics sink (per-iter loss/time)")
     g.add_argument("--save", type=str, default=None, help="checkpoint directory")
+    g.add_argument("--keep_last_n", type=int, default=0,
+                   help="checkpoint retention: after each committed save, "
+                   "prune all but the newest N committed steps (0 = keep all)")
+    g.add_argument("--anomaly_max_skips", type=int, default=0,
+                   help="non-finite-loss policy (core/resilience.py): skip up "
+                   "to N consecutive NaN/Inf updates (state rolled back, batch "
+                   "dropped), then abort with an emergency checkpoint; 0 = "
+                   "disarmed (no rollback snapshot, no per-iter loss sync)")
     g.add_argument("--load", type=str, default=None, help="resume directory")
     g.add_argument("--load_hf", type=str, default=None,
                    help="initialize weights from a local HuggingFace "

@@ -1,4 +1,4 @@
-"""Sharded checkpoint save/restore with resume.
+"""Sharded checkpoint save/restore with resume — atomic and verified.
 
 The reference's trainer never saves (SURVEY §5: only an unused --load_params
 flag; the vendored Megatron checkpointing.py/dist_checkpointing are not
@@ -6,15 +6,71 @@ integrated). Here sharded save/restore is first-class via Orbax: each leaf is
 written from its NamedSharding layout and restored into the (possibly
 different) target sharding, so a run searched onto a new strategy can resume
 from an old layout.
+
+Commit protocol (the resilience layer — production TPU-pod training is
+dominated by preemptions and transient storage faults):
+
+1. data is written into a ``step_N.tmp`` staging directory;
+2. a **manifest** (per-leaf shapes/dtypes + sha256 content digests, plus a
+   sha256 digest of every file in the staging dir) is written into the
+   staging dir *last* and fsynced — it is the commit marker: a directory
+   without a parseable manifest is never a checkpoint;
+3. one ``rename(step_N.tmp → step_N)`` publishes the step atomically.
+
+File digests are verified BEFORE any restore is attempted: decoding
+corrupted compressed chunks is undefined behaviour in the storage stack
+(observed as heap corruption), so a corrupt step must be detected from the
+raw bytes and never handed to the array reader. The per-leaf digests remain
+as the end-to-end check on what was actually restored.
+
+A kill at any point leaves either the old committed set untouched or a
+``.tmp`` orphan that :func:`latest_step` garbage-collects and never selects.
+Restores verify the manifest (shape/dtype/digest per leaf) and, when no
+explicit step was requested, **fall back to the next-older committed step**
+on corruption (``ckpt_fallback`` metrics event). Saves retry transient
+I/O errors with exponential backoff (core/retry.py) and honour the
+``--keep_last_n`` retention policy. On multi-controller deployments the
+commit (file digests, manifest, rename) has exactly one writer — process 0
+— with a cross-process barrier after it; leaves that cannot be
+host-gathered from one process carry structure-only manifest records
+(digest None), and the per-file digests remain the byte-level guard.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Optional
+import re
+import shutil
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
+
+from galvatron_tpu.core import faults
+from galvatron_tpu.core.retry import with_retries
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_VERSION = 1
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_TMP_SUFFIX = ".tmp"
+_OLD_SUFFIX = ".old"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A committed checkpoint failed content verification (digest/shape/dtype
+    mismatch against its manifest, or an unreadable payload whose structure
+    the manifest proves should match)."""
+
+
+class CheckpointVerificationIOError(CheckpointCorruptError):
+    """Verification could not READ the step (transient I/O outlasted the
+    retry budget) — indistinguishable from corruption for fallback purposes
+    (skip to an older step), but it must never trigger quarantine: renaming
+    healthy steps aside during a storage outage would hide every committed
+    checkpoint and cause the silent restart-from-scratch this whole layer
+    exists to prevent."""
 
 
 def _ocp():
@@ -23,34 +79,461 @@ def _ocp():
     return ocp
 
 
-def save_checkpoint(ckpt_dir: str, state: Any, step: int) -> str:
-    """Writes state (params/opt/step pytree) under ckpt_dir/step_N."""
-    ocp = _ocp()
-    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, state, force=True)
-    ckptr.wait_until_finished()
-    return path
+def parse_step_name(name: str) -> Optional[int]:
+    """Strict committed-step-name parser: ``step_<digits>`` only — partial
+    saves (``step_N.tmp``), renamed-aside dirs and arbitrary ``step_*``
+    artifacts never parse."""
+    m = _STEP_RE.match(name)
+    return int(m.group(1)) if m else None
+
+
+def step_path(ckpt_dir: str, step: int) -> str:
+    return os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+
+
+def read_manifest(path: str) -> Optional[Dict[str, Any]]:
+    """The step's manifest, or None when absent/unparseable (uncommitted or
+    pre-manifest legacy dir)."""
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(m, dict) or not isinstance(m.get("leaves"), dict):
+        return None
+    return m
+
+
+def gc_stale_tmp(ckpt_dir: str) -> List[str]:
+    """Best-effort cleanup of save-protocol leftovers. Orphaned staging dirs
+    (a kill mid-save leaves ``step_N.tmp`` behind) are removed; a
+    ``step_N.old`` renamed aside by an interrupted re-save swap is renamed
+    BACK into place when ``step_N`` is missing (the old committed data must
+    survive a kill between the swap's two renames) and removed once the swap
+    is known complete. Single-writer per directory is assumed — the GC runs
+    from the resume path and the saver's own process, never concurrently
+    with another host's staging."""
+    removed = []
+    if not os.path.isdir(ckpt_dir):
+        return removed
+    for name in os.listdir(ckpt_dir):
+        full = os.path.join(ckpt_dir, name)
+        if not os.path.isdir(full):
+            continue
+        if name.endswith(_OLD_SUFFIX) and parse_step_name(
+            name[: -len(_OLD_SUFFIX)]
+        ) is not None:
+            final = full[: -len(_OLD_SUFFIX)]
+            if os.path.isdir(final):
+                shutil.rmtree(full, ignore_errors=True)  # swap completed
+                removed.append(full)
+            else:
+                # swap died mid-way: restore the old committed copy.
+                # Best-effort — on multi-host resume every process scans the
+                # shared dir and exactly one rename wins the race
+                try:
+                    os.rename(full, final)
+                except OSError:
+                    pass
+        elif name.startswith("step_") and name.endswith(_TMP_SUFFIX):
+            shutil.rmtree(full, ignore_errors=True)
+            removed.append(full)
+    return removed
+
+
+def _scan_steps(ckpt_dir: str, with_manifest: bool) -> List[int]:
+    """Ascending strictly-named step dirs, split by the commit marker (a
+    parseable manifest) — one scan loop so future selection changes cannot
+    diverge the committed vs legacy views."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    steps = []
+    for name in os.listdir(ckpt_dir):
+        s = parse_step_name(name)
+        if s is None:
+            continue
+        full = os.path.join(ckpt_dir, name)
+        if os.path.isdir(full) and (read_manifest(full) is not None) == with_manifest:
+            steps.append(s)
+    return sorted(steps)
+
+
+def committed_steps(ckpt_dir: str) -> List[int]:
+    """Ascending step numbers whose directories are committed (strict name
+    AND a parseable manifest — the commit marker)."""
+    return _scan_steps(ckpt_dir, with_manifest=True)
+
+
+def uncommitted_steps(ckpt_dir: str) -> List[int]:
+    """Step-named directories with NO manifest: either a pre-manifest legacy
+    checkpoint (written before the commit protocol — possibly resumable via
+    an explicit ``step=``) or a partial save left by the pre-protocol code.
+    Callers that find no committed steps should surface these instead of
+    silently starting from scratch."""
+    return _scan_steps(ckpt_dir, with_manifest=False)
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
-    if not os.path.isdir(ckpt_dir):
-        return None
-    steps = []
-    for name in os.listdir(ckpt_dir):
-        if name.startswith("step_"):
-            try:
-                steps.append(int(name.split("_")[1]))
-            except ValueError:
-                pass
-    return max(steps) if steps else None
+    """Newest committed step (stale ``.tmp`` staging dirs are GC'd on the
+    way); None when no committed checkpoint exists."""
+    gc_stale_tmp(ckpt_dir)
+    steps = committed_steps(ckpt_dir)
+    return steps[-1] if steps else None
+
+
+def _no_checkpoints_message(ckpt_dir: str) -> str:
+    legacy = uncommitted_steps(ckpt_dir)
+    if legacy:
+        return (
+            f"no committed checkpoints under {ckpt_dir} — but steps "
+            f"{legacy} exist without a manifest (pre-commit-protocol legacy "
+            "saves, or partial writes by a pre-protocol revision). Restore "
+            "one explicitly with step=N to bypass the commit check, then "
+            "re-save to commit it."
+        )
+    return f"no checkpoints under {ckpt_dir}"
+
+
+def _leaf_digest(leaf: Any) -> Dict[str, Any]:
+    if not getattr(leaf, "is_fully_addressable", True):
+        # multi-controller: this process cannot host-gather a globally
+        # sharded array — record structure only (digest None is understood
+        # by verify_manifest as "not checkable"); the per-file digests still
+        # guard the bytes on disk
+        return {
+            "shape": list(leaf.shape),
+            "dtype": str(np.dtype(leaf.dtype)),
+            "digest": None,
+        }
+    arr = np.ascontiguousarray(np.asarray(leaf))
+    return {
+        "shape": list(arr.shape),
+        "dtype": str(arr.dtype),
+        "digest": "sha256:" + hashlib.sha256(arr.tobytes()).hexdigest(),
+    }
+
+
+def _manifest_of(state: Any, step: int) -> Dict[str, Any]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    return {
+        "version": MANIFEST_VERSION,
+        "step": int(step),
+        "leaves": {jax.tree_util.keystr(kp): _leaf_digest(x) for kp, x in flat},
+    }
+
+
+def _file_digests(root: str) -> Dict[str, Dict[str, Any]]:
+    """sha256 + size of every file under a step directory (manifest
+    excluded) — the pre-decode integrity record."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for dirpath, _, files in os.walk(root):
+        for fn in files:
+            if fn == MANIFEST_NAME:
+                continue
+            full = os.path.join(dirpath, fn)
+            h = hashlib.sha256()
+            with open(full, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            out[os.path.relpath(full, root)] = {
+                "size": os.path.getsize(full),
+                "digest": "sha256:" + h.hexdigest(),
+            }
+    return out
+
+
+def verify_files(path: str, manifest: Dict[str, Any]) -> List[str]:
+    """Raw-byte verification of a step directory against its manifest's file
+    records. Runs BEFORE any restore: corrupted compressed chunks must never
+    reach the array decoder (undefined behaviour in the storage stack), so
+    corruption is detected from the bytes on disk. Empty when the manifest
+    predates file records."""
+    want = manifest.get("files")
+    if not want:
+        return []
+    errs: List[str] = []
+    got = _file_digests(path)
+    for rel in sorted(set(want) | set(got)):
+        w, g = want.get(rel), got.get(rel)
+        if w is None:
+            errs.append(f"unexpected file {rel}")
+        elif g is None:
+            errs.append(f"missing file {rel}")
+        elif g["size"] != w.get("size"):
+            errs.append(
+                f"file {rel} size mismatch ({g['size']} bytes, "
+                f"manifest records {w.get('size')})"
+            )
+        elif g != w:
+            errs.append(
+                f"file {rel} content digest mismatch "
+                f"(size {g['size']} matches — bytes corrupted in place)"
+            )
+    return errs
+
+
+def _verify_files_pod(path: str, manifest: Dict[str, Any]) -> List[str]:
+    """File verification with exactly one reader on multi-controller pods:
+    process 0 hashes (mirroring the single-writer commit) and broadcasts the
+    verdict, so every process raises — or proceeds into the collective
+    restore — identically. N hosts independently re-hashing a multi-GB
+    checkpoint would multiply the resume-critical-path I/O N-fold, and a
+    host-local torn read diverging one process's verdict would mismatch the
+    collective and hang the pod."""
+    if jax.process_count() == 1:
+        try:
+            # the hash pass re-reads every checkpoint byte — the single most
+            # I/O-heavy step of resume, so it gets the same transient-retry
+            # treatment as the restore itself
+            return with_retries(
+                lambda: verify_files(path, manifest),
+                describe=f"file verification of {path}",
+            )
+        except OSError as e:
+            # still unreadable after retries: the fallback may move to an
+            # older step, but the distinct type forbids quarantine — a
+            # storage outage must not rename healthy checkpoints aside
+            raise CheckpointVerificationIOError(
+                f"could not read {path} for verification after retries: "
+                f"{str(e)[:200]}"
+            ) from e
+    from jax.experimental import multihost_utils
+
+    # verdict codes broadcast from the single verifier: 0 ok, 1 content
+    # mismatch (quarantinable corruption), 2 verification read error
+    errs: List[str] = []
+    code = 0
+    if jax.process_index() == 0:
+        try:
+            errs = with_retries(
+                lambda: verify_files(path, manifest),
+                describe=f"file verification of {path}",
+            )
+            code = 1 if errs else 0
+        except Exception as e:
+            # the broadcast below MUST be reached: peers are already parked
+            # inside broadcast_one_to_all, and raising here would wedge the
+            # pod — a read failure becomes a broadcast verdict, not a hang
+            code = 2
+            errs = [str(e)[:200]]
+    code = int(multihost_utils.broadcast_one_to_all(np.int32(code)))
+    if code == 2:
+        raise CheckpointVerificationIOError(
+            "file verification read failed on process 0"
+            + (f": {errs[0]}" if errs else "")
+        )
+    if code == 1 and not errs:
+        errs = ["file verification failed on process 0"]
+    return errs if code else []
+
+
+def _verify_step_files(
+    path: str, step: int, where: str, manifest: Optional[Dict[str, Any]]
+) -> None:
+    """Shared pre-decode gate of every restore path: raise
+    :class:`CheckpointCorruptError` when the step's bytes don't match its
+    manifest's file records (no-op for manifests predating file records)."""
+    if manifest is None:
+        return
+    ferrs = _verify_files_pod(path, manifest)
+    if ferrs:
+        raise CheckpointCorruptError(
+            f"step {step} under {where} failed file verification: "
+            + "; ".join(ferrs[:5])
+        )
+
+
+def verify_manifest(manifest: Dict[str, Any], state: Any) -> List[str]:
+    """Per-leaf shape/dtype/content-digest check of a (restored) state tree
+    against its manifest; returns human-readable mismatch descriptions."""
+    errs: List[str] = []
+    want = manifest.get("leaves", {})
+    seen = set()
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        k = jax.tree_util.keystr(kp)
+        seen.add(k)
+        rec = want.get(k)
+        if rec is None:
+            errs.append(f"leaf {k} not in manifest")
+            continue
+        got = _leaf_digest(leaf)
+        for field in ("shape", "dtype", "digest"):
+            if field == "digest" and (
+                got["digest"] is None or rec.get("digest") is None
+            ):
+                # either side not host-gatherable (multi-controller):
+                # content is guarded by the per-file digests instead
+                continue
+            if got[field] != rec.get(field):
+                errs.append(
+                    f"leaf {k} {field} mismatch: checkpoint has {got[field]}, "
+                    f"manifest records {rec.get(field)}"
+                )
+                break
+    errs.extend(f"manifest leaf {k} missing from checkpoint" for k in sorted(set(want) - seen))
+    return errs
+
+
+def _content_only_match(manifest: Dict[str, Any], state: Any) -> bool:
+    """Keypath-free equality: the multiset of (shape, dtype, digest) leaf
+    records matches the manifest's. A digest of None (either side — a
+    structure-only record from a multihost save, or a non-addressable
+    restored leaf) is a wildcard: within its (shape, dtype) group only the
+    leaf COUNT is checked, since content there is guarded by the per-file
+    digests instead — comparing None against a real sha256 would wrongly
+    reject every healthy pod-written checkpoint restored raw."""
+    from collections import defaultdict
+
+    def grouped(records):
+        groups: Dict[Any, List[Optional[str]]] = defaultdict(list)
+        for r in records:
+            groups[(tuple(r.get("shape", ())), r.get("dtype"))].append(
+                r.get("digest")
+            )
+        return groups
+
+    got = grouped(_leaf_digest(x) for x in jax.tree_util.tree_leaves(state))
+    want = grouped(manifest.get("leaves", {}).values())
+    if set(got) != set(want):
+        return False
+    for key, want_digests in want.items():
+        got_digests = got[key]
+        if len(got_digests) != len(want_digests):
+            return False
+        if None in got_digests or None in want_digests:
+            continue  # wildcard group: count match is all that's checkable
+        if sorted(got_digests) != sorted(want_digests):
+            return False
+    return True
+
+
+def _pod_sync(tag: str) -> None:
+    """Cross-process barrier on multi-controller deployments; no-op on a
+    single controller (every test and CPU-sim path)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+def _retry_unless_collective(fn, describe: str):
+    """I/O retry wrapper for orbax save/restore calls: on a multi-controller
+    pod these are COLLECTIVE, and a lone process re-entering one while its
+    peers have moved on deadlocks the pod — there the call gets exactly one
+    try and the failure surfaces. Single controller retries as usual."""
+    if jax.process_count() > 1:
+        return fn()
+    return with_retries(fn, describe=describe)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # not all filesystems expose dir fds; rename is still atomic
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _apply_retention(ckpt_dir: str, keep_last_n: int) -> None:
+    for s in committed_steps(ckpt_dir)[:-keep_last_n]:
+        shutil.rmtree(step_path(ckpt_dir, s), ignore_errors=True)
+
+
+def save_checkpoint(
+    ckpt_dir: str, state: Any, step: int, keep_last_n: int = 0,
+    meta: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Writes state (params/opt/step pytree) under ckpt_dir/step_N with the
+    atomic commit protocol (staging dir → fsynced manifest → rename); retries
+    transient I/O with backoff; ``keep_last_n > 0`` prunes older committed
+    steps after the new one lands. ``meta`` (JSON-serializable) rides along
+    in the manifest — the trainer records batches-consumed there, which
+    diverges from the step count once anomaly skips happen."""
+    ocp = _ocp()
+    base = os.path.abspath(ckpt_dir)
+    final = os.path.join(base, f"step_{step}")
+    tmp = final + _TMP_SUFFIX
+    manifest = _manifest_of(state, step)
+    if meta:
+        manifest["meta"] = dict(meta)
+
+    multi = jax.process_count() > 1
+
+    def write_data():
+        if os.path.isdir(tmp) and (not multi or jax.process_index() == 0):
+            shutil.rmtree(tmp)
+        if multi:
+            _pod_sync(f"ckpt_clean_{step}")
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(tmp, state, force=True)
+        ckptr.wait_until_finished()
+        faults.crash("mid_save")  # injection point: preemption before commit
+
+    def commit():
+        manifest["files"] = _file_digests(tmp)
+        mpath = os.path.join(tmp, MANIFEST_NAME)
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.isdir(final):
+            # re-save of an existing step: swap via a recoverable .old side
+            # name — a kill between the two renames leaves step_N.old (the
+            # old committed data), which gc_stale_tmp renames back into
+            # place; at no point are both copies GC-able
+            old = final + _OLD_SUFFIX
+            if os.path.isdir(old):
+                shutil.rmtree(old)
+            os.rename(final, old)
+            os.rename(tmp, final)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(tmp, final)
+        _fsync_dir(base)
+
+    if multi:
+        # the orbax write is COLLECTIVE across processes: it must run exactly
+        # once per process and never sit inside a retry loop (a lone process
+        # retrying a collective — or re-entering the pre-clean barrier while
+        # the others wait at the commit barrier — deadlocks the pod). Only
+        # the single-writer commit I/O on process 0 is retried.
+        write_data()
+        try:
+            if jax.process_index() == 0:
+                with_retries(commit, describe=f"checkpoint commit step {step}")
+        finally:
+            # process 0 must reach the barrier even when the commit failed —
+            # its peers are already waiting inside _pod_sync, and
+            # sync_global_devices has no peer-failure detection, so raising
+            # before the barrier would hang the pod instead of surfacing the
+            # error. After the sync the peers' view stays consistent: an
+            # uncommitted step has no manifest, so latest_step never selects
+            # it and the failure propagates from process 0's exception.
+            _pod_sync(f"ckpt_commit_{step}")  # no process races ahead
+    else:
+        # two retry units, not one: a transient failure in the tiny commit
+        # (manifest write / rename) must not re-run the multi-GB data write
+        with_retries(write_data, describe=f"checkpoint save step {step}")
+        with_retries(commit, describe=f"checkpoint commit step {step}")
+    faults.after_commit(final)  # injection point: post-commit storage corruption
+    if keep_last_n > 0 and jax.process_index() == 0:
+        _apply_retention(base, keep_last_n)
+    return final
 
 
 def restore_checkpoint(ckpt_dir: str, abstract_state: Any, step: Optional[int] = None) -> Any:
     """Restores into the shardings carried by ``abstract_state`` (a pytree of
     jax.ShapeDtypeStruct with .sharding — e.g. from eval_shape + the runtime's
     state_shardings). Cross-strategy resume falls out: Orbax reshards on
-    load.
+    load. The restored tree is verified against the step's manifest
+    (shape/dtype/content digest per leaf); failures raise
+    :class:`CheckpointCorruptError`, which the no-explicit-step portable
+    restore path treats as "fall back to the next-older committed step".
 
     Layout note: the blocked fused-QKV change (models/modeling.py:qkv_dims)
     made MHA ``wqkv`` leaves rank-3; a checkpoint written by the earlier
@@ -61,16 +544,57 @@ def restore_checkpoint(ckpt_dir: str, abstract_state: Any, step: Optional[int] =
     if step is None:
         step = latest_step(ckpt_dir)
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+            raise FileNotFoundError(_no_checkpoints_message(ckpt_dir))
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+    manifest = read_manifest(path)
+    # detected from raw bytes, before the array decoder ever sees corrupt chunks
+    _verify_step_files(path, step, ckpt_dir, manifest)
+    # the manifest proves what tree structure is on disk: when it matches the
+    # target, a restore failure cannot be a layout mismatch — it is corruption
+    structure_matches = manifest is not None and set(
+        manifest["leaves"]
+    ) == _tree_keypaths(abstract_state)
     ckptr = ocp.StandardCheckpointer()
     try:
-        return ckptr.restore(path, abstract_state)
+        restored = _retry_unless_collective(
+            lambda: ckptr.restore(path, abstract_state),
+            describe=f"checkpoint restore step {step}",
+        )
     except Exception as e:
         msg = _legacy_layout_message(abstract_state, str(e))
         if msg:
             raise ValueError(msg) from e
+        if structure_matches:
+            if isinstance(e, OSError):
+                # transient I/O that outlasted the retry budget, not proven
+                # corruption: fallback may proceed, quarantine must not
+                raise CheckpointVerificationIOError(
+                    f"step {step} under {ckpt_dir} could not be read after "
+                    f"retries: {str(e)[:500]}"
+                ) from e
+            raise CheckpointCorruptError(
+                f"step {step} under {ckpt_dir} matches the target structure "
+                f"but failed to restore (corrupt payload): {str(e)[:500]}"
+            ) from e
         raise
+    if manifest is not None and structure_matches:
+        errs = verify_manifest(manifest, restored)
+        if errs:
+            raise CheckpointCorruptError(
+                f"step {step} under {ckpt_dir} failed content verification: "
+                + "; ".join(errs[:5])
+            )
+    # defensive copy: restored leaves can be backed by the storage layer's
+    # own buffers, and the trainer donates its state into train_step —
+    # donating storage-owned buffers is a double-free (observed as heap
+    # corruption on the second post-resume step). jnp.copy re-lands every
+    # leaf in XLA-owned buffers; one transient 2x of state memory, at
+    # restore time only.
+    import jax.numpy as jnp
+
+    restored = jax.tree.map(jnp.copy, restored)
+    jax.block_until_ready(restored)
+    return restored
 
 
 def _legacy_layout_message(abstract_state: Any, err: str) -> Optional[str]:
@@ -115,7 +639,10 @@ def _legacy_layout_message(abstract_state: Any, err: str) -> Optional[str]:
     return None
 
 
-def save_checkpoint_portable(ckpt_dir: str, state: Any, step: int, runtime) -> str:
+def save_checkpoint_portable(
+    ckpt_dir: str, state: Any, step: int, runtime, keep_last_n: int = 0,
+    meta: Optional[Dict[str, Any]] = None,
+) -> str:
     """Save in the PORTABLE (flat-layers) layout: pipeline engines unstack
     their stage/virtual-stage parameter stacks first, so a checkpoint saved
     at any (pp, vpp, schedule, division) restores into any other — the
@@ -123,7 +650,9 @@ def save_checkpoint_portable(ckpt_dir: str, state: Any, step: int, runtime) -> s
     saves at all, SURVEY §5)."""
     f = runtime.flatten_params
     if f is None:
-        return save_checkpoint(ckpt_dir, state, step)
+        return save_checkpoint(
+            ckpt_dir, state, step, keep_last_n=keep_last_n, meta=meta
+        )
 
     def flatten_state(st):
         out = dict(st)
@@ -133,7 +662,9 @@ def save_checkpoint_portable(ckpt_dir: str, state: Any, step: int, runtime) -> s
 
     # one compiled program instead of per-leaf eager slice dispatches
     flat = jax.jit(flatten_state)(state)
-    return save_checkpoint(ckpt_dir, flat, step)
+    return save_checkpoint(
+        ckpt_dir, flat, step, keep_last_n=keep_last_n, meta=meta
+    )
 
 
 def _tree_keypaths(tree) -> set:
@@ -156,7 +687,7 @@ def _checkpoint_layout(
     if step is None:
         step = latest_step(ckpt_dir)
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+            raise FileNotFoundError(_no_checkpoints_message(ckpt_dir))
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
     try:
         meta = ocp.StandardCheckpointer().metadata(path)
@@ -172,9 +703,95 @@ def _checkpoint_layout(
     return "neither"
 
 
-def restore_checkpoint_portable(ckpt_dir: str, runtime, step: Optional[int] = None) -> Any:
+def restore_checkpoint_portable(
+    ckpt_dir: str, runtime, step: Optional[int] = None, metrics=None
+) -> Any:
     """Restore a portable (flat-layout) checkpoint into the runtime's own
-    layout, resharding as needed. Flat leaves restore under the per-layer
+    layout, resharding as needed (see ``_restore_checkpoint_portable_at``).
+
+    When no explicit ``step`` is requested, committed steps are tried newest
+    → oldest: a checkpoint that fails manifest verification (or whose payload
+    is unreadable despite a structure-matching manifest) is skipped with a
+    ``ckpt_fallback`` event on ``metrics`` (any object with a
+    ``.log(event, **fields)`` method, e.g. utils.metrics.MetricsLogger) —
+    a corrupt latest save can no longer take down resume."""
+    if step is not None:
+        return _restore_checkpoint_portable_at(ckpt_dir, runtime, step)
+    gc_stale_tmp(ckpt_dir)  # also recovers a .old from an interrupted swap
+    steps = committed_steps(ckpt_dir)
+    if not steps:
+        raise FileNotFoundError(_no_checkpoints_message(ckpt_dir))
+    return _try_newest_first(
+        list(reversed(steps)),
+        lambda s: _restore_checkpoint_portable_at(ckpt_dir, runtime, s),
+        f"all {len(steps)} committed checkpoints under {ckpt_dir} failed "
+        "verification",
+        metrics=metrics,
+        quarantine_base=os.path.abspath(ckpt_dir),
+    )
+
+
+def _try_newest_first(
+    steps, restore_one, exhausted_msg: str, metrics=None,
+    quarantine_base: Optional[str] = None,
+):
+    """THE fallback protocol, shared by every no-explicit-step restore path:
+    try ``restore_one(step)`` newest → oldest, skipping steps that fail
+    verification (``ckpt_fallback`` metrics event per skip when ``metrics``
+    is given); raises :class:`CheckpointCorruptError` chaining the last
+    failure once every candidate is exhausted. With ``quarantine_base`` set
+    (the trainer's resume path), a corrupt step is renamed aside so it stops
+    counting as committed."""
+    last_err: Optional[CheckpointCorruptError] = None
+    for s in steps:
+        try:
+            return restore_one(s)
+        except CheckpointCorruptError as e:
+            print(f"checkpoint step {s} corrupt, falling back: {str(e)[:200]}")
+            if metrics is not None:
+                metrics.log("ckpt_fallback", step=s, error=str(e)[:300])
+            if quarantine_base is not None and not isinstance(
+                e, CheckpointVerificationIOError
+            ):
+                # only PROVEN corruption is renamed aside — a verification
+                # read error may just be a storage blip, and quarantining on
+                # it would hide every healthy checkpoint during an outage
+                _quarantine_step(quarantine_base, s)
+            last_err = e
+    raise CheckpointCorruptError(exhausted_msg) from last_err
+
+
+def _quarantine_step(base: str, s: int) -> None:
+    """Rename a corrupt committed step aside (``step_N`` → ``step_N.corrupt``,
+    kept on disk for forensics) so name-based selection never sees it again.
+    Without this, ``--keep_last_n`` retention after a fallback resume would
+    prune the healthy OLDER steps the fallback just used while keeping the
+    corrupt newest one, and a retrained run reaching the same step number
+    would dedup its exit save against the corrupt dir and never persist.
+    Multihost processes race the rename; the losers ignore the OSError."""
+    src = step_path(base, s)
+    dst = src + ".corrupt"
+    # rename FIRST, clean a stale dst only on failure: pre-cleaning would
+    # let a process that lost the multihost race rmtree the quarantine its
+    # peer just created (src gone ⇒ dst IS the fresh forensic copy)
+    for _ in range(2):
+        try:
+            os.rename(src, dst)
+            print(f"quarantined corrupt checkpoint {src} → {dst}")
+            return
+        except OSError:
+            if not os.path.isdir(src):
+                return  # lost the race: a peer already quarantined it
+            if os.path.isdir(dst):
+                # stale quarantine of an earlier incarnation of this step:
+                # clear it and retry once
+                shutil.rmtree(dst, ignore_errors=True)
+            else:
+                return  # rename failed for another reason: best-effort, stop
+
+
+def _restore_checkpoint_portable_at(ckpt_dir: str, runtime, step: int) -> Any:
+    """Single-step portable restore: flat leaves restore under the per-layer
     GSPMD specs of the runtime's strategies (sharded over tp/dp, replicated
     over pp — a transient pp-fold duplication of each device's stage share),
     then a jitted restack lands them on the engine's stage stacks."""
@@ -197,7 +814,9 @@ def restore_checkpoint_portable(ckpt_dir: str, runtime, step: Optional[int] = No
         )
     try:
         flat = restore_checkpoint(ckpt_dir, flat_abstract, step)
-    except FileNotFoundError:
+    except (FileNotFoundError, CheckpointCorruptError):
+        # corruption is never a layout signal — surface it (the
+        # no-explicit-step caller turns it into fallback to an older step)
         raise
     except Exception as flat_err:
         if layout == "flat":
@@ -279,4 +898,71 @@ def abstract_state_of(runtime, init_key=None) -> Any:
         lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
         shapes,
         runtime.state_shardings,
+    )
+
+
+def _restore_raw_at(base: str, s: int) -> Any:
+    """Single-step raw restore: file-verify → restore → content-verify, any
+    failure raised as :class:`CheckpointCorruptError` (the fallback loop's
+    skip signal)."""
+    ocp = _ocp()
+    path = step_path(base, s)
+    manifest = read_manifest(path)
+    _verify_step_files(path, s, base, manifest)
+    try:
+        raw = _retry_unless_collective(
+            lambda: ocp.StandardCheckpointer().restore(path),
+            describe=f"raw checkpoint restore step {s}",
+        )
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"step {s} under {base} failed to restore: {str(e)[:300]}"
+        ) from e
+    if manifest is not None:
+        errs = verify_manifest(manifest, raw)
+        # a raw restore may spell container keypaths differently than the
+        # saved jax tree (list vs dict-of-indices); content equality as a
+        # multiset of (shape, dtype, digest) is the keypath-free check
+        if errs and not _content_only_match(manifest, raw):
+            raise CheckpointCorruptError(
+                f"step {s} under {base} failed content verification: "
+                + "; ".join(errs[:5])
+            )
+    return raw
+
+
+def restore_raw_checkpoint(ckpt_dir: str, step: Optional[int] = None) -> tuple:
+    """Raw (no target tree) restore with manifest verification and the same
+    newest-to-oldest fallback as the portable path (shared
+    :func:`_try_newest_first` loop) — serves the model-only consumers
+    (cli generate/serve/export-hf, which need ``params`` without a
+    runtime). Returns ``(tree, step)``."""
+    base = os.path.abspath(ckpt_dir)
+    if step is not None:
+        if not os.path.isdir(step_path(base, step)):
+            # absence is not corruption: a typo'd step must not send the
+            # operator hunting for storage faults
+            raise FileNotFoundError(f"no step_{step} under {base}")
+        return _restore_raw_at(base, step), step
+    gc_stale_tmp(base)  # also recovers a .old from an interrupted swap
+    steps = list(reversed(committed_steps(base)))
+    if not steps:
+        # inference-only consumers have no silent-restart risk, so
+        # pre-manifest legacy dirs stay loadable (loudly, unverified) —
+        # unlike the trainer, which refuses to resume from them
+        legacy = list(reversed(uncommitted_steps(base)))
+        if legacy:
+            print(
+                f"WARNING: no committed checkpoints under {base}; trying "
+                f"pre-manifest legacy steps {legacy} WITHOUT content "
+                "verification (re-save to commit them)"
+            )
+            steps = legacy
+        else:
+            raise FileNotFoundError(_no_checkpoints_message(base))
+    return _try_newest_first(
+        steps,
+        lambda s: (_restore_raw_at(base, s), s),
+        f"all {len(steps)} candidate checkpoints under {base} failed "
+        "verification",
     )

@@ -76,16 +76,27 @@ class IndexedTokenDataset:
     """Memory-mapped view of a ``write_indexed_dataset`` corpus."""
 
     def __init__(self, prefix: str):
+        from galvatron_tpu.core.retry import with_retries
+
         idx_path = prefix + ".idx.json"
         if not os.path.exists(idx_path):
             raise FileNotFoundError(
                 f"{idx_path} not found — build the corpus with "
                 "write_indexed_dataset / tokenize_text_file first"
             )
-        with open(idx_path) as f:
-            self.meta = json.load(f)
+
+        def read_meta():
+            with open(idx_path) as f:
+                return json.load(f)
+
+        # corpus lives on network storage on pods: transient read errors are
+        # retried with backoff instead of killing the run (core/retry.py)
+        self.meta = with_retries(read_meta, describe=f"read {idx_path}")
         self.dtype = np.dtype(self.meta["dtype"])
-        self.tokens = np.memmap(prefix + ".bin", dtype=self.dtype, mode="r")
+        self.tokens = with_retries(
+            lambda: np.memmap(prefix + ".bin", dtype=self.dtype, mode="r"),
+            describe=f"map {prefix}.bin",
+        )
         if self.tokens.size != self.meta["num_tokens"]:
             raise ValueError(
                 f"{prefix}.bin has {self.tokens.size} tokens but the index "

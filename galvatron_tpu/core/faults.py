@@ -1,0 +1,131 @@
+"""Fault-injection harness for the resilience layer.
+
+Production TPU training is dominated by preemptions and transient storage
+faults (Varuna/Bamboo treat recovery as a first-class subsystem); this module
+makes those failure modes *reproducible* so tests can prove the recovery
+paths end-to-end instead of trusting them. All hooks are no-ops unless armed,
+either programmatically (``configure(...)`` — what the tests use) or via the
+``GALVATRON_FAULTS`` environment variable (what a chaos job on a real pod
+uses), e.g.::
+
+    GALVATRON_FAULTS="kill_mid_save=1,fail_io=3,nan_at_step=5,nan_count=2"
+
+Supported faults:
+
+- ``kill_mid_save=N``    — the next N checkpoint saves crash after the data
+                           write but before the manifest/commit rename, so
+                           the staging dir is left uncommitted (the
+                           preemption-mid-save scenario).
+- ``corrupt_leaf=N``     — after the next N saves commit, flip bytes in the
+                           middle of the largest array file of the committed
+                           step (the transient-storage-corruption scenario).
+- ``fail_io=N``          — the next N retry-protected I/O operations raise
+                           ``OSError`` (consumed per *attempt*, so a retry
+                           loop with enough budget rides through).
+- ``nan_at_step=K`` (+ ``nan_count=N``, default 1) — the observed loss at
+  training steps K..K+N-1 is forced to NaN (the silent-divergence scenario).
+
+The hooks are called from the real code paths (checkpoint save/commit, the
+retry wrapper, the trainer's loss observation), so an injected fault
+exercises exactly the machinery a real one would.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+ENV_VAR = "GALVATRON_FAULTS"
+
+_active: Dict[str, int] = {}
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed crash hook (simulated preemption/kill)."""
+
+
+def configure(**faults: int) -> None:
+    """Arm faults programmatically (merges into the active set)."""
+    for k, v in faults.items():
+        _active[k] = int(v)
+
+
+def reset() -> None:
+    _active.clear()
+
+
+def active() -> Dict[str, int]:
+    return dict(_active)
+
+
+def init_from_env(env: Optional[str] = None) -> None:
+    """Parse ``GALVATRON_FAULTS`` (comma-separated key=int pairs)."""
+    spec = env if env is not None else os.environ.get(ENV_VAR, "")
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        try:
+            _active[key.strip()] = int(val) if val else 1
+        except ValueError:
+            raise ValueError(
+                f"{ENV_VAR}: expected key=int pairs, got {part!r}"
+            ) from None
+
+
+def _consume(key: str) -> bool:
+    n = _active.get(key, 0)
+    if n > 0:
+        _active[key] = n - 1
+        return True
+    return False
+
+
+def crash(point: str) -> None:
+    """Simulated kill at a named crash point (e.g. ``mid_save``)."""
+    if _consume(f"kill_{point}"):
+        raise FaultInjected(f"injected crash at {point}")
+
+
+def maybe_fail_io(site: str = "") -> None:
+    """Injected transient I/O failure (consumed by retry loops)."""
+    if _consume("fail_io"):
+        raise OSError(f"injected transient I/O failure ({site or 'io'})")
+
+
+def force_nan(step: int) -> bool:
+    """True when the observed loss at ``step`` should be forced to NaN."""
+    k = _active.get("nan_at_step")
+    if k is None:
+        return False
+    return k <= step < k + _active.get("nan_count", 1)
+
+
+def after_commit(step_dir: str) -> None:
+    """Post-commit hook: corrupt the just-committed checkpoint if armed."""
+    if _consume("corrupt_leaf"):
+        corrupt_checkpoint_leaf(step_dir)
+
+
+def corrupt_checkpoint_leaf(step_dir: str) -> str:
+    """Flip bytes in the middle of the largest array file under a committed
+    step directory (manifest excluded) — storage corruption that name-based
+    selection cannot see and only content verification catches."""
+    largest, size = None, -1
+    for root, _, files in os.walk(step_dir):
+        for fn in files:
+            if fn == "manifest.json":
+                continue
+            full = os.path.join(root, fn)
+            s = os.path.getsize(full)
+            if s > size:
+                largest, size = full, s
+    if largest is None or size <= 0:
+        raise FileNotFoundError(f"no array files to corrupt under {step_dir}")
+    with open(largest, "r+b") as f:
+        f.seek(size // 2)
+        chunk = f.read(min(64, size - size // 2))
+        f.seek(size // 2)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    return largest

@@ -1,0 +1,71 @@
+"""Training anomaly sentinel — NaN/Inf loss policy.
+
+The reference has no failure detection at all (SURVEY §5); here a single
+non-finite loss cannot silently poison the optimizer state. The sentinel
+watches the realized loss each iteration and applies a bounded skip policy:
+
+- a non-finite loss **discards the update** — the trainer rolls the state
+  back to the pre-step snapshot, drops the batch, and keeps going
+  (``anomaly_skip`` metrics event);
+- more than ``max_skips`` *consecutive* non-finite losses means the run is
+  genuinely diverging (not one poisoned batch), so the sentinel escalates to
+  :class:`AnomalyAbort` and the trainer lands a committed **emergency
+  checkpoint** of the last-good state before dying (``emergency_save``).
+
+Cost, stated plainly: when armed (``--anomaly_max_skips > 0``) the trainer
+holds one extra copy of the train state (the rollback snapshot — the train
+step donates its input buffers, so post-hoc recovery is impossible without
+it) and realizes the loss on the host every iteration (a per-iter device
+sync). Off by default; flip it on for any run long enough to care about.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class AnomalyAbort(RuntimeError):
+    """Consecutive non-finite losses exceeded the skip budget."""
+
+    def __init__(self, step: int, consecutive: int, max_skips: int):
+        super().__init__(
+            f"aborting at step {step}: {consecutive} consecutive non-finite "
+            f"losses exceed --anomaly_max_skips {max_skips}"
+        )
+        self.step = step
+        self.consecutive = consecutive
+        self.max_skips = max_skips
+
+
+class AnomalySentinel:
+    """Skip-then-abort policy over the per-iteration loss."""
+
+    def __init__(self, max_skips: int = 0):
+        self.max_skips = int(max_skips)
+        self.consecutive = 0
+        self.total_skips = 0
+
+    @property
+    def armed(self) -> bool:
+        return self.max_skips > 0
+
+    def snapshot(self, state: Any) -> Optional[Any]:
+        """Pre-step rollback copy (None when disarmed — no memory cost)."""
+        if not self.armed:
+            return None
+        return jax.tree.map(jnp.copy, state)
+
+    def observe(self, loss: float, step: int) -> str:
+        """Classify the realized loss: ``"ok"`` | ``"skip"`` | ``"abort"``."""
+        if math.isfinite(loss):
+            self.consecutive = 0
+            return "ok"
+        self.consecutive += 1
+        self.total_skips += 1
+        if self.consecutive > self.max_skips:
+            return "abort"
+        return "skip"

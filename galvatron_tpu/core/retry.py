@@ -1,0 +1,83 @@
+"""Retry with exponential backoff for transient I/O.
+
+Shared by checkpoint save/restore, the indexed-corpus reads and HF weight
+loading: on TPU pods the checkpoint/corpus filesystem is network-attached
+(GCS fuse, NFS), where transient ``OSError``s are routine and a single
+failed read should not kill a multi-hour run. Deliberately I/O-scoped:
+only exceptions in ``policy.retryable`` (default ``OSError``) are retried;
+everything else — including corruption, structure mismatches, and the
+deterministic ``OSError`` subclasses in ``policy.non_retryable``
+(missing path, permission denied), which retrying cannot fix —
+propagates immediately.
+
+Every attempt first passes through :func:`faults.maybe_fail_io`, so any
+retry-protected site is automatically a fault-injection point for the
+``fail_io=N`` fault (tests/test_resilience.py proves the ride-through).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional, Tuple, Type
+
+from galvatron_tpu.core import faults
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    # defaults sized for the stated purpose — riding out routine
+    # network-filesystem stalls on multi-hour pod runs: 5 attempts with
+    # 0.2/0.4/0.8/1.6s backoff ≈ 3s of ride-through (a 3-attempt/0.15s
+    # window would lose the run to any sub-second GCS-fuse/NFS blip)
+    attempts: int = 5
+    base_delay_s: float = 0.2
+    max_delay_s: float = 10.0
+    backoff: float = 2.0
+    retryable: Tuple[Type[BaseException], ...] = (OSError,)
+    # deterministic OSError subclasses retrying can never fix: a typo'd path
+    # or a permission problem must surface as itself on the first attempt,
+    # not as a "failed after 3 attempts" transient-I/O exhaustion
+    non_retryable: Tuple[Type[BaseException], ...] = (
+        FileNotFoundError,
+        PermissionError,
+        IsADirectoryError,
+        NotADirectoryError,
+    )
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (0-based): base * backoff^n."""
+        return min(self.max_delay_s, self.base_delay_s * self.backoff ** attempt)
+
+
+def with_retries(
+    fn: Callable[[], Any],
+    policy: Optional[RetryPolicy] = None,
+    describe: str = "",
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Any:
+    """Run ``fn()`` with up to ``policy.attempts`` tries; exponential backoff
+    between tries; the final failure propagates with the attempt count noted
+    via exception note (non-retryable exceptions propagate immediately)."""
+    policy = policy or RetryPolicy()
+    last: Optional[BaseException] = None
+    for attempt in range(policy.attempts):
+        try:
+            faults.maybe_fail_io(describe)
+            return fn()
+        except policy.retryable as e:
+            if isinstance(e, policy.non_retryable):
+                raise
+            last = e
+            if attempt + 1 >= policy.attempts:
+                break
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(policy.delay(attempt))
+    assert last is not None
+    if hasattr(last, "add_note"):  # 3.11+
+        last.add_note(
+            f"({describe or 'operation'} failed after {policy.attempts} attempts)"
+        )
+    raise last

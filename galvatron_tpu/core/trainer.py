@@ -3,28 +3,40 @@
 The train_dist.py body of the reference (reference:
 models/llama_hf/train_dist.py:16-90): resolve model config → hybrid strategy
 → construct hybrid model → dataloader → Adam → iterate forward_backward with
-profiler hooks. Plus what the reference lacks: checkpoint save/resume.
+profiler hooks. Plus what the reference lacks: checkpoint save/resume, and
+the resilience layer around it — every exit mode (normal completion, SIGTERM,
+unhandled exception, anomaly abort) lands a committed, resumable checkpoint,
+and a non-finite loss is skipped/aborted by policy (core/resilience.py)
+instead of silently poisoning the optimizer state.
 """
 
 from __future__ import annotations
 
 import argparse
+import math
+
 import jax
 import numpy as np
 
+from galvatron_tpu.core import faults
 from galvatron_tpu.core.arguments import hybrid_config_from_args, model_config_from_args
 from galvatron_tpu.core.checkpoint import (
     latest_step,
+    read_manifest,
     restore_checkpoint_portable,
     save_checkpoint_portable,
+    step_path,
+    uncommitted_steps,
 )
 from galvatron_tpu.core.dataloader import build_dataloader
 from galvatron_tpu.core.optim import AdamConfig
+from galvatron_tpu.core.resilience import AnomalyAbort, AnomalySentinel
 from galvatron_tpu.parallel.hybrid import build_runtime
 from galvatron_tpu.profiling.runtime import RuntimeProfiler
 
 
 def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
+    faults.init_from_env()  # chaos hooks: no-ops unless GALVATRON_FAULTS is set
     if getattr(ns, "multihost", 0):
         # join the multi-host job (TPU pods: coordinator/process id are
         # auto-detected from the TPU metadata; DCN carries the collectives) —
@@ -106,12 +118,35 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
         global_batch_size=ns.global_train_batch_size, seq_len=seq,
     )
 
+    from galvatron_tpu.utils.metrics import MetricsLogger
+
+    # opened before restore so a corrupt-latest fallback (ckpt_fallback) is
+    # visible in the same JSONL stream as the training events
+    metrics = MetricsLogger(getattr(ns, "metrics_path", None))
     start_step = 0
+    batch_offset = 0
     if ns.load and latest_step(ns.load) is not None:
-        state = restore_checkpoint_portable(ns.load, rt)
+        state = restore_checkpoint_portable(ns.load, rt, metrics=metrics)
         start_step = int(np.asarray(state["step"]))
+        # stream position ≠ optimizer step once anomaly skips happened: a
+        # skipped batch was consumed but produced no update. The save path
+        # records batches-consumed in the manifest (dir name == actual step,
+        # so the restored step's manifest is addressable here).
+        batch_offset = start_step
+        m = read_manifest(step_path(ns.load, start_step))
+        if m and isinstance(m.get("meta"), dict):
+            batch_offset = int(m["meta"].get("batches_consumed", start_step))
         if verbose:
             print(f"resumed from {ns.load} at step {start_step}")
+    elif ns.load and uncommitted_steps(ns.load):
+        # pre-manifest legacy dirs must not silently restart from scratch
+        raise FileNotFoundError(
+            f"--load {ns.load}: steps {uncommitted_steps(ns.load)} exist but "
+            "none carry a manifest (pre-commit-protocol saves, or partial "
+            "writes). Refusing to silently start from step 0 — restore one "
+            "explicitly (checkpoint.restore_checkpoint_portable(..., step=N)) "
+            "and re-save to commit it, or point --load elsewhere."
+        )
     elif hf_params is not None:
         state = rt.init_state_from(hf_params)
         if verbose:
@@ -120,19 +155,23 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
         state = rt.init_state(jax.random.key(ns.seed))
 
     # start_batch fast-forwards by index arithmetic so resume sees the batches
-    # an uninterrupted run would (reference has no resume at all)
+    # an uninterrupted run would (reference has no resume at all); the offset
+    # is batches CONSUMED, not optimizer steps — they diverge after skips
     loader = build_dataloader(
-        cfg, ns.global_train_batch_size, seq, seed=ns.seed, start_batch=start_step,
+        cfg, ns.global_train_batch_size, seq, seed=ns.seed, start_batch=batch_offset,
         data_path=getattr(ns, "data_path", None),
     )
     from galvatron_tpu.core.signals import GracefulExitHandler
-    from galvatron_tpu.utils.metrics import MetricsLogger
 
     # per-iter host syncs (float(loss) every step) serialize dispatch with
     # device compute; only sync each iteration when the user asked for
-    # per-iter observables (loss curves, per-iter metrics). Otherwise let
+    # per-iter observables (loss curves, per-iter metrics) or armed the
+    # anomaly sentinel (which must classify the realized loss). Otherwise let
     # dispatch run free and time a window (TPU-idiomatic async training).
-    sync_each = bool(ns.check_loss or getattr(ns, "metrics_path", None))
+    sentinel = AnomalySentinel(getattr(ns, "anomaly_max_skips", 0))
+    sync_each = bool(
+        ns.check_loss or getattr(ns, "metrics_path", None) or sentinel.armed
+    )
     prof = RuntimeProfiler(warmup_iters=1, windowed=not sync_each)
     # jax.profiler trace of the training loop (op/kernel timeline viewable in
     # TensorBoard/Perfetto) — the tracing counterpart of the reference's
@@ -147,19 +186,34 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
     consumed = 0
     batches_at_size: dict = {}
     if rampup is not None:
-        for _ in range(start_step):
+        for _ in range(batch_offset):
             b = rampup(consumed)
             batches_at_size[b] = batches_at_size.get(b, 0) + 1
             consumed += b
     else:
-        consumed = start_step * ns.global_train_batch_size
+        consumed = batch_offset * ns.global_train_batch_size
     consumed_at_start = consumed
     cur_bs = ns.global_train_batch_size
-    metrics = MetricsLogger(getattr(ns, "metrics_path", None))
+    keep_n = getattr(ns, "keep_last_n", 0)
+    # due-based save schedule instead of a bare modulus: an anomaly-skipped
+    # iteration `continue`s past the save point, and a modulus would then
+    # silently double the checkpoint cadence exactly when the run is unstable
+    next_save_at = (
+        (batch_offset // ns.save_interval + 1) * ns.save_interval
+        if ns.save and ns.save_interval else None
+    )
+    # `it` counts BATCHES globally (train_iters bounds batches consumed, so
+    # a crash+resume run trains exactly the batches an uninterrupted run
+    # would); the optimizer step lags by every anomaly skip, pre-crash skips
+    # included — resuming at start_step instead would silently re-grant the
+    # skipped iterations and re-log train_iter steps the first run already
+    # emitted for different batches
+    prior_skips = batch_offset - start_step
     iters_run = 0
+    train_exc = None
     try:
         with GracefulExitHandler() as exit_handler:
-            for it in range(start_step, ns.train_iters):
+            for it in range(batch_offset, ns.train_iters):
                 if exit_handler.signaled is not None:
                     if verbose:
                         print(f"signal {exit_handler.signaled} received; stopping at iter {it}")
@@ -171,7 +225,7 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
                     trace_started = True
                 if rampup is not None:
                     bs = rampup(consumed)
-                    if bs != cur_bs or it == start_step:
+                    if bs != cur_bs or it == batch_offset:
                         cur_bs = bs
                         loader = build_dataloader(
                             cfg, bs, seq, seed=ns.seed + bs,
@@ -182,44 +236,171 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
                     consumed += bs
                 else:
                     consumed += cur_bs
-                iters_run += 1
                 batch = rt.shard_batch(next(loader))
+                # counted only once the batch is actually consumed: iters_run
+                # feeds the batches_consumed manifest record, and a crash in
+                # the fetch itself must not make resume skip a real batch
+                iters_run += 1
+                # rollback copy — the train step donates its input buffers,
+                # so a discarded update is unrecoverable without it (None
+                # when the sentinel is disarmed: no memory cost)
+                snap = sentinel.snapshot(state)
                 prof.begin_iter()
-                state, loss = rt.train_step(state, batch)
+                new_state, loss = rt.train_step(state, batch)
+                # rebind NOW: the old buffers were donated into train_step,
+                # so `state` must never name them again — an XLA error
+                # surfacing at float(loss) below would otherwise hand the
+                # emergency-save path deleted arrays
+                state = new_state
                 # always hand end_iter the loss: per-iter mode syncs each
                 # step (sync_each implies that's wanted); windowed mode syncs
                 # ONCE, to close the warmup — without it the window would
                 # open while warmup compute is still in flight and overstate
                 # avg iter time
                 prof.end_iter(loss)
+                loss_val = float(loss) if sync_each else None
+                # injection sits OUTSIDE the armed gate: chaos jobs force a
+                # NaN observation with or without the sentinel (a disarmed
+                # run must drive the stringified-JSONL divergence path too)
+                if loss_val is not None and faults.force_nan(it):
+                    loss_val = float("nan")
+                if sentinel.armed:
+                    verdict = sentinel.observe(loss_val, it)
+                    if verdict != "ok":
+                        # discard the poisoned update: drop the batch, roll
+                        # the state back to the pre-step snapshot
+                        state = snap
+                        if verdict == "abort":
+                            raise AnomalyAbort(
+                                it, sentinel.consecutive, sentinel.max_skips
+                            )
+                        # loss serialized as a string: bare NaN/Infinity is
+                        # not valid JSON and would break strict JSONL readers
+                        metrics.log(
+                            "anomaly_skip", step=it, loss=str(loss_val),
+                            consecutive=sentinel.consecutive,
+                        )
+                        if verbose:
+                            print(
+                                f"iter {it}: non-finite loss; update skipped "
+                                f"({sentinel.consecutive}/{sentinel.max_skips})"
+                            )
+                        continue
                 if sync_each:
-                    losses.append(float(loss))
+                    losses.append(loss_val)
                     if verbose:
-                        print(f"iter {it}: loss {float(loss):.4f}")
+                        print(f"iter {it}: loss {loss_val:.4f}")
                 if metrics.path:
                     metrics.log(
-                        "train_iter", step=it, loss=float(loss), batch_size=cur_bs,
+                        "train_iter", step=it,
+                        # a disarmed run can still diverge: bare NaN/Infinity
+                        # is not valid JSON (same reason anomaly_skip
+                        # stringifies), so non-finite losses log as strings
+                        loss=(
+                            loss_val
+                            if loss_val is None or math.isfinite(loss_val)
+                            else str(loss_val)
+                        ),
+                        batch_size=cur_bs,
                         iter_ms=(prof.iter_times_ms[-1] if prof.iter_times_ms else None),
                     )
-                if ns.save and ns.save_interval and (it + 1) % ns.save_interval == 0:
-                    save_checkpoint_portable(ns.save, state, it + 1, rt)
+                if next_save_at is not None and (it + 1) >= next_save_at:
+                    # dir name = the state's actual optimizer step: skipped
+                    # iterations (this run's AND pre-crash ones) advanced
+                    # `it` but not the state, and the exit-save dedup
+                    # compares latest_step against it
+                    actual_step = it + 1 - prior_skips - sentinel.total_skips
+                    save_checkpoint_portable(
+                        ns.save, state, actual_step, rt, keep_last_n=keep_n,
+                        meta={"batches_consumed": batch_offset + iters_run},
+                    )
+                    next_save_at = (
+                        (it + 1) // ns.save_interval + 1
+                    ) * ns.save_interval
                     if verbose:
-                        print(f"saved step {it + 1} → {ns.save}")
+                        print(f"saved step {actual_step} → {ns.save}")
         prof.finish(loss if iters_run else None)
+    except BaseException as e:
+        train_exc = e
+        raise
     finally:
         # always close the trace — an exception mid-loop must not lose the
-        # captured data or wedge the process-wide profiler state
+        # captured data or wedge the process-wide profiler state. Guarded:
+        # a stop_trace failure (e.g. flushing to broken storage) must not
+        # rob the crash path of its emergency checkpoint below, nor mask
+        # the original training exception
         if trace_started:
-            jax.profiler.stop_trace()
-            if verbose:
-                print(f"jax.profiler trace → {trace_dir}")
-    # checkpoint on exit — normal completion or signal (the reference's
-    # dist_signal_handler checkpoint-then-exit pattern, there unused)
-    if ns.save:
-        final_step = int(np.asarray(state["step"]))
-        if latest_step(ns.save) != final_step:
-            save_checkpoint_portable(ns.save, state, final_step, rt)
-    metrics.close()
+            try:
+                jax.profiler.stop_trace()
+                if verbose:
+                    print(f"jax.profiler trace → {trace_dir}")
+            except Exception as trace_err:
+                print(f"failed to close jax.profiler trace: {trace_err!r}")
+        # checkpoint on exit — normal completion, signal-stop (the
+        # reference's dist_signal_handler checkpoint-then-exit pattern,
+        # there unused), unhandled exception, or anomaly abort: every exit
+        # mode lands a committed, resumable checkpoint
+        try:
+            # the save itself is collective on a multi-controller pod
+            # (orbax write + commit barrier), so it is only safe when every
+            # process reaches this path with the same verdict: normal
+            # completion, signal-stop (preemption signals all hosts), and
+            # AnomalyAbort (decided on the globally-reduced loss) are
+            # replicated; an arbitrary exception may be host-local (one
+            # host's dataloader shard failing), and entering the collective
+            # save alone would hang inside this finally with the traceback
+            # never printed. There the exception surfaces instead.
+            replicated_exit = (
+                train_exc is None
+                or isinstance(train_exc, AnomalyAbort)
+                or jax.process_count() == 1
+            )
+            if ns.save and not replicated_exit:
+                print(
+                    "skipping exit checkpoint: exception on a multi-host run "
+                    "may be host-local and the save is collective"
+                )
+            if ns.save and replicated_exit:
+                final_step = int(np.asarray(state["step"]))
+                batches_now = batch_offset + iters_run
+                # dedup on step AND stream position: trailing anomaly-skipped
+                # batches advance batches_consumed without advancing the
+                # optimizer step, and skipping the re-save would leave the
+                # committed meta stale — resume would then replay the skipped
+                # batches (deterministically poisoned data could loop the
+                # skip budget on every restart instead of progressing)
+                already_committed = latest_step(ns.save) == final_step
+                if already_committed:
+                    m = read_manifest(step_path(ns.save, final_step))
+                    meta = m.get("meta") if m else None
+                    already_committed = isinstance(meta, dict) and int(
+                        meta.get("batches_consumed", -1)
+                    ) == batches_now
+                if not already_committed:
+                    save_checkpoint_portable(
+                        ns.save, state, final_step, rt, keep_last_n=keep_n,
+                        meta={"batches_consumed": batches_now},
+                    )
+                if train_exc is not None:
+                    # the event fires even when the write was skipped (e.g.
+                    # an anomaly abort whose last-good state an interval
+                    # save already committed) — the operator signal is the
+                    # exceptional exit, not the redundant write
+                    metrics.log(
+                        "emergency_save", step=final_step,
+                        already_committed=already_committed,
+                        reason=f"{type(train_exc).__name__}: "
+                               f"{str(train_exc)[:200]}",
+                    )
+                    print(f"emergency checkpoint step {final_step} → {ns.save}")
+                elif verbose and not already_committed:
+                    print(f"saved step {final_step} → {ns.save}")
+        except Exception as save_err:
+            # best-effort: a failed exit save must not mask the original error
+            print(f"exit checkpoint failed: {save_err!r}")
+        finally:
+            # crash runs flush their JSONL tail too
+            metrics.close()
     # throughput from actual samples processed (rampup runs at smaller sizes)
     avg_bs = (consumed - consumed_at_start) / iters_run if iters_run else 0
     # cost-model fidelity: predicted-vs-measured iteration time when training

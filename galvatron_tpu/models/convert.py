@@ -526,22 +526,34 @@ def _state_dict_from_dir(path: str) -> Dict[str, Any]:
     import json
     import os
 
+    from galvatron_tpu.core.retry import with_retries
+
     def load_file(fn):
+        # multi-GB shard reads off network storage: retry transient I/O
+        # instead of abandoning the whole import (core/retry.py)
         full = os.path.join(path, fn)
         if fn.endswith(".safetensors"):
             from safetensors.numpy import load_file as st_load
 
-            return st_load(full)
+            return with_retries(lambda: st_load(full), describe=f"read {fn}")
         import torch
 
-        return torch.load(full, map_location="cpu", weights_only=True)
+        return with_retries(
+            lambda: torch.load(full, map_location="cpu", weights_only=True),
+            describe=f"read {fn}",
+        )
+
+    def read_index(idx):
+        with open(idx) as f:
+            return sorted(set(json.load(f)["weight_map"].values()))
 
     sd: Dict[str, Any] = {}
     for index in ("model.safetensors.index.json", "pytorch_model.bin.index.json"):
         idx = os.path.join(path, index)
         if os.path.exists(idx):
-            with open(idx) as f:
-                shards = sorted(set(json.load(f)["weight_map"].values()))
+            shards = with_retries(
+                lambda i=idx: read_index(i), describe=f"read {index}"
+            )
             for fn in shards:
                 sd.update(load_file(fn))
             return sd

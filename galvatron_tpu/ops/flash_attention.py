@@ -46,10 +46,26 @@ def _use_interpret() -> bool:
 _VMEM_LIMIT_MB = int(os.environ.get("GALVATRON_FLASH_VMEM_MB", "64"))
 
 
-def _compiler_params(**kw) -> pltpu.CompilerParams:
+# jax < 0.6 spells the Mosaic params class TPUCompilerParams
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+
+def _compiler_params(**kw):
     if _VMEM_LIMIT_MB:
         kw.setdefault("vmem_limit_bytes", _VMEM_LIMIT_MB << 20)
-    return pltpu.CompilerParams(**kw)
+    return _CompilerParams(**kw)
+
+
+def _single_buffered(shape, index_map) -> pl.BlockSpec:
+    """BlockSpec pinned to single-buffering where pallas supports it
+    (pl.Buffered, jax >= 0.6); older pallas falls back to Mosaic's default
+    double-buffering — a VMEM-budget optimization only, numerics identical
+    (the raised vmem_limit_bytes still covers the measured shapes there)."""
+    if hasattr(pl, "Buffered"):
+        return pl.BlockSpec(
+            shape, index_map, pipeline_mode=pl.Buffered(buffer_count=1)
+        )
+    return pl.BlockSpec(shape, index_map)
 
 
 def _rope_rows(x, c, s):
@@ -617,22 +633,21 @@ def _flash_bwd_blocked(
     # across grid steps costs 2x VMEM on every operand, which blows the 16M
     # scoped limit at the 7B shape (measured 19.3M); per-invocation compute
     # (~4 GFLOP) dwarfs the unoverlapped slab fetch
-    single = pl.Buffered(buffer_count=1)
     if stacked:
         qkv_specs = [
-            pl.BlockSpec((1, 1, 1, s, d), lambda b_, h_: (b_, 0, h_, 0, 0), pipeline_mode=single),
-            pl.BlockSpec((1, 1, 1, s, d), lambda b_, h_: (b_, 1, h_, 0, 0), pipeline_mode=single),
-            pl.BlockSpec((1, 1, 1, s, d), lambda b_, h_: (b_, 2, h_, 0, 0), pipeline_mode=single),
+            _single_buffered((1, 1, 1, s, d), lambda b_, h_: (b_, 0, h_, 0, 0)),
+            _single_buffered((1, 1, 1, s, d), lambda b_, h_: (b_, 1, h_, 0, 0)),
+            _single_buffered((1, 1, 1, s, d), lambda b_, h_: (b_, 2, h_, 0, 0)),
         ]
         qkv_inputs = (qkv, qkv, qkv)
     else:
-        spec = pl.BlockSpec((1, 1, s, d), lambda b_, h_: (b_, h_, 0, 0), pipeline_mode=single)
+        spec = _single_buffered((1, 1, s, d), lambda b_, h_: (b_, h_, 0, 0))
         qkv_specs = [spec, spec, spec]
         qkv_inputs = (q, k, v)
-    bhsd = pl.BlockSpec((1, 1, s, d), lambda b_, h_: (b_, h_, 0, 0), pipeline_mode=single)
-    rows = pl.BlockSpec((s, d // 2), lambda b_, h_: (0, 0), pipeline_mode=single)
+    bhsd = _single_buffered((1, 1, s, d), lambda b_, h_: (b_, h_, 0, 0))
+    rows = _single_buffered((s, d // 2), lambda b_, h_: (0, 0))
     if do_stacked_out:
-        out_specs = [pl.BlockSpec((1, 3, 1, s, d), lambda b_, h_: (b_, 0, h_, 0, 0), pipeline_mode=single)]
+        out_specs = [_single_buffered((1, 3, 1, s, d), lambda b_, h_: (b_, 0, h_, 0, 0))]
         out_shape = [jax.ShapeDtypeStruct((b, 3, h, s, d), dtype)]
     else:
         out_specs = [bhsd, bhsd, bhsd]
@@ -648,7 +663,7 @@ def _flash_bwd_blocked(
             bhsd,  # out
             # (s, 1) pads to (s, 128) lanes under TPU tiling — 1M fp32, so
             # single-buffer it like the slabs
-            pl.BlockSpec((1, 1, s, 1), lambda b_, h_: (b_, h_, 0, 0), pipeline_mode=single),
+            _single_buffered((1, 1, s, 1), lambda b_, h_: (b_, h_, 0, 0)),
             rows, rows,
         ],
         out_specs=out_specs,

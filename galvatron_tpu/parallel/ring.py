@@ -31,6 +31,8 @@ import functools
 from typing import Sequence
 
 import jax
+
+from galvatron_tpu import compat
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
@@ -288,7 +290,7 @@ def ring_attention(
     # while plain data sharding over the cp axes works — same linearization
     # as ppermute over the axis tuple
     idx_arr = jnp.arange(cp, dtype=jnp.int32)
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         local,
         mesh=mesh,
         in_specs=(spec, spec, spec, P(axis)),

@@ -25,6 +25,8 @@ from __future__ import annotations
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
+
+from galvatron_tpu import compat
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from galvatron_tpu.core.strategy import LayerStrategy
@@ -109,7 +111,7 @@ def constrain(x, mesh: Mesh, spec: P):
     on the tracing context's AbstractMesh (whose manual axes are typed
     Manual); the concrete mesh's sharding would be rejected in the
     transpose/grad path."""
-    am = jax.sharding.get_abstract_mesh()
+    am = compat.get_abstract_mesh()
     target = am if (am is not None and not am.empty) else mesh
     return jax.lax.with_sharding_constraint(x, NamedSharding(target, spec))
 

@@ -3,20 +3,31 @@
 Counterpart of the reference's Flask server (reference:
 galvatron/site_package/megatron/text_generation_server.py — PUT /api with
 {"prompts": [...], "tokens_to_generate": N, ...}). Stdlib-only
-(http.server) so it carries no extra dependencies; single worker, requests
-are served sequentially in arrival order (generation holds the chip anyway).
+(http.server) so it carries no extra dependencies; generation requests are
+serialized by the service lock (generation holds the chip anyway).
 
 API (POST or PUT /api, JSON body):
   {"prompts": ["..."], "tokens_to_generate": 32, "temperature": 0.0,
    "top_k": 0, "top_p": 0.0}
 → {"text": ["...completions..."], "tokens": [[...ids...]]}
+GET /healthz → {"status": "ok", "uptime_s": ..., "requests_served": ...,
+                "model": {vocab/hidden/layers/heads/max_seq_len}}
+
+Connections are handled on threads — generation itself stays serialized by
+the service lock, but /healthz answers while a generation is in flight —
+and each carries a socket timeout (``request_timeout_s``) so a stalled
+client (connected but never sending, or trickling a body) releases its
+thread instead of accumulating forever. Pending /api work is bounded by
+``max_pending`` (excess requests fail fast with 503 instead of queueing
+threads on the generation lock for clients that may be long gone).
 """
 
 from __future__ import annotations
 
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, HTTPServer
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
 import jax
@@ -30,6 +41,23 @@ class GenerationService:
         self.max_new_default = max_new_default
         self.key = jax.random.key(seed)
         self.lock = threading.Lock()
+        self.started_at = time.time()
+        self.requests_served = 0
+
+    def health(self) -> dict:
+        c = self.cfg
+        return {
+            "status": "ok",
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "requests_served": self.requests_served,
+            "model": {
+                "vocab_size": c.vocab_size,
+                "hidden_size": c.hidden_size,
+                "num_layers": c.num_layers,
+                "num_heads": c.num_heads,
+                "max_seq_len": c.max_seq_len,
+            },
+        }
 
     def generate(self, body: dict) -> dict:
         from galvatron_tpu.models import generation
@@ -59,12 +87,23 @@ class GenerationService:
                 pad_id=self.tok.pad_id if self.tok.pad_id is not None else 0,
                 key=sub,
             )
+            # counted inside the generation lock: re-acquiring it afterwards
+            # would park this finished request behind the next generation
+            self.requests_served += 1
         texts = [self.tok.decode(o[len(tp):]) for o, tp in zip(outs, tok_prompts)]
         return {"text": texts, "tokens": outs}
 
 
-def _make_handler(service: GenerationService):
+def _make_handler(
+    service: GenerationService, request_timeout_s: float,
+    gate: threading.BoundedSemaphore,
+):
     class Handler(BaseHTTPRequestHandler):
+        # socketserver per-connection timeout: applied to the socket in
+        # setup(), so a stalled read (request line or body) raises instead
+        # of pinning its handler thread forever
+        timeout = request_timeout_s
+
         def _reply(self, code: int, payload: dict):
             data = json.dumps(payload).encode()
             self.send_response(code)
@@ -76,17 +115,39 @@ def _make_handler(service: GenerationService):
         def _handle(self):
             if self.path.rstrip("/") != "/api":
                 return self._reply(404, {"error": "use /api"})
+            # bounded pending work: the threading server gives every
+            # connection a thread, and a thread parked on the generation
+            # lock is NOT covered by the socket timeout — without the gate,
+            # a slow generation plus a request flood accumulates unbounded
+            # threads and then burns chip time generating for clients long
+            # gone. Saturated → fail fast with 503 (/healthz stays open).
+            if not gate.acquire(blocking=False):
+                return self._reply(
+                    503, {"error": "server busy: too many pending requests"}
+                )
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 return self._reply(200, service.generate(body))
+            except TimeoutError:
+                # stalled client mid-body: drop the connection without
+                # attempting to write a reply into the dead socket
+                self.close_connection = True
+                return
             except ValueError as e:
                 return self._reply(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — surface to client
                 return self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            finally:
+                gate.release()
 
         do_POST = _handle
         do_PUT = _handle
+
+        def do_GET(self):
+            if self.path.rstrip("/") == "/healthz":
+                return self._reply(200, service.health())
+            return self._reply(404, {"error": "use /api (POST/PUT) or /healthz (GET)"})
 
         def log_message(self, *a):  # quiet
             pass
@@ -95,8 +156,16 @@ def _make_handler(service: GenerationService):
 
 
 def run_server(service: GenerationService, port: int = 5000, host: str = "127.0.0.1",
-               ready_event: Optional[threading.Event] = None) -> None:
-    httpd = HTTPServer((host, port), _make_handler(service))
+               ready_event: Optional[threading.Event] = None,
+               request_timeout_s: float = 120.0, max_pending: int = 8) -> None:
+    # threading server: generation is serialized by service.lock anyway, but
+    # /healthz must answer while a long generation is in flight — a probe
+    # timing out against a busy single-threaded server would get a healthy
+    # process restarted. max_pending bounds queued /api work (excess → 503).
+    gate = threading.BoundedSemaphore(max_pending)
+    httpd = ThreadingHTTPServer(
+        (host, port), _make_handler(service, request_timeout_s, gate)
+    )
     service.httpd = httpd
     if ready_event is not None:
         ready_event.set()

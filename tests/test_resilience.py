@@ -1,0 +1,455 @@
+"""Fault-injection proof of the resilience layer (core/faults.py hooks into
+core/checkpoint.py, core/retry.py and the trainer's anomaly sentinel).
+
+Every recovery path is exercised end-to-end instead of trusted:
+crash mid-save → the partial step is never selected for resume;
+corrupt latest → restore falls back to the previous committed step
+(``ckpt_fallback``); NaN burst → bounded skips without mutating state, then
+an emergency checkpoint (``anomaly_skip``/``emergency_save``); transient I/O
+faults ride through the retry loop. All events land in the JSONL metrics log.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from galvatron_tpu.core import faults
+from galvatron_tpu.core.arguments import initialize_galvatron
+from galvatron_tpu.core.checkpoint import (
+    CheckpointCorruptError,
+    committed_steps,
+    latest_step,
+    parse_step_name,
+    read_manifest,
+    restore_raw_checkpoint,
+    save_checkpoint,
+    step_path,
+)
+from galvatron_tpu.core.resilience import AnomalyAbort, AnomalySentinel
+from galvatron_tpu.core.retry import RetryPolicy, with_retries
+from galvatron_tpu.utils.metrics import read_metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+TINY = [
+    "--model_size", "llama-0.3b", "--num_layers", "2", "--hidden_size", "32",
+    "--num_heads", "2", "--ffn_dim", "64", "--vocab_size", "128",
+    "--seq_length", "16", "--global_train_batch_size", "8",
+    "--mixed_precision", "fp32",
+]
+
+
+def tiny_ns(*extra):
+    return initialize_galvatron("train", TINY + list(extra))
+
+
+def small_state(v: float, step: int):
+    return {
+        "params": {"w": jnp.full((8,), v, jnp.float32)},
+        "step": jnp.asarray(step, jnp.int32),
+    }
+
+
+# ---------------------------------------------------------------------------
+# strict step-name parsing (standalone guard under the manifest check)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_step_name_strict():
+    assert parse_step_name("step_5") == 5
+    assert parse_step_name("step_12345") == 12345
+    # staging/partial artifacts and arbitrary step_* names never parse
+    for bad in ("step_5.tmp", "step_5.old.tmp", "step_", "step_5x",
+                "step_x5", "step5", "xstep_5", "step_5 ", "step_-1"):
+        assert parse_step_name(bad) is None, bad
+
+
+def test_latest_step_ignores_junk_and_gcs_tmp(tmp_path):
+    d = str(tmp_path)
+    # committed = strict name AND a parseable manifest
+    os.makedirs(os.path.join(d, "step_3"))
+    with open(os.path.join(d, "step_3", "manifest.json"), "w") as f:
+        json.dump({"version": 1, "step": 3, "leaves": {}}, f)
+    os.makedirs(os.path.join(d, "step_9"))  # no manifest: uncommitted
+    os.makedirs(os.path.join(d, "step_7.tmp"))  # stale staging dir
+    os.makedirs(os.path.join(d, "step_junk"))
+    with open(os.path.join(d, "step_junk", "manifest.json"), "w") as f:
+        json.dump({"version": 1, "step": 0, "leaves": {}}, f)
+    assert latest_step(d) == 3
+    assert not os.path.exists(os.path.join(d, "step_7.tmp"))  # GC'd
+    assert os.path.isdir(os.path.join(d, "step_9"))  # kept (may be external)
+
+
+# ---------------------------------------------------------------------------
+# retry + fail_io fault
+# ---------------------------------------------------------------------------
+
+
+def test_retry_rides_through_injected_io_faults():
+    faults.configure(fail_io=2)
+    calls = []
+    out = with_retries(
+        lambda: calls.append(1) or 42,
+        policy=RetryPolicy(attempts=3, base_delay_s=0.0),
+        sleep=lambda s: None,
+    )
+    assert out == 42 and len(calls) == 1  # two attempts consumed by injection
+
+
+def test_retry_exhausts_on_persistent_io_failure():
+    faults.configure(fail_io=5)
+    with pytest.raises(OSError):
+        with_retries(
+            lambda: 42,
+            policy=RetryPolicy(attempts=3, base_delay_s=0.0),
+            sleep=lambda s: None,
+        )
+    assert faults.active()["fail_io"] == 2  # exactly 3 attempts consumed
+
+
+def test_retry_does_not_retry_non_io_errors():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("corrupt, retrying cannot fix this")
+
+    with pytest.raises(ValueError):
+        with_retries(boom, policy=RetryPolicy(attempts=3, base_delay_s=0.0),
+                     sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_fault_env_parsing():
+    faults.init_from_env("kill_mid_save=1, fail_io=3,nan_at_step=5,nan_count")
+    assert faults.active() == {
+        "kill_mid_save": 1, "fail_io": 3, "nan_at_step": 5, "nan_count": 1,
+    }
+    with pytest.raises(ValueError):
+        faults.init_from_env("fail_io=lots")
+
+
+# ---------------------------------------------------------------------------
+# commit protocol: crash mid-save is never selected
+# ---------------------------------------------------------------------------
+
+
+def test_kill_mid_save_never_selected_for_resume(tmp_path):
+    d = str(tmp_path / "ck")
+    save_checkpoint(d, small_state(1.0, 1), 1)
+    assert committed_steps(d) == [1]
+
+    faults.configure(kill_mid_save=1)
+    with pytest.raises(faults.FaultInjected):
+        save_checkpoint(d, small_state(2.0, 2), 2)
+    # the crashed save left only an uncommitted staging dir: never selected,
+    # GC'd on the next resume scan
+    assert latest_step(d) == 1
+    assert not any(n.endswith(".tmp") for n in os.listdir(d))
+
+    raw, step = restore_raw_checkpoint(d)
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(raw["params"]["w"]),
+                                  np.full((8,), 1.0, np.float32))
+
+    # the retried save (fault cleared) commits normally over the same step
+    save_checkpoint(d, small_state(2.0, 2), 2)
+    assert committed_steps(d) == [1, 2]
+
+
+def test_corrupt_latest_falls_back_to_previous_committed(tmp_path):
+    d = str(tmp_path / "ck")
+    save_checkpoint(d, small_state(1.0, 1), 1)
+    save_checkpoint(d, small_state(2.0, 2), 2)
+    faults.corrupt_checkpoint_leaf(step_path(d, 2))
+
+    # explicit step: corruption surfaces loudly
+    with pytest.raises(CheckpointCorruptError):
+        restore_raw_checkpoint(d, step=2)
+    # no explicit step: newest → oldest fallback
+    raw, step = restore_raw_checkpoint(d)
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(raw["params"]["w"]),
+                                  np.full((8,), 1.0, np.float32))
+
+
+def test_corrupt_leaf_fault_via_after_commit(tmp_path):
+    """The armed corrupt_leaf hook flips bytes in the committed step right
+    after the rename — the name-based selector cannot see it, the file
+    digests catch it before any decode, and restore falls back."""
+    d = str(tmp_path / "ck")
+    save_checkpoint(d, small_state(1.0, 1), 1)
+    faults.configure(corrupt_leaf=1)
+    save_checkpoint(d, small_state(2.0, 2), 2)
+    assert committed_steps(d) == [1, 2]  # corruption is invisible to names
+    raw, step = restore_raw_checkpoint(d)
+    assert step == 1
+
+
+def test_keep_last_n_retention(tmp_path):
+    d = str(tmp_path / "ck")
+    for s in (1, 2, 3, 4):
+        save_checkpoint(d, small_state(float(s), s), s, keep_last_n=2)
+    assert committed_steps(d) == [3, 4]
+
+
+def test_interrupted_resave_swap_recovers_old_committed(tmp_path):
+    """A kill between the re-save swap's two renames leaves step_N.old (the
+    old committed data) + step_N.tmp (the unpublished new data); the next
+    scan must restore the old copy, not GC both."""
+    d = str(tmp_path / "ck")
+    save_checkpoint(d, small_state(1.0, 1), 1)
+    # simulate the mid-swap kill state by hand
+    os.rename(step_path(d, 1), step_path(d, 1) + ".old")
+    os.makedirs(step_path(d, 1) + ".tmp")
+    assert latest_step(d) == 1  # recovered from .old, .tmp GC'd
+    raw, step = restore_raw_checkpoint(d)
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(raw["params"]["w"]),
+                                  np.full((8,), 1.0, np.float32))
+    # completed swap: the stale .old is removed, the published copy wins
+    save_checkpoint(d, small_state(2.0, 1), 1)
+    os.makedirs(step_path(d, 1) + ".old")
+    assert latest_step(d) == 1
+    assert not os.path.exists(step_path(d, 1) + ".old")
+
+
+def test_raw_restore_falls_back_to_legacy_pre_manifest_dirs(tmp_path, capsys):
+    """Inference consumers (cli generate/serve/export-hf) keep loading
+    checkpoints written before the commit protocol — loudly, unverified —
+    since they carry no silent-restart risk."""
+    d = str(tmp_path / "ck")
+    save_checkpoint(d, small_state(1.0, 3), 3)
+    os.remove(os.path.join(step_path(d, 3), "manifest.json"))  # legacy now
+    raw, step = restore_raw_checkpoint(d)
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(raw["params"]["w"]),
+                                  np.full((8,), 1.0, np.float32))
+    assert "WITHOUT content verification" in capsys.readouterr().out
+
+
+def test_train_refuses_silent_restart_on_legacy_dirs(tmp_path):
+    """A --load dir holding only pre-manifest step dirs must error loudly,
+    not reinitialize from step 0 and quietly lose the run's progress."""
+    from galvatron_tpu.core.trainer import train
+
+    d = str(tmp_path / "ck")
+    os.makedirs(os.path.join(d, "step_7"))  # legacy: no manifest
+    with pytest.raises(FileNotFoundError, match=r"\[7\]"):
+        train(tiny_ns("--train_iters", "1", "--load", d), verbose=False)
+
+
+def test_save_schedule_catches_up_after_anomaly_skip(tmp_path):
+    """An anomaly-skipped iteration that lands on a save boundary must not
+    silently double the checkpoint cadence — the save fires on the next
+    finite iteration instead."""
+    from galvatron_tpu.core.trainer import train
+
+    d = str(tmp_path / "ck")
+    faults.configure(nan_at_step=1)  # it=1 skips; (it+1)=2 was the boundary
+    ns = tiny_ns("--train_iters", "5", "--save", d, "--save_interval", "2",
+                 "--anomaly_max_skips", "3")
+    train(ns, verbose=False)
+    # modulus-only scheduling would miss the it=1 boundary entirely;
+    # due-based catches up on the next finite iteration. Dir names track the
+    # state's actual optimizer step (one behind `it` after the skip): saves
+    # land at steps 2 (catch-up) and 3, then the exit save at 4.
+    assert committed_steps(d) == [2, 3, 4]
+
+
+# ---------------------------------------------------------------------------
+# anomaly sentinel (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_skip_then_abort_policy():
+    s = AnomalySentinel(max_skips=2)
+    assert s.armed
+    assert s.observe(1.0, 0) == "ok"
+    assert s.observe(float("nan"), 1) == "skip"
+    assert s.observe(float("inf"), 2) == "skip"
+    assert s.observe(float("nan"), 3) == "abort"
+    # a finite loss resets the consecutive counter
+    s2 = AnomalySentinel(max_skips=1)
+    assert s2.observe(float("nan"), 0) == "skip"
+    assert s2.observe(1.0, 1) == "ok"
+    assert s2.observe(float("nan"), 2) == "skip"
+    assert s2.total_skips == 2
+    # disarmed sentinel takes no snapshot (no memory cost)
+    assert AnomalySentinel(0).snapshot({"w": jnp.ones(2)}) is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the trainer
+# ---------------------------------------------------------------------------
+
+
+def test_train_crash_mid_save_lands_emergency_checkpoint(tmp_path):
+    from galvatron_tpu.core.trainer import train
+
+    d = str(tmp_path / "ck")
+    m = str(tmp_path / "m.jsonl")
+    faults.configure(kill_mid_save=1)
+    ns = tiny_ns("--train_iters", "2", "--save", d, "--save_interval", "1",
+                 "--metrics_path", m)
+    with pytest.raises(faults.FaultInjected):
+        train(ns, verbose=False)
+    # the interval save of step 1 crashed mid-write; the exit path landed a
+    # committed emergency checkpoint instead, and nothing partial is visible
+    assert committed_steps(d) == [1]
+    events = [r["event"] for r in read_metrics(m)]
+    assert "emergency_save" in events
+
+    # resume from the emergency checkpoint completes the run
+    ns2 = tiny_ns("--train_iters", "2", "--save", d, "--load", d)
+    out = train(ns2, verbose=False)
+    assert int(np.asarray(out["state"]["step"])) == 2
+    assert committed_steps(d) == [1, 2]
+
+
+def test_train_corrupt_latest_resumes_from_fallback(tmp_path):
+    from galvatron_tpu.core.trainer import train
+
+    d = str(tmp_path / "ck")
+    m = str(tmp_path / "m.jsonl")
+    ns = tiny_ns("--train_iters", "2", "--save", d, "--save_interval", "1")
+    train(ns, verbose=False)
+    assert committed_steps(d) == [1, 2]
+    faults.corrupt_checkpoint_leaf(step_path(d, 2))
+
+    ns2 = tiny_ns("--train_iters", "3", "--save", d, "--load", d,
+                  "--metrics_path", m)
+    out = train(ns2, verbose=False)
+    # resumed from step 1 (the corrupt step 2 was skipped) and trained to 3
+    assert int(np.asarray(out["state"]["step"])) == 3
+    recs = read_metrics(m)
+    fb = [r for r in recs if r["event"] == "ckpt_fallback"]
+    assert len(fb) == 1 and fb[0]["step"] == 2
+    assert [r["step"] for r in recs if r["event"] == "train_iter"] == [1, 2]
+    # the corrupt step was QUARANTINED (renamed aside, kept for forensics):
+    # without this, --keep_last_n retention would prune the healthy steps the
+    # fallback just used while keeping the corrupt newest, and an exit save
+    # reaching step 2 again would dedup against the corrupt dir
+    assert committed_steps(d) == [1, 3]
+    assert os.path.isdir(step_path(d, 2) + ".corrupt")
+
+
+def test_train_nan_burst_skips_then_emergency_save(tmp_path):
+    from galvatron_tpu.core.trainer import train
+
+    d = str(tmp_path / "ck")
+    clean = str(tmp_path / "clean")
+    m = str(tmp_path / "m.jsonl")
+
+    # reference: an uninterrupted 2-iter run (same seed/flags), committed at 2
+    train(tiny_ns("--train_iters", "2", "--save", clean), verbose=False)
+    assert committed_steps(clean) == [2]
+
+    # NaN losses injected from iteration 2 onward; budget of 2 skips
+    faults.configure(nan_at_step=2, nan_count=5)
+    ns = tiny_ns("--train_iters", "10", "--save", d, "--metrics_path", m,
+                 "--anomaly_max_skips", "2")
+    with pytest.raises(AnomalyAbort) as ei:
+        train(ns, verbose=False)
+    assert ei.value.step == 4 and ei.value.consecutive == 3
+
+    recs = read_metrics(m)
+    skips = [r for r in recs if r["event"] == "anomaly_skip"]
+    assert [s["step"] for s in skips] == [2, 3]
+    assert [s["consecutive"] for s in skips] == [1, 2]
+    em = [r for r in recs if r["event"] == "emergency_save"]
+    assert len(em) == 1 and em[0]["step"] == 2
+    assert "AnomalyAbort" in em[0]["reason"]
+
+    # the emergency checkpoint holds the LAST-GOOD state: skipped updates
+    # never mutated it, so its content digests match the clean 2-iter run
+    assert committed_steps(d) == [2]
+    got = read_manifest(step_path(d, 2))["leaves"]
+    want = read_manifest(step_path(clean, 2))["leaves"]
+    assert got == want
+
+    # and it resumes IN THE BATCH DOMAIN: the aborted run consumed 5 batches
+    # (2 trained + 3 skipped, recorded as batches_consumed in the manifest),
+    # so train_iters=7 grants exactly 2 more batches — the skipped
+    # iterations are not silently re-granted, and the resumed run's
+    # optimizer step lands at 4 (= 7 - 3 pre-crash skips), exactly where an
+    # uninterrupted 7-iter run with the same 3 skips would
+    faults.reset()
+    ns2 = tiny_ns("--train_iters", "7", "--save", d, "--load", d,
+                  "--anomaly_max_skips", "2")
+    out = train(ns2, verbose=False)
+    assert int(np.asarray(out["state"]["step"])) == 4
+
+
+def test_exit_save_records_trailing_skipped_batches(tmp_path):
+    """Anomaly skips AFTER the last interval save advance the stream but not
+    the optimizer step; the exit save must still refresh the committed
+    meta's batches_consumed (dedup on step alone would leave it stale and
+    resume would replay — and re-skip — the same poisoned batches forever)."""
+    from galvatron_tpu.core.trainer import train
+
+    d = str(tmp_path / "ck")
+    # it=0,1 train (steps 1,2; interval save at boundary 2), it=2,3 skip
+    faults.configure(nan_at_step=2, nan_count=2)
+    ns = tiny_ns("--train_iters", "4", "--save", d, "--save_interval", "2",
+                 "--anomaly_max_skips", "3")
+    train(ns, verbose=False)
+    assert committed_steps(d) == [2]
+    m = read_manifest(step_path(d, 2))
+    assert m["meta"]["batches_consumed"] == 4  # not the stale 2
+
+    # resume: batches 0..3 are spent, so train_iters=6 grants exactly 2 more
+    faults.reset()
+    out = train(tiny_ns("--train_iters", "6", "--save", d, "--load", d),
+                verbose=False)
+    assert int(np.asarray(out["state"]["step"])) == 4  # 2 + 2, skips not re-granted
+
+
+def test_content_only_match_treats_none_digest_as_wildcard():
+    """Structure-only manifest records (digest None, multihost saves) must
+    not wrongly reject a healthy raw restore whose keypaths drifted."""
+    from galvatron_tpu.core.checkpoint import _content_only_match
+
+    state = {"a": jnp.ones((4,), jnp.float32), "b": jnp.zeros((4,), jnp.float32)}
+    rec = {"shape": [4], "dtype": "float32", "digest": None}
+    manifest = {"leaves": {"['x']": dict(rec), "['y']": dict(rec)}}
+    assert _content_only_match(manifest, state)  # count matches, wildcard digests
+    # a genuine structural mismatch still rejects
+    assert not _content_only_match(manifest, {"a": jnp.ones((4,), jnp.float32)})
+    assert not _content_only_match(
+        manifest, {"a": jnp.ones((4,)), "b": jnp.zeros((5,))}
+    )
+
+
+def test_disarmed_nan_injection_logs_string_loss(tmp_path):
+    """nan_at_step fires with the sentinel DISARMED too (chaos jobs need no
+    --anomaly_max_skips precondition), and the non-finite loss reaches the
+    JSONL as a string — bare NaN is not valid JSON."""
+    from galvatron_tpu.core.trainer import train
+
+    m = str(tmp_path / "m.jsonl")
+    faults.configure(nan_at_step=1)
+    train(tiny_ns("--train_iters", "2", "--metrics_path", m), verbose=False)
+    recs = [r for r in read_metrics(m) if r["event"] == "train_iter"]
+    assert [r["step"] for r in recs] == [0, 1]
+    assert isinstance(recs[0]["loss"], float)
+    assert recs[1]["loss"] == "nan"
+
+
+def test_train_keep_last_n_via_flag(tmp_path):
+    from galvatron_tpu.core.trainer import train
+
+    d = str(tmp_path / "ck")
+    ns = tiny_ns("--train_iters", "4", "--save", d, "--save_interval", "1",
+                 "--keep_last_n", "2")
+    train(ns, verbose=False)
+    assert committed_steps(d) == [3, 4]

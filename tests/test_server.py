@@ -73,3 +73,133 @@ def test_server_roundtrip():
     except urllib.error.HTTPError as e:
         assert e.code == 400
     svc.httpd.shutdown()
+
+
+def _start_server(request_timeout_s=120.0, max_pending=8):
+    from galvatron_tpu.server import GenerationService, run_server
+
+    params = modeling.init_model_params(jax.random.key(0), TINY)
+    svc = GenerationService(params, TINY, ByteTokenizer(), max_new_default=4)
+    ready = threading.Event()
+    t = threading.Thread(
+        target=run_server, args=(svc, 0),
+        kwargs={"ready_event": ready, "request_timeout_s": request_timeout_s,
+                "max_pending": max_pending},
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(10)
+    return svc, svc.httpd.server_address[1]
+
+
+def test_healthz():
+    svc, port = _start_server()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30
+        ) as r:
+            body = json.loads(r.read())
+        assert body["status"] == "ok"
+        assert body["uptime_s"] >= 0
+        assert body["requests_served"] == 0
+        assert body["model"] == {
+            "vocab_size": TINY.vocab_size, "hidden_size": 32,
+            "num_layers": 1, "num_heads": 2, "max_seq_len": 64,
+        }
+        # unknown GET path → 404 (POST-only /api unaffected)
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/api", timeout=30)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        svc.httpd.shutdown()
+
+
+def test_stalled_client_cannot_wedge_server():
+    """Stalled clients must not pin handler threads forever: the
+    per-connection socket timeout (Handler.timeout) drops a connection whose
+    read stalls — mid-request-line or mid-body — and the server keeps
+    serving. The close is asserted, not just liveness (the threading server
+    would answer /healthz even with the timeout broken)."""
+    import socket
+
+    svc, port = _start_server(request_timeout_s=0.5)
+    try:
+        # stalled client 1: connects, sends nothing
+        s1 = socket.create_connection(("127.0.0.1", port))
+        # stalled client 2: starts a request, never delivers the body
+        s2 = socket.create_connection(("127.0.0.1", port))
+        s2.sendall(
+            b"POST /api HTTP/1.1\r\nHost: x\r\nContent-Length: 100\r\n\r\n"
+        )
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30
+            ) as r:
+                assert json.loads(r.read())["status"] == "ok"
+            # both stalled connections are dropped once request_timeout_s
+            # elapses: recv observes EOF (empty read) instead of hanging
+            for s in (s1, s2):
+                s.settimeout(10)
+                assert s.recv(1024) == b""
+        finally:
+            s1.close()
+            s2.close()
+    finally:
+        svc.httpd.shutdown()
+
+
+
+def test_server_busy_returns_503():
+    """Pending /api work is bounded: with the generation lock held and the
+    single slot occupied, further requests fail fast with 503 instead of
+    queueing threads; /healthz stays open throughout."""
+    import socket
+    import time
+    import urllib.error
+
+    svc, port = _start_server(max_pending=1)
+    payload = json.dumps({"prompts": ["a"], "tokens_to_generate": 1}).encode()
+
+    try:
+        svc.lock.acquire()  # wedge generation so the slot holder parks
+        occupier = socket.create_connection(("127.0.0.1", port))
+        try:
+            # the occupier takes the single slot, then parks on the lock
+            occupier.sendall(
+                b"POST /api HTTP/1.1\r\nHost: x\r\nContent-Length: "
+                + str(len(payload)).encode() + b"\r\n\r\n" + payload
+            )
+            # poll until the occupier holds the slot and a probe sees 503;
+            # a probe racing ahead of the occupier parks too (short client
+            # timeout) and itself becomes the occupier for the next probe
+            got_503 = False
+            for _ in range(100):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/api", data=payload, method="POST"
+                )
+                try:
+                    urllib.request.urlopen(req, timeout=2).read()
+                except urllib.error.HTTPError as e:
+                    if e.code == 503:
+                        got_503 = True
+                        break
+                    raise
+                except (TimeoutError, urllib.error.URLError):
+                    pass
+                time.sleep(0.05)
+            assert got_503
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30
+            ) as r:
+                assert json.loads(r.read())["status"] == "ok"
+        finally:
+            svc.lock.release()
+            # unwedged: the parked occupier's generation completes and its
+            # response arrives — the slot really was held, not dropped
+            occupier.settimeout(120)
+            assert occupier.recv(64).startswith(b"HTTP/1.0 200")
+            occupier.close()
+    finally:
+        svc.httpd.shutdown()

@@ -1,0 +1,58 @@
+"""core/signals.py — GracefulExitHandler latch, second-SIGINT hard exit,
+and handler restoration (the trainer's checkpoint-then-exit contract relies
+on all three)."""
+
+import signal
+
+import pytest
+
+from galvatron_tpu.core.signals import GracefulExitHandler
+
+
+def test_sigterm_latches_and_handlers_restore():
+    prev_term = signal.getsignal(signal.SIGTERM)
+    prev_int = signal.getsignal(signal.SIGINT)
+    with GracefulExitHandler() as h:
+        assert h.signaled is None
+        signal.raise_signal(signal.SIGTERM)
+        assert h.signaled == signal.SIGTERM
+        # repeated SIGTERM stays latched (only SIGINT escalates)
+        signal.raise_signal(signal.SIGTERM)
+        assert h.signaled == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is prev_term
+    assert signal.getsignal(signal.SIGINT) is prev_int
+
+
+def test_first_sigint_latches_second_hard_exits():
+    prev_int = signal.getsignal(signal.SIGINT)
+    with GracefulExitHandler() as h:
+        signal.raise_signal(signal.SIGINT)
+        assert h.signaled == signal.SIGINT  # graceful: loop drains + saves
+        with pytest.raises(KeyboardInterrupt):
+            signal.raise_signal(signal.SIGINT)  # impatient second Ctrl-C
+    assert signal.getsignal(signal.SIGINT) is prev_int
+
+
+def test_sigterm_then_sigint_hard_exits():
+    """A SIGTERM'd (preempted) run still honours an operator Ctrl-C."""
+    with GracefulExitHandler() as h:
+        signal.raise_signal(signal.SIGTERM)
+        assert h.signaled == signal.SIGTERM
+        with pytest.raises(KeyboardInterrupt):
+            signal.raise_signal(signal.SIGINT)
+
+
+def test_restoration_after_exception_inside_block():
+    prev_term = signal.getsignal(signal.SIGTERM)
+    with pytest.raises(RuntimeError):
+        with GracefulExitHandler():
+            raise RuntimeError("boom")
+    assert signal.getsignal(signal.SIGTERM) is prev_term
+
+
+def test_custom_signal_list():
+    prev = signal.getsignal(signal.SIGUSR1)
+    with GracefulExitHandler(signals=[signal.SIGUSR1]) as h:
+        signal.raise_signal(signal.SIGUSR1)
+        assert h.signaled == signal.SIGUSR1
+    assert signal.getsignal(signal.SIGUSR1) is prev
