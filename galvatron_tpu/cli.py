@@ -8,7 +8,10 @@ models/<name>/{train_dist,search_dist,profiler}.py + profile_hardware):
   profile           model computation/memory profiling → JSON
   profile-hardware  ICI bandwidth + overlap sweep → JSON
   generate          KV-cache text generation from a checkpoint (or random init)
-  serve             REST generation server (text_generation_server equivalent)
+  serve             REST generation server (text_generation_server equivalent);
+                    continuous-batching engine by default (--num_slots,
+                    --prefill_chunk, --request_ttl_s; --num_slots 0 = legacy
+                    serialized path)
   export-hf         trainer checkpoint → HuggingFace-format checkpoint
 
 The per-model modules (galvatron_tpu.models.<family>) re-export these with
@@ -336,9 +339,24 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             return 0
         from galvatron_tpu.server import GenerationService, run_server
 
+        engine = None
+        if ns.num_slots > 0:
+            from galvatron_tpu.serving import Engine
+
+            engine = Engine(
+                params, cfg,
+                num_slots=ns.num_slots,
+                prefill_chunk=ns.prefill_chunk,
+                max_queue=ns.max_queue,
+                request_ttl_s=ns.request_ttl_s if ns.request_ttl_s > 0 else None,
+                eos_id=tok.eos_id if tok.eos_id is not None else -1,
+                pad_id=tok.pad_id if tok.pad_id is not None else 0,
+                seed=ns.seed,
+            )
         run_server(
-            GenerationService(params, cfg, tok, ns.max_new_tokens, ns.seed),
-            port=ns.port, host=ns.host,
+            GenerationService(params, cfg, tok, ns.max_new_tokens, ns.seed,
+                              engine=engine),
+            port=ns.port, host=ns.host, max_pending=ns.max_pending,
         )
         return 0
 

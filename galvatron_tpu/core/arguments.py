@@ -221,6 +221,22 @@ def _add_generate_args(p: argparse.ArgumentParser):
     g.add_argument("--seed", type=int, default=1234)
     g.add_argument("--port", type=int, default=5000)
     g.add_argument("--host", type=str, default="127.0.0.1")
+    # serve: continuous-batching engine (serving.Engine); 0 slots = legacy
+    # serialized path (generate_np under the global lock)
+    g.add_argument("--num_slots", type=int, default=4,
+                   help="KV-cache slots = max concurrently decoding requests "
+                   "(0 disables the engine: serialized single-shot path)")
+    g.add_argument("--prefill_chunk", type=int, default=32,
+                   help="prompt tokens prefilled per jitted chunk when a "
+                   "request joins its slot (one compiled program per size)")
+    g.add_argument("--request_ttl_s", type=float, default=30.0,
+                   help="max seconds a request may wait in the admission "
+                   "queue before being rejected with 503 (<=0: no TTL)")
+    g.add_argument("--max_queue", type=int, default=64,
+                   help="admission queue depth; beyond it requests fail "
+                   "fast with 503 (engine path's max_pending equivalent)")
+    g.add_argument("--max_pending", type=int, default=8,
+                   help="legacy path: bound on queued /api requests")
     g.add_argument("--output_dir", type=str, default=None,
                    help="export-hf: directory for the HF-format checkpoint")
 

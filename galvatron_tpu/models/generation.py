@@ -106,6 +106,82 @@ def forward_with_cache(params: Params, tokens, cfg: ModelConfig, cache: KVCache,
 
 
 # ---------------------------------------------------------------------------
+# Slot-wise forward: every batch row at its own absolute position
+# (continuous-batching serving — each row is a different request)
+# ---------------------------------------------------------------------------
+
+
+def _layer_with_cache_slots(x, p, cfg: ModelConfig, k_cache, v_cache, offsets,
+                            cos_sin, alibi):
+    """``_layer_with_cache`` variant where ``offsets`` is (B,): row ``b``
+    reads/writes its cache at its own position. Returns (x, k_cache, v_cache)."""
+    b, s, h = x.shape
+    xa = modeling.norm(x, p["attn_norm"], cfg)
+    pa = p["attn"]
+    q, k, v = modeling.project_qkv_heads(xa, pa, cfg)
+    if cfg.pos_embed == "rope":
+        cos, sin = cos_sin  # (B, s, hd/2) per-row tables
+        q = modeling.apply_rope(q, cos, sin)
+        k = modeling.apply_rope(k, cos, sin)
+    row_update = jax.vmap(
+        lambda c, u, o: jax.lax.dynamic_update_slice(c, u, (o, 0, 0))
+    )
+    k_cache = row_update(k_cache, k.astype(k_cache.dtype), offsets)
+    v_cache = row_update(v_cache, v.astype(v_cache.dtype), offsets)
+    bias = None
+    if alibi is not None:
+        q_pos = offsets[:, None] + jnp.arange(s)[None]  # (B, s)
+        k_pos = jnp.arange(k_cache.shape[1])
+        rel = k_pos[None, None, :] - q_pos[:, :, None]  # (B, s, Smax)
+        bias = (alibi[None, :, None, None] * rel[:, None]).astype(jnp.float32)
+    o = modeling.attention_xla(q, k_cache, v_cache, cfg, bias=bias, q_offset=offsets)
+    x = x + modeling.attn_output(o, pa, cfg, x.dtype)
+    x = x + modeling.mlp_block(
+        modeling.norm(x, p["mlp_norm"], cfg), p["mlp"], cfg, train=False
+    )
+    return x, k_cache, v_cache
+
+
+def forward_with_cache_slots(params: Params, tokens, cfg: ModelConfig,
+                             cache: KVCache, offsets):
+    """Run ``tokens`` (B, s) through the model with PER-ROW absolute positions
+    ``offsets`` (B,), updating row ``b`` of the cache at ``offsets[b]``.
+    Returns (logits, new_cache). ``offsets`` may be traced.
+
+    This is the forward the continuous-batching engine runs once per decode
+    iteration over all slots: rows are independent requests at arbitrary
+    depths into their sequences; rows holding no request are simply masked by
+    the caller (their writes land at their own row's offset and are
+    overwritten by the next prefill before ever becoming visible — causal
+    masking keeps positions > a row's own offset invisible)."""
+    b, s = tokens.shape
+    smax = cache.k.shape[2]
+    if cfg.pos_embed == "rope":
+        cos_all, sin_all = modeling.rope_tables(cfg, smax)
+        pos = offsets[:, None] + jnp.arange(s)[None]  # (B, s)
+        cos_sin = (cos_all[pos], sin_all[pos])
+    else:
+        cos_sin = None
+    alibi = (
+        jnp.asarray(modeling.alibi_slopes(cfg.num_heads)) if cfg.pos_embed == "alibi" else None
+    )
+    x = params["embed"]["tok"].astype(cfg.dtype)[tokens]
+    if cfg.pos_embed == "learned":
+        pos = offsets[:, None] + jnp.arange(s)[None]
+        x = x + params["embed"]["pos"].astype(cfg.dtype)[pos]
+    new_k, new_v = [], []
+    for i, lp in enumerate(params["layers"]):
+        x, ki, vi = _layer_with_cache_slots(
+            x, lp, cfg, cache.k[i], cache.v[i], offsets, cos_sin, alibi
+        )
+        new_k.append(ki)
+        new_v.append(vi)
+    x = modeling.norm(x, params["final_norm"], cfg)
+    logits = modeling.lm_head(x, params, cfg)
+    return logits, KVCache(jnp.stack(new_k), jnp.stack(new_v))
+
+
+# ---------------------------------------------------------------------------
 # Sampling (reference: megatron/text_generation/sampling.py modify_logits_for_
 # top_k_filtering / top_p_filtering + sample)
 # ---------------------------------------------------------------------------

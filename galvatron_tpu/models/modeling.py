@@ -594,12 +594,20 @@ def rope_tables(cfg: ModelConfig, seq_len: int, offset: int = 0):
 def apply_rope(x, cos, sin):
     """x: (B, S, n, hd). Rotate-half convention (reference: rotary_pos_embedding
     apply_rotary_pos_emb, site_package/megatron/core/models/common/embeddings/
-    rotary_pos_embedding.py:144)."""
+    rotary_pos_embedding.py:144).
+
+    ``cos``/``sin`` are ``(S, hd/2)`` tables shared across the batch, or
+    ``(B, S, hd/2)`` per-row tables (slot-wise decode: each batch row sits at
+    its own absolute position)."""
     dt = x.dtype
     x = x.astype(jnp.float32)
     x1, x2 = jnp.split(x, 2, axis=-1)
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    if cos.ndim == 3:
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+    else:
+        c = cos[None, :, None, :]
+        s = sin[None, :, None, :]
     out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
     return out.astype(dt)
 
@@ -631,7 +639,10 @@ def attention_xla(q, k, v, cfg: ModelConfig, bias=None, q_offset=0):
 
     k/v may be longer than q (KV-cache decode): query i sits at absolute
     position ``q_offset + i`` and sees keys at positions <= its own.
-    ``q_offset`` may be a traced scalar."""
+    ``q_offset`` may be a traced scalar, or a traced ``(B,)`` vector giving
+    each batch row its own absolute position — the slot-wise entry point used
+    by the continuous-batching serving engine, where every row of the batch
+    is a different request at a different depth into its sequence."""
     b, s, nh, hd = q.shape
     k = _repeat_kv(k, nh // k.shape[2])
     v = _repeat_kv(v, nh // v.shape[2])
@@ -639,10 +650,12 @@ def attention_xla(q, k, v, cfg: ModelConfig, bias=None, q_offset=0):
     if bias is not None:
         scores = scores + bias
     if cfg.causal:
-        q_pos = q_offset + jnp.arange(s)
+        # (1|B, s): scalar offset broadcasts over the batch; a (B,) offset
+        # yields a per-row mask (scores are (b, n, q, k))
+        q_pos = jnp.reshape(jnp.asarray(q_offset), (-1, 1)) + jnp.arange(s)[None]
         k_pos = jnp.arange(k.shape[1])
-        causal = k_pos[None, :] <= q_pos[:, None]
-        scores = jnp.where(causal[None, None], scores, -1e30)
+        causal = k_pos[None, None, :] <= q_pos[:, :, None]
+        scores = jnp.where(causal[:, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bnqk,bknh->bqnh", probs, v)
 

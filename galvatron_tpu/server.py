@@ -3,23 +3,35 @@
 Counterpart of the reference's Flask server (reference:
 galvatron/site_package/megatron/text_generation_server.py — PUT /api with
 {"prompts": [...], "tokens_to_generate": N, ...}). Stdlib-only
-(http.server) so it carries no extra dependencies; generation requests are
-serialized by the service lock (generation holds the chip anyway).
+(http.server) so it carries no extra dependencies.
+
+Two execution paths behind one API:
+
+- **Continuous-batching engine** (``serving.Engine``, the default from the
+  CLI): each prompt is submitted as a request and resolved via a future;
+  overlapping requests share every decode iteration over one persistent
+  slot-based KV cache instead of queueing on a lock. Backpressure is the
+  engine's bounded admission queue (``QueueFull``/TTL expiry → 503).
+- **Serialized legacy path** (``engine=None``): ``generate_np`` under the
+  global service lock, pending work bounded by the ``max_pending`` gate
+  (excess requests fail fast with 503). Kept as the compatible single-shot
+  path and as the baseline ``bench_serving.py`` measures against.
 
 API (POST or PUT /api, JSON body):
   {"prompts": ["..."], "tokens_to_generate": 32, "temperature": 0.0,
    "top_k": 0, "top_p": 0.0}
 → {"text": ["...completions..."], "tokens": [[...ids...]]}
-GET /healthz → {"status": "ok", "uptime_s": ..., "requests_served": ...,
-                "model": {vocab/hidden/layers/heads/max_seq_len}}
+GET /healthz → {"status": "ok", "uptime_s": ..., "requests": {succeeded/
+                failed/rejected}, "gate" | "serving": saturation + engine
+                stats, "model": {vocab/hidden/layers/heads/max_seq_len}}
 
-Connections are handled on threads — generation itself stays serialized by
-the service lock, but /healthz answers while a generation is in flight —
-and each carries a socket timeout (``request_timeout_s``) so a stalled
-client (connected but never sending, or trickling a body) releases its
-thread instead of accumulating forever. Pending /api work is bounded by
-``max_pending`` (excess requests fail fast with 503 instead of queueing
-threads on the generation lock for clients that may be long gone).
+Connections are handled on threads — /healthz answers while generations are
+in flight — and each carries a socket timeout (``request_timeout_s``) so a
+stalled client (connected but never sending, or trickling a body) releases
+its thread instead of accumulating forever. Replies into sockets the client
+already abandoned (BrokenPipeError/ConnectionResetError) are swallowed and
+the connection closed, like the stalled-read TimeoutError path — a
+disconnecting client must not leave tracebacks or a half-written 500.
 """
 
 from __future__ import annotations
@@ -32,24 +44,76 @@ from typing import Any, Optional
 
 import jax
 
+from galvatron_tpu.utils.metrics import Counters
+
+
+class _Gate:
+    """Bounded pending-work gate for the legacy path, with visible
+    saturation (capacity/in_use/rejected land in /healthz so a 503-storm
+    shows up on the probe, not just client-side)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._sem = threading.BoundedSemaphore(capacity)
+        self._lock = threading.Lock()
+        self.in_use = 0
+        self.rejected = 0
+
+    def acquire(self) -> bool:
+        ok = self._sem.acquire(blocking=False)
+        with self._lock:
+            if ok:
+                self.in_use += 1
+            else:
+                self.rejected += 1
+        return ok
+
+    def release(self) -> None:
+        with self._lock:
+            self.in_use -= 1
+        self._sem.release()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "in_use": self.in_use,
+                "saturated": self.in_use >= self.capacity,
+                "rejected": self.rejected,
+            }
+
+
+class ServiceBusy(RuntimeError):
+    """Mapped to HTTP 503 by the handler (queue full / TTL expired)."""
+
 
 class GenerationService:
-    def __init__(self, params, cfg, tokenizer, max_new_default: int = 64, seed: int = 0):
+    def __init__(self, params, cfg, tokenizer, max_new_default: int = 64,
+                 seed: int = 0, engine=None):
         self.params = params
         self.cfg = cfg
         self.tok = tokenizer
         self.max_new_default = max_new_default
         self.key = jax.random.key(seed)
+        self.engine = engine  # serving.Engine, or None for the legacy path
         self.lock = threading.Lock()
         self.started_at = time.time()
-        self.requests_served = 0
+        self.counters = Counters("succeeded", "failed", "rejected")
+        self.gate: Optional[_Gate] = None  # set by run_server (legacy path)
+
+    @property
+    def requests_served(self) -> int:
+        # back-compat alias (pre-engine probes read this): completed OK
+        return self.counters.get("succeeded")
 
     def health(self) -> dict:
         c = self.cfg
-        return {
+        req = self.counters.snapshot()
+        out = {
             "status": "ok",
             "uptime_s": round(time.time() - self.started_at, 3),
-            "requests_served": self.requests_served,
+            "requests_served": req["succeeded"],
+            "requests": req,
             "model": {
                 "vocab_size": c.vocab_size,
                 "hidden_size": c.hidden_size,
@@ -58,10 +122,13 @@ class GenerationService:
                 "max_seq_len": c.max_seq_len,
             },
         }
+        if self.gate is not None:
+            out["gate"] = self.gate.snapshot()
+        if self.engine is not None:
+            out["serving"] = self.engine.stats()
+        return out
 
-    def generate(self, body: dict) -> dict:
-        from galvatron_tpu.models import generation
-
+    def _validate(self, body: dict):
         if not isinstance(body, dict):
             raise ValueError("request body must be a JSON object")
         prompts = body.get("prompts")
@@ -72,10 +139,65 @@ class GenerationService:
         n_new = int(body.get("tokens_to_generate", self.max_new_default))
         if n_new < 0 or n_new > self.cfg.max_seq_len:
             raise ValueError(f"tokens_to_generate out of range [0, {self.cfg.max_seq_len}]")
+        return prompts, n_new
+
+    def generate(self, body: dict) -> dict:
+        prompts, n_new = self._validate(body)
         tok_prompts = [self.tok.encode(p) for p in prompts]
+        if self.engine is not None:
+            outs = self._generate_engine(body, tok_prompts, n_new)
+        else:
+            outs = self._generate_serialized(body, tok_prompts, n_new)
+        texts = [self.tok.decode(o[len(tp):]) for o, tp in zip(outs, tok_prompts)]
+        return {"text": texts, "tokens": outs}
+
+    def _generate_engine(self, body: dict, tok_prompts, n_new: int):
+        """Continuous-batching path: one engine request per prompt, futures
+        resolved as slots retire. Prompts of one HTTP request overlap with
+        each other AND with every other in-flight connection."""
+        from concurrent.futures import TimeoutError as FuturesTimeout
+
+        from galvatron_tpu.serving import QueueFull, RequestExpired
+
+        ttl = body.get("ttl_s")
+        futures = []
+        try:
+            for tp in tok_prompts:
+                futures.append(self.engine.submit(
+                    tp, n_new,
+                    temperature=float(body.get("temperature", 0.0)),
+                    top_k=int(body.get("top_k", 0)),
+                    top_p=float(body.get("top_p", 0.0)),
+                    ttl_s=float(ttl) if ttl is not None else None,
+                ))
+            return [f.result(timeout=self.engine.result_timeout_s)
+                    for f in futures]
+        except QueueFull as e:
+            raise ServiceBusy(str(e)) from e
+        except RequestExpired as e:
+            raise ServiceBusy(str(e)) from e
+        except FuturesTimeout as e:
+            # distinct from the socket-read TimeoutError the handler treats
+            # as a dead client: this request must get a real 500 and count
+            # as failed (on 3.11+ FuturesTimeout aliases TimeoutError, which
+            # the handler's stalled-client branch would silently swallow)
+            raise RuntimeError(
+                f"generation timed out after {self.engine.result_timeout_s}s"
+            ) from e
+        finally:
+            # failed or abandoned siblings must not burn chip time: cancel
+            # whatever has not been admitted yet (done futures ignore it)
+            for f in futures:
+                f.cancel()
+
+    def _generate_serialized(self, body: dict, tok_prompts, n_new: int):
+        """Legacy single-shot path: full prefill+decode per request under
+        the global lock (generation holds the chip anyway)."""
+        from galvatron_tpu.models import generation
+
         with self.lock:
             self.key, sub = jax.random.split(self.key)
-            outs = generation.generate_np(
+            return generation.generate_np(
                 self.params,
                 self.cfg,
                 tok_prompts,
@@ -87,17 +209,9 @@ class GenerationService:
                 pad_id=self.tok.pad_id if self.tok.pad_id is not None else 0,
                 key=sub,
             )
-            # counted inside the generation lock: re-acquiring it afterwards
-            # would park this finished request behind the next generation
-            self.requests_served += 1
-        texts = [self.tok.decode(o[len(tp):]) for o, tp in zip(outs, tok_prompts)]
-        return {"text": texts, "tokens": outs}
 
 
-def _make_handler(
-    service: GenerationService, request_timeout_s: float,
-    gate: threading.BoundedSemaphore,
-):
+def _make_handler(service: GenerationService, request_timeout_s: float):
     class Handler(BaseHTTPRequestHandler):
         # socketserver per-connection timeout: applied to the socket in
         # setup(), so a stalled read (request line or body) raises instead
@@ -105,41 +219,60 @@ def _make_handler(
         timeout = request_timeout_s
 
         def _reply(self, code: int, payload: dict):
-            data = json.dumps(payload).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
+            # a client that disconnected mid-generation must not blow a
+            # traceback out of the handler (nor can the 500-path itself be
+            # allowed to throw) — drop the dead connection like the
+            # stalled-read TimeoutError path does
+            try:
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            except (BrokenPipeError, ConnectionResetError, TimeoutError, OSError):
+                self.close_connection = True
 
         def _handle(self):
             if self.path.rstrip("/") != "/api":
                 return self._reply(404, {"error": "use /api"})
-            # bounded pending work: the threading server gives every
-            # connection a thread, and a thread parked on the generation
-            # lock is NOT covered by the socket timeout — without the gate,
-            # a slow generation plus a request flood accumulates unbounded
-            # threads and then burns chip time generating for clients long
-            # gone. Saturated → fail fast with 503 (/healthz stays open).
-            if not gate.acquire(blocking=False):
+            # bounded pending work (legacy path only): the threading server
+            # gives every connection a thread, and a thread parked on the
+            # generation lock is NOT covered by the socket timeout — without
+            # the gate, a slow generation plus a request flood accumulates
+            # unbounded threads and then burns chip time generating for
+            # clients long gone. Saturated → fail fast with 503 (/healthz
+            # stays open). With the engine, admission control lives in the
+            # scheduler's bounded queue instead (QueueFull/TTL → 503).
+            gate = service.gate
+            if gate is not None and not gate.acquire():
+                service.counters.inc("rejected")
                 return self._reply(
                     503, {"error": "server busy: too many pending requests"}
                 )
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
-                return self._reply(200, service.generate(body))
+                resp = service.generate(body)
+                service.counters.inc("succeeded")
+                return self._reply(200, resp)
             except TimeoutError:
                 # stalled client mid-body: drop the connection without
                 # attempting to write a reply into the dead socket
                 self.close_connection = True
                 return
+            except ServiceBusy as e:
+                service.counters.inc("rejected")
+                return self._reply(503, {"error": str(e)})
             except ValueError as e:
+                service.counters.inc("failed")
                 return self._reply(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — surface to client
+                service.counters.inc("failed")
                 return self._reply(500, {"error": f"{type(e).__name__}: {e}"})
             finally:
-                gate.release()
+                if gate is not None:
+                    gate.release()
 
         do_POST = _handle
         do_PUT = _handle
@@ -158,13 +291,15 @@ def _make_handler(
 def run_server(service: GenerationService, port: int = 5000, host: str = "127.0.0.1",
                ready_event: Optional[threading.Event] = None,
                request_timeout_s: float = 120.0, max_pending: int = 8) -> None:
-    # threading server: generation is serialized by service.lock anyway, but
-    # /healthz must answer while a long generation is in flight — a probe
-    # timing out against a busy single-threaded server would get a healthy
-    # process restarted. max_pending bounds queued /api work (excess → 503).
-    gate = threading.BoundedSemaphore(max_pending)
+    # threading server: /healthz must answer while a long generation is in
+    # flight — a probe timing out against a busy single-threaded server
+    # would get a healthy process restarted. On the legacy path max_pending
+    # bounds queued /api work (excess → 503); with the engine, the
+    # scheduler's bounded queue is the admission control.
+    if service.engine is None:
+        service.gate = _Gate(max_pending)
     httpd = ThreadingHTTPServer(
-        (host, port), _make_handler(service, request_timeout_s, gate)
+        (host, port), _make_handler(service, request_timeout_s)
     )
     service.httpd = httpd
     if ready_event is not None:

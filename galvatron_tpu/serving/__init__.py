@@ -1,0 +1,34 @@
+"""Continuous-batching serving subsystem.
+
+Three layers, one per module:
+
+- [[kv_slots]] ``SlotKVCache`` — persistent fixed-shape device KV cache,
+  host-side slot allocator (per-slot offset/length, alloc/free/reset).
+- [[scheduler]] ``Scheduler`` — FIFO admission queue with per-request TTL,
+  bounded depth (``QueueFull``), expiry (``RequestExpired``), counters.
+- [[engine]] ``Engine`` — the loop: one jitted decode step over all slots
+  per iteration, chunked prefill on admission, host-side per-request
+  sampling, retire-on-eos/budget.
+
+``server.GenerationService`` submits into the engine via futures; the
+legacy serialized ``generate_np`` path remains available when the engine is
+disabled (``--num_slots 0``).
+"""
+
+from galvatron_tpu.serving.engine import Engine
+from galvatron_tpu.serving.kv_slots import SlotKVCache
+from galvatron_tpu.serving.scheduler import (
+    QueueFull,
+    Request,
+    RequestExpired,
+    Scheduler,
+)
+
+__all__ = [
+    "Engine",
+    "SlotKVCache",
+    "Scheduler",
+    "Request",
+    "QueueFull",
+    "RequestExpired",
+]

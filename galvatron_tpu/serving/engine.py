@@ -1,0 +1,408 @@
+"""Continuous-batching generation engine: one jitted decode step, many requests.
+
+The serialized server path (``server.GenerationService.generate`` under the
+global lock) pays a full prefill+decode ``generate`` per request; aggregate
+throughput is one request at a time no matter how many chips sit idle. This
+engine instead runs ONE fixed-shape jitted decode step per iteration over a
+persistent slot-based KV cache ([[kv_slots]]): every active request occupies
+a batch row, new requests join between iterations via chunked prefill into
+their slot, and finished rows retire and free their slot immediately
+(iteration-level scheduling — Orca, OSDI '22). Overlapping requests share
+every forward pass instead of queueing on a lock.
+
+Static shapes are the point on TPU: exactly two compiled programs exist for
+the engine's whole lifetime — ``_decode_step`` at ``(num_slots, 1)`` and
+``_prefill_chunk`` at ``(1, prefill_chunk)`` — slot index, per-row offsets,
+and prompt contents are all traced operands, so the jit cache stays bounded
+at 2 regardless of traffic mix (no per-request recompiles).
+
+Sampling runs on host from the per-slot last logits: each request carries
+its own temperature/top_k/top_p, which therefore never enter the compiled
+program (a per-request static ``top_k`` would recompile; a host-side
+``np.argmax``/categorical over ``(V,)`` per slot is noise next to the
+forward). Greedy host sampling matches ``generate``'s on-device argmax
+bit-for-bit, which is what the parity tests pin.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from galvatron_tpu.models import generation
+from galvatron_tpu.models.generation import KVCache
+from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.serving.kv_slots import SlotKVCache
+from galvatron_tpu.serving.scheduler import Request, Scheduler
+from galvatron_tpu.utils.metrics import Counters, QuantileWindow
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _prefill_chunk(params, cfg: ModelConfig, cache: KVCache, tokens, slot, offset):
+    """Prefill one chunk of one request into its slot.
+
+    tokens: (1, C) — the request's tokens [offset, offset+C) padded at the
+    tail; slot/offset are traced scalars, so every chunk of every request
+    reuses this one compiled program. Returns ((C, V) logits, cache).
+    Garbage k/v written by tail padding is invisible forever: positions
+    beyond a row's own query offset are causally masked, and each decode
+    step overwrites its position before attending to it."""
+    row = KVCache(
+        jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
+        jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
+    )
+    logits, row = generation.forward_with_cache(params, tokens, cfg, row, offset)
+    cache = KVCache(
+        jax.lax.dynamic_update_slice_in_dim(cache.k, row.k, slot, axis=1),
+        jax.lax.dynamic_update_slice_in_dim(cache.v, row.v, slot, axis=1),
+    )
+    return logits[0], cache
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _decode_step(params, cfg: ModelConfig, cache: KVCache, tokens, offsets):
+    """One decode iteration over ALL slots: tokens (B,) at per-row positions
+    offsets (B,). Inactive rows carry (0, 0) — their write lands at position
+    0 of their own free slot and is overwritten by the next prefill before
+    any query can attend it. Returns ((B, V) next-position logits, cache)."""
+    logits, cache = generation.forward_with_cache_slots(
+        params, tokens[:, None], cfg, cache, offsets
+    )
+    return logits[:, 0], cache
+
+
+def _sample_host(rng: np.random.Generator, logits: np.ndarray,
+                 temperature: float, top_k: int, top_p: float) -> int:
+    """Host-side sampler mirroring ``generation.sample_logits`` semantics
+    (temperature<=0 → greedy; top-k filter; nucleus keeps the smallest
+    prefix with cumulative prob >= top_p, always >= 1 token)."""
+    logits = np.asarray(logits, np.float64)
+    if temperature <= 0:
+        return int(np.argmax(logits))
+    scaled = logits / temperature
+    if top_k > 0:
+        kth = np.sort(scaled)[-min(top_k, len(scaled))]
+        scaled = np.where(scaled < kth, -np.inf, scaled)
+    if top_p > 0:
+        sorted_logits = np.sort(scaled)[::-1]
+        shifted = sorted_logits - sorted_logits[0]
+        probs = np.exp(shifted) / np.exp(shifted).sum()
+        cum = np.cumsum(probs)
+        keep = cum - probs < top_p
+        threshold = sorted_logits[keep].min()
+        scaled = np.where(scaled < threshold, -np.inf, scaled)
+    shifted = scaled - scaled.max()
+    p = np.exp(shifted)
+    p /= p.sum()
+    return int(rng.choice(len(p), p=p))
+
+
+class Engine:
+    """Continuous-batching engine: submit() → Future, loop thread does the rest.
+
+    Thread model: handler threads call ``submit``/``stats``; ONE loop thread
+    owns the device cache, the slot table, and all jit calls. The scheduler
+    queue is the only structure both sides touch, and it carries its own lock.
+    """
+
+    def __init__(self, params, cfg: ModelConfig, *, num_slots: int = 4,
+                 prefill_chunk: int = 32, max_queue: int = 64,
+                 request_ttl_s: Optional[float] = 30.0,
+                 max_seq_len: Optional[int] = None, eos_id: int = -1,
+                 pad_id: int = 0, seed: int = 0,
+                 result_timeout_s: float = 600.0, start_loop: bool = True):
+        if not cfg.causal or cfg.objective != "clm" or cfg.enc_layers > 0:
+            raise ValueError(
+                "serving engine requires a decoder-only causal LM (same "
+                "constraint as generation.generate)"
+            )
+        if prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        self.params = params
+        self.cfg = cfg
+        self.eos_id = int(eos_id)
+        self.pad_id = int(pad_id)
+        self.seed = int(seed)
+        self.result_timeout_s = float(result_timeout_s)
+        self.slots = SlotKVCache(cfg, num_slots, max_seq_len)
+        # a chunk longer than the slot would slice past the cache end
+        self.prefill_chunk = min(int(prefill_chunk), self.slots.max_seq_len)
+        self.scheduler = Scheduler(max_queue=max_queue, default_ttl_s=request_ttl_s)
+        self.counters = Counters(
+            "steps", "prefill_chunks", "prefill_tokens", "tokens_generated"
+        )
+        self.ttft = QuantileWindow(512)
+        self._last_logits = np.zeros(
+            (self.slots.num_slots, cfg.vocab_size), np.float32
+        )
+        self._by_slot: Dict[int, Request] = {}
+        self._rng: Dict[int, np.random.Generator] = {}
+        self._busy_s = 0.0
+        self._last_step_tps = 0.0
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, name="serving-engine", daemon=True
+        )
+        if start_loop:
+            self._thread.start()
+
+    # -- client side --------------------------------------------------------
+
+    def submit(self, tokens: Sequence[int], max_new_tokens: int,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
+               ttl_s: Optional[float] = None) -> Future:
+        """Enqueue one request; the Future resolves to the full token list
+        (prompt + completion, eos excluded — ``generate_np`` row semantics).
+        Raises ``QueueFull`` on backpressure; the Future fails with
+        ``RequestExpired`` if the request out-waits its TTL in queue."""
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise ValueError("empty prompt")
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 0:
+            raise ValueError("max_new_tokens must be >= 0")
+        if not self.slots.fits(len(tokens), max_new_tokens):
+            raise ValueError(
+                f"prompt ({len(tokens)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the engine's slot capacity {self.slots.max_seq_len}"
+            )
+        req = Request(
+            tokens=tokens, max_new_tokens=max_new_tokens,
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p),
+        )
+        if max_new_tokens == 0:
+            req.future.set_result(list(tokens))
+            return req.future
+        self.scheduler.submit(req, ttl_s=ttl_s)
+        with self._cond:
+            self._cond.notify()
+        return req.future
+
+    def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
+                 **kw) -> List[List[int]]:
+        """Synchronous convenience over ``submit`` (bench/tests): submits all
+        prompts at once so they overlap, then gathers in order."""
+        futures = [self.submit(p, max_new_tokens, **kw) for p in prompts]
+        return [f.result(timeout=self.result_timeout_s) for f in futures]
+
+    def stats(self) -> dict:
+        sc = self.scheduler.counters.snapshot()
+        ec = self.counters.snapshot()
+        ttft = self.ttft.summary()
+        tokens = ec["tokens_generated"]
+        busy = self._busy_s
+        return {
+            "queue_depth": self.scheduler.depth,
+            "queue_capacity": self.scheduler.max_queue,
+            "queue_saturated": self.scheduler.saturated,
+            "active_slots": self.slots.active_count,
+            "num_slots": self.slots.num_slots,
+            "occupancy": round(self.slots.occupancy, 4),
+            "steps": ec["steps"],
+            "prefill_chunks": ec["prefill_chunks"],
+            "prefill_tokens": ec["prefill_tokens"],
+            "tokens_generated": tokens,
+            "tokens_per_s": round(tokens / busy, 3) if busy > 0 else 0.0,
+            "tokens_per_s_last_step": round(self._last_step_tps, 3),
+            "ttft_p50_s": ttft["p50"],
+            "ttft_p95_s": ttft["p95"],
+            "submitted": sc["submitted"],
+            "admitted": sc["admitted"],
+            "completed": sc["completed"],
+            "failed": sc["failed"],
+            "rejected_queue_full": sc["rejected_queue_full"],
+            "expired": sc["expired"],
+        }
+
+    def reset_metrics(self) -> None:
+        """Zero counters/TTFT/throughput accounting (bench: drop warmup
+        compile time from the measured window). Call while idle."""
+        self.counters = Counters(
+            "steps", "prefill_chunks", "prefill_tokens", "tokens_generated"
+        )
+        self.scheduler.counters = Counters(
+            "submitted", "admitted", "completed", "failed",
+            "rejected_queue_full", "expired",
+        )
+        self.ttft = QuantileWindow(512)
+        self._busy_s = 0.0
+        self._last_step_tps = 0.0
+
+    def step_once(self) -> None:
+        """One scheduler+decode iteration, synchronously (tests and
+        ``start_loop=False`` callers — deterministic interleaving)."""
+        self._admit()
+        if self._by_slot:
+            self._step()
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread.is_alive():
+            self._thread.join(timeout=30)
+        self._fail_all(RuntimeError("engine shut down"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- engine loop (single thread owns cache + slots + jit calls) ---------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while (not self._stop and self.scheduler.empty()
+                       and not self._by_slot):
+                    # short timeout: TTLs must expire even with no wakeups
+                    self._cond.wait(timeout=0.05)
+                if self._stop:
+                    break
+            try:
+                self._admit()
+                if self._by_slot:
+                    self._step()
+            except Exception as e:  # noqa: BLE001 — engine must not die silently
+                self._fail_all(e)
+
+    def _admit(self) -> None:
+        """Admit queued requests into free slots (chunked prefill)."""
+        self.scheduler.expire()
+        while self.slots.free_slots > 0:
+            req = self.scheduler.pop()
+            if req is None:
+                return
+            if req.future.cancelled():  # abandoned while queued
+                continue
+            try:
+                self._prefill(req)
+            except Exception as e:  # noqa: BLE001 — fail the one request
+                self.scheduler.counters.inc("failed")
+                if req.slot is not None:
+                    self._by_slot.pop(req.slot, None)
+                    self._rng.pop(req.slot, None)
+                    self.slots.free(req.slot)
+                    req.slot = None
+                if not req.future.done():
+                    req.future.set_exception(e)
+
+    def _prefill(self, req: Request) -> None:
+        t0 = time.perf_counter()
+        slot = self.slots.alloc()
+        assert slot is not None
+        req.slot = slot
+        toks = np.asarray(req.tokens, np.int32)
+        c = self.prefill_chunk
+        smax = self.slots.max_seq_len
+        starts = list(range(0, len(toks), c))
+        if starts and starts[-1] + c > smax:
+            # the fixed-size window must not cross the slot end:
+            # dynamic_update_slice would CLAMP the start index, silently
+            # shifting the write over earlier positions. Slide the last
+            # window left instead — re-prefilling the overlap recomputes
+            # identical k/v (deterministic function of tokens + positions),
+            # so the rewrite is idempotent.
+            starts[-1] = smax - c
+        last_row = None
+        for start in starts:
+            chunk = toks[start:start + c]
+            n = len(chunk)
+            # fresh buffer per chunk: on CPU, jnp.asarray may alias the host
+            # memory and dispatch is async — mutating a shared buffer for the
+            # next chunk would corrupt the in-flight one's input
+            buf = np.full((1, c), self.pad_id, np.int32)
+            buf[0, :n] = chunk
+            logits, cache = _prefill_chunk(
+                self.params, self.cfg, self.slots.cache, jnp.asarray(buf),
+                np.int32(slot), np.int32(start),
+            )
+            self.slots.cache = cache
+            last_row = (logits, n - 1)
+            self.counters.inc("prefill_chunks")
+            self.counters.inc("prefill_tokens", n)
+        logits, idx = last_row
+        self._last_logits[slot] = np.asarray(logits[idx], np.float32)
+        self.slots.lengths[slot] = len(toks)
+        self._by_slot[slot] = req
+        self._rng[slot] = np.random.default_rng((self.seed, req.rid))
+        self._busy_s += time.perf_counter() - t0
+
+    def _step(self) -> None:
+        """One decode iteration: sample for every active slot from its last
+        logits, retire eos/budget-exhausted rows, then run ONE shared forward
+        for the survivors."""
+        t0 = time.perf_counter()
+        tokens = np.zeros((self.slots.num_slots,), np.int32)
+        offsets = np.zeros((self.slots.num_slots,), np.int32)
+        sampled = 0
+        appended = 0
+        retired: List[int] = []
+        for slot in self.slots.active_slots():
+            req = self._by_slot[slot]
+            tok = _sample_host(
+                self._rng[slot], self._last_logits[slot],
+                req.temperature, req.top_k, req.top_p,
+            )
+            sampled += 1
+            now = time.time()
+            if req.first_token_at is None:
+                req.first_token_at = now
+                self.ttft.add(now - req.submitted_at)
+            if self.eos_id >= 0 and tok == self.eos_id:
+                retired.append(slot)
+                continue
+            req.generated.append(tok)
+            appended += 1
+            if len(req.generated) >= req.max_new_tokens:
+                retired.append(slot)
+                continue
+            tokens[slot] = tok
+            offsets[slot] = self.slots.lengths[slot]
+            self.slots.lengths[slot] += 1
+        for slot in retired:
+            self._retire(slot)
+        still = self.slots.active_slots()
+        if still:
+            logits, cache = _decode_step(
+                self.params, self.cfg, self.slots.cache,
+                jnp.asarray(tokens), jnp.asarray(offsets),
+            )
+            self.slots.cache = cache
+            logits = np.asarray(logits)
+            for slot in still:
+                self._last_logits[slot] = logits[slot]
+        self.counters.inc("steps")
+        self.counters.inc("tokens_generated", appended)
+        dt = time.perf_counter() - t0
+        self._busy_s += dt
+        if dt > 0:
+            self._last_step_tps = sampled / dt
+
+    def _retire(self, slot: int) -> None:
+        req = self._by_slot.pop(slot)
+        self._rng.pop(slot, None)
+        self.slots.free(slot)
+        self.scheduler.counters.inc("completed")
+        if not req.future.done():
+            req.future.set_result(list(req.tokens) + req.generated)
+
+    def _fail_all(self, exc: Exception) -> None:
+        for slot in list(self._by_slot):
+            req = self._by_slot.pop(slot)
+            self._rng.pop(slot, None)
+            self.scheduler.counters.inc("failed")
+            if not req.future.done():
+                req.future.set_exception(exc)
+        self.slots.reset()
+        self.scheduler.drain(exc)

@@ -1,0 +1,95 @@
+"""Slot-managed persistent KV cache for the continuous-batching engine.
+
+One fixed-shape device cache — ``(L, num_slots, max_seq_len, kv_heads,
+head_dim)`` k and v — lives for the whole server lifetime; requests borrow a
+*slot* (one batch row) for their duration and return it on retirement
+(vLLM's PagedAttention manages blocks within a sequence; here the unit is
+the whole-sequence slot, which is what maps onto JAX's static-shape jit:
+every decode step sees the same array shapes, so the compiled program is
+reused forever — no per-request allocation, no recompiles).
+
+Host side this class is a tiny allocator: a free list plus per-slot
+offset/length bookkeeping. Device side it owns the ``KVCache`` pytree that
+the engine threads through its jitted prefill/decode calls. Slots are NOT
+zeroed on reuse — a new request's prefill writes positions ``[0, P)`` before
+any query can see them, and causal masking hides every position beyond a
+row's own write offset, so stale keys from the previous occupant are never
+attended.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from galvatron_tpu.models import generation
+from galvatron_tpu.models.modeling import ModelConfig
+
+
+class SlotKVCache:
+    """Fixed ``(num_slots, max_seq_len)`` KV cache + slot allocator."""
+
+    def __init__(self, cfg: ModelConfig, num_slots: int, max_seq_len: Optional[int] = None):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.cfg = cfg
+        self.num_slots = int(num_slots)
+        self.max_seq_len = int(min(max_seq_len or cfg.max_seq_len, cfg.max_seq_len))
+        # device arrays; reassigned by the engine after every jitted step
+        self.cache = generation.init_kv_cache(cfg, self.num_slots, self.max_seq_len)
+        # host bookkeeping: length = tokens materialized in the slot so far
+        # (prompt + generated); the next token lands at position == length
+        self.lengths = np.zeros((self.num_slots,), np.int32)
+        self._free: List[int] = list(range(self.num_slots - 1, -1, -1))
+        self._active: set = set()
+
+    # -- allocator ----------------------------------------------------------
+
+    def alloc(self) -> Optional[int]:
+        """Claim a free slot (length reset to 0); None when fully occupied."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._active.add(slot)
+        self.lengths[slot] = 0
+        return slot
+
+    def free(self, slot: int) -> None:
+        if slot not in self._active:
+            raise ValueError(f"slot {slot} is not active")
+        self._active.discard(slot)
+        self.lengths[slot] = 0
+        self._free.append(slot)
+
+    def reset(self) -> None:
+        """Release every slot and reallocate the device cache (engine
+        failure recovery / drain). The engine's jitted steps DONATE the
+        cache buffers — after a step that died mid-call the old arrays may
+        already be invalidated, so a fresh cache is the only safe state."""
+        self._active.clear()
+        self.lengths[:] = 0
+        self._free = list(range(self.num_slots - 1, -1, -1))
+        self.cache = generation.init_kv_cache(self.cfg, self.num_slots, self.max_seq_len)
+
+    # -- views --------------------------------------------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    def active_slots(self) -> List[int]:
+        return sorted(self._active)
+
+    @property
+    def occupancy(self) -> float:
+        return len(self._active) / self.num_slots
+
+    def fits(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """Whole lifetime of the request stays inside the slot: the last
+        generated token sits at position prompt_len + max_new_tokens - 1."""
+        return prompt_len >= 1 and prompt_len + max_new_tokens <= self.max_seq_len

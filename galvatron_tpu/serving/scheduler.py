@@ -1,0 +1,146 @@
+"""Iteration-level request scheduler (Orca, OSDI '22).
+
+Requests enter a FIFO admission queue with a per-request deadline (TTL);
+the engine loop admits the head of the queue whenever a KV slot frees up and
+retires sequences the moment they hit eos or their token budget — admission
+and retirement happen at *decode-step* granularity, between iterations of
+one shared forward pass, never by preempting a running step.
+
+Backpressure is explicit and accounted: a bounded queue rejects new work
+immediately (``QueueFull`` → HTTP 503) instead of parking threads, and a
+request that waits in queue past its deadline is expired with
+``RequestExpired`` (→ 503) rather than eventually hogging a slot the live
+traffic needs.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional
+
+from galvatron_tpu.utils.metrics import Counters
+
+
+class QueueFull(RuntimeError):
+    """Admission queue at capacity — reject fast, client should back off."""
+
+
+class RequestExpired(RuntimeError):
+    """Request spent longer than its TTL waiting in the admission queue."""
+
+
+_rid = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request moving through queue → slot → retirement."""
+
+    tokens: List[int]                 # prompt token ids
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    deadline: Optional[float] = None  # absolute time() the queue wait may last
+    rid: int = field(default_factory=lambda: next(_rid))
+    future: Future = field(default_factory=Future)
+    submitted_at: float = field(default_factory=time.time)
+    # engine-managed state
+    slot: Optional[int] = None
+    generated: List[int] = field(default_factory=list)
+    first_token_at: Optional[float] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.tokens)
+
+
+class Scheduler:
+    """FIFO admission queue with TTL expiry and bounded depth."""
+
+    def __init__(self, max_queue: int = 64, default_ttl_s: Optional[float] = 30.0):
+        self.max_queue = max(1, int(max_queue))
+        self.default_ttl_s = default_ttl_s
+        self._q: Deque[Request] = deque()
+        self._lock = threading.Lock()
+        self.counters = Counters(
+            "submitted", "admitted", "completed", "failed",
+            "rejected_queue_full", "expired",
+        )
+
+    def submit(self, req: Request, ttl_s: Optional[float] = None) -> Request:
+        """Enqueue or raise ``QueueFull``. ``ttl_s`` overrides the default
+        TTL; None with no default means the request never expires."""
+        ttl = self.default_ttl_s if ttl_s is None else ttl_s
+        if ttl is not None and req.deadline is None:
+            req.deadline = req.submitted_at + float(ttl)
+        with self._lock:
+            if len(self._q) >= self.max_queue:
+                self.counters.inc("rejected_queue_full")
+                raise QueueFull(
+                    f"admission queue full ({self.max_queue} pending)"
+                )
+            self._q.append(req)
+        self.counters.inc("submitted")
+        return req
+
+    def expire(self, now: Optional[float] = None) -> List[Request]:
+        """Drop every queued request past its deadline, failing its future.
+        Called by the engine loop each iteration — a saturated server sheds
+        dead-on-arrival work instead of eventually generating for it."""
+        now = time.time() if now is None else now
+        dropped: List[Request] = []
+        with self._lock:
+            keep: Deque[Request] = deque()
+            for r in self._q:
+                if r.deadline is not None and now > r.deadline:
+                    dropped.append(r)
+                else:
+                    keep.append(r)
+            self._q = keep
+        for r in dropped:
+            self.counters.inc("expired")
+            if not r.future.done():  # client may have cancelled already
+                r.future.set_exception(RequestExpired(
+                    f"request {r.rid} expired after "
+                    f"{now - r.submitted_at:.2f}s in queue"
+                ))
+        return dropped
+
+    def pop(self, now: Optional[float] = None) -> Optional[Request]:
+        """Next admissible request (expired ones already shed), or None."""
+        self.expire(now)
+        with self._lock:
+            if not self._q:
+                return None
+            req = self._q.popleft()
+        self.counters.inc("admitted")
+        return req
+
+    def drain(self, exc: Exception) -> List[Request]:
+        """Fail every queued request (engine shutdown)."""
+        with self._lock:
+            dropped = list(self._q)
+            self._q.clear()
+        for r in dropped:
+            self.counters.inc("failed")
+            if not r.future.done():
+                r.future.set_exception(exc)
+        return dropped
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    @property
+    def saturated(self) -> bool:
+        return self.depth >= self.max_queue
+
+    def empty(self) -> bool:
+        return self.depth == 0

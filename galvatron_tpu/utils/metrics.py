@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -61,3 +62,59 @@ class MetricsLogger:
 def read_metrics(path: str):
     with open(path) as f:
         return [json.loads(line) for line in f if line.strip()]
+
+
+class Counters:
+    """Thread-safe named integer counters (serving: admitted/completed/
+    rejected/expired and the server's succeeded/failed/rejected split —
+    handler threads and the engine loop increment concurrently)."""
+
+    def __init__(self, *names: str):
+        self._lock = threading.Lock()
+        self._c: Dict[str, int] = {n: 0 for n in names}
+
+    def inc(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + n
+            return self._c[name]
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._c.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._c)
+
+
+class QuantileWindow:
+    """Fixed-size ring of float samples with quantile readout (serving:
+    time-to-first-token p50/p95 over the last N requests). O(size) memory,
+    sorting only at read time — add() stays cheap on the engine hot loop."""
+
+    def __init__(self, size: int = 512):
+        self.size = max(1, size)
+        self._buf: list = []
+        self._i = 0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def add(self, x: float) -> None:
+        with self._lock:
+            if len(self._buf) < self.size:
+                self._buf.append(float(x))
+            else:
+                self._buf[self._i] = float(x)
+            self._i = (self._i + 1) % self.size
+            self._n += 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            buf = sorted(self._buf)
+        if not buf:
+            return None
+        idx = min(len(buf) - 1, max(0, int(round(q * (len(buf) - 1)))))
+        return buf[idx]
+
+    def summary(self) -> Dict[str, Any]:
+        return {"n": self._n, "p50": self.quantile(0.5), "p95": self.quantile(0.95)}

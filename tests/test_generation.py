@@ -86,6 +86,55 @@ def test_top_k_top_p_filters():
     assert len(seen) > 1
 
 
+def test_top_p_one_keeps_full_distribution():
+    """top_p=1.0 must be a no-op filter: every token stays in the nucleus,
+    so the draw equals the unfiltered draw for the same key."""
+    logits = jnp.asarray([[2.0, -1.0, 0.5, 0.0, -3.0]])
+    for seed in range(16):
+        key = jax.random.key(seed)
+        with_p = generation.sample_logits(key, logits, temperature=1.0, top_p=1.0)
+        without = generation.sample_logits(key, logits, temperature=1.0)
+        assert int(with_p[0]) == int(without[0])
+    # unfiltered temperature sampling reaches the whole support
+    seen = {int(generation.sample_logits(jax.random.key(s), logits,
+                                         temperature=5.0, top_p=1.0)[0])
+            for s in range(256)}
+    assert seen == set(range(5))
+
+
+def test_top_k_one_is_greedy_at_any_temperature():
+    logits = jnp.asarray([[1.0, 4.0, 2.0, 3.0]])
+    greedy = generation.sample_logits(jax.random.key(0), logits, temperature=0.0)
+    for seed in range(8):
+        for temp in (0.5, 1.0, 10.0):
+            t = generation.sample_logits(jax.random.key(seed), logits,
+                                         temperature=temp, top_k=1)
+            assert int(t[0]) == int(greedy[0]) == 1
+
+
+def test_traced_sampling_params_do_not_recompile():
+    """temperature/top_p are traced operands of the jitted generate: sweeping
+    them must hit the jit cache, not grow it (a serving engine sweeping
+    per-request params would otherwise compile per value)."""
+    cfg = CFG
+    params = modeling.init_model_params(jax.random.key(0), cfg)
+    prompt = [1, 2, 3, 4, 5]
+    kw = dict(max_new_tokens=3, top_k=2)
+    generation.generate_np(params, cfg, [prompt], temperature=0.5, top_p=0.5, **kw)
+    n0 = generation.generate._cache_size()
+    for temp, top_p in [(0.1, 0.3), (0.9, 0.95), (2.0, 0.5), (0.7, 0.2)]:
+        generation.generate_np(params, cfg, [prompt], temperature=temp,
+                               top_p=top_p, **kw)
+    assert generation.generate._cache_size() == n0
+    # the greedy/no-nucleus program is a second entry (use_top_p is static),
+    # but sweeping temperature within it stays flat too
+    generation.generate_np(params, cfg, [prompt], temperature=0.5, **kw)
+    n1 = generation.generate._cache_size()
+    for temp in (0.0, 0.3, 1.5):
+        generation.generate_np(params, cfg, [prompt], temperature=temp, **kw)
+    assert generation.generate._cache_size() == n1
+
+
 def test_dataloader_start_batch_equivalence():
     from galvatron_tpu.core.dataloader import RandomTokenDataset
 

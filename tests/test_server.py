@@ -193,7 +193,12 @@ def test_server_busy_returns_503():
             with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/healthz", timeout=30
             ) as r:
-                assert json.loads(r.read())["status"] == "ok"
+                health = json.loads(r.read())
+            assert health["status"] == "ok"
+            # the 503-storm is visible from the probe, not just client-side
+            assert health["gate"]["saturated"] and health["gate"]["in_use"] == 1
+            assert health["gate"]["rejected"] >= 1
+            assert health["requests"]["rejected"] >= 1
         finally:
             svc.lock.release()
             # unwedged: the parked occupier's generation completes and its
