@@ -100,6 +100,15 @@ def _add_training_args(p: argparse.ArgumentParser):
         help="0 = off, 1 = full-layer remat, 2 = selective (attention-core-only "
         "recompute; reference: Megatron --recompute-granularity selective)",
     )
+    g.add_argument(
+        "--mlp_recompute", type=str, default="policy",
+        choices=["off", "gate", "policy"],
+        help="activation-memory recompute over the MLP/norm/loss regions "
+        "(DESIGN.md 'Activation memory accounting'): 'policy' saves the "
+        "swiglu/gelu gate exactly once per layer and rematerializes the "
+        "fp32-widened norm/cross-entropy buffers; 'gate' remats only the "
+        "activation product; 'off' restores the pre-policy behaviour",
+    )
     g.add_argument("--sequence_parallel", type=int, default=0)
     g.add_argument("--context_parallel_deg", type=int, default=1)
     g.add_argument("--context_parallel_impl", type=str, default="ring",
@@ -390,6 +399,7 @@ def hybrid_config_from_args(ns: argparse.Namespace, num_layers: int, world: int)
             vocab_tp=ns.vocab_tp,
             embed_dp_type="zero3" if ns.embed_sdp else "ddp",
             mixed_precision=ns.mixed_precision,
+            mlp_recompute=getattr(ns, "mlp_recompute", "policy"),
         )
         if getattr(ns, "pp_division", None):
             hp.pp_division = ns.pp_division

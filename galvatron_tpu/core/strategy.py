@@ -136,10 +136,20 @@ class HybridParallelConfig:
     # scaling with skip-on-overflow; reference: megatron grad_scaler.py)
     mixed_precision: str = "bf16"
     default_dp_type: str = "ddp"
+    # activation-memory recompute over the MLP/norm/loss regions
+    # (modeling.ModelConfig.mlp_recompute; DESIGN.md "Activation memory
+    # accounting"): 'policy' (default — one gate save per layer, fp32
+    # widenings rematerialized) | 'gate' (product-only remat) | 'off'
+    mlp_recompute: str = "policy"
 
     def __post_init__(self):
         if self.pipeline_type not in ("gpipe", "pipedream_flush"):
             raise ValueError(f"unknown pipeline_type {self.pipeline_type}")
+        if self.mlp_recompute not in ("off", "gate", "policy"):
+            raise ValueError(
+                f"mlp_recompute must be 'off', 'gate' or 'policy', got "
+                f"{self.mlp_recompute!r}"
+            )
         if self.pp_division is None and self.layer_strategies:
             self.pp_division = balanced_division(len(self.layer_strategies), self.pp)
 
@@ -238,6 +248,7 @@ class HybridParallelConfig:
             "embed_dp_type": self.embed_dp_type,
             "default_dp_type": self.default_dp_type,
             "mixed_precision": self.mixed_precision,
+            "mlp_recompute": self.mlp_recompute,
         }
 
     @classmethod
@@ -288,6 +299,7 @@ class HybridParallelConfig:
             embed_dp_type=d.get("embed_dp_type", "ddp"),
             default_dp_type=default_dp,
             mixed_precision=d.get("mixed_precision", "bf16"),
+            mlp_recompute=d.get("mlp_recompute", "policy"),
         )
 
     def save(self, path: str) -> None:

@@ -28,6 +28,7 @@ from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+from jax.ad_checkpoint import checkpoint_name
 
 from galvatron_tpu import compat
 import jax.numpy as jnp
@@ -139,6 +140,24 @@ class ModelConfig:
     # ops.flash_attention.flash_attention, which the head-major wiring
     # bypasses.
     flash_headmajor: bool = True
+    # Activation-memory recompute over the MLP/norm/loss regions
+    # (--mlp_recompute; DESIGN.md "Activation memory accounting"). The HLO
+    # buffer audit (BASELINE.md round 5) showed the backward holding TWO
+    # saved copies of the swiglu gate per layer plus fp32-widened (B, S, H)/
+    # vocab-shard copies of bf16 activations (norm statistics and the
+    # cross-entropy cast) — real HBM that caps feasible batch size.
+    #   'policy': jax.checkpoint over the norm+MLP residual branch with a
+    #     save_only_these_names('mlp_gate') policy — the gate projection
+    #     output is saved exactly once (compute dtype) and everything else
+    #     (the fp32 norm statistics, the silu·gate / gelu product) is
+    #     recomputed in the backward; standalone norms and the cross-entropy
+    #     fp32 cast are likewise rematerialized from their narrow inputs
+    #     (cast at the consumer, never saved widened). The default.
+    #   'gate': only the activation-product remat — the shape
+    #     experiments/swiglu_recompute_probe.py measured (one gate save,
+    #     fp32 widenings untouched).
+    #   'off': the pre-policy behaviour (double gate save + widened saves).
+    mlp_recompute: str = "policy"
 
     @property
     def kv_heads(self) -> int:
@@ -561,16 +580,7 @@ def model_annotations(cfg: ModelConfig) -> Params:
 # ---------------------------------------------------------------------------
 
 
-def norm(x, p, cfg: ModelConfig):
-    """RMSNorm / LayerNorm; Pallas fused kernel on TPU when cfg.fused_norm
-    (reference fused-norm CUDA ops: megatron fused_layer_norm / rms_norm,
-    flash-attn dropout_add_rms_norm — SURVEY §2.1)."""
-    if cfg.fused_norm:
-        from galvatron_tpu.ops import fused_norm
-
-        if cfg.norm_type == "rms":
-            return fused_norm.fused_rmsnorm(x, p["scale"], cfg.norm_eps)
-        return fused_norm.fused_layernorm(x, p["scale"], p["bias"], cfg.norm_eps)
+def _norm_impl(x, p, cfg: ModelConfig):
     dt = x.dtype
     x32 = x.astype(jnp.float32)
     if cfg.norm_type == "rms":
@@ -582,6 +592,26 @@ def norm(x, p, cfg: ModelConfig):
         out = (x32 - mu) * jax.lax.rsqrt(var + cfg.norm_eps)
         out = out * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
     return out.astype(dt)
+
+
+def norm(x, p, cfg: ModelConfig):
+    """RMSNorm / LayerNorm; Pallas fused kernel on TPU when cfg.fused_norm
+    (reference fused-norm CUDA ops: megatron fused_layer_norm / rms_norm,
+    flash-attn dropout_add_rms_norm — SURVEY §2.1).
+
+    Under ``mlp_recompute='policy'`` the fp32 statistics are rematerialized
+    in the backward from the compute-dtype input — without the wrap, autodiff
+    saves an fp32-widened (B, S, H) copy of every normed activation (the
+    round-5 HLO buffer audit's 67 MB/layer class)."""
+    if cfg.fused_norm:
+        from galvatron_tpu.ops import fused_norm
+
+        if cfg.norm_type == "rms":
+            return fused_norm.fused_rmsnorm(x, p["scale"], cfg.norm_eps)
+        return fused_norm.fused_layernorm(x, p["scale"], p["bias"], cfg.norm_eps)
+    if cfg.mlp_recompute == "policy":
+        return jax.checkpoint(lambda x_, p_: _norm_impl(x_, p_, cfg))(x, p)
+    return _norm_impl(x, p, cfg)
 
 
 def rope_tables(cfg: ModelConfig, seq_len: int, offset: int = 0):
@@ -913,7 +943,12 @@ def mlp_block(x, p, cfg: ModelConfig, train: bool = True):
     """SwiGLU or GeLU MLP (reference: ParallelMLP, galvatron/core/
     tensor_parallel/transformer.py:78-159); switch-MoE when moe_experts > 0
     (SwitchMLP, transformer.py:161-295). ``train`` only affects MoE routing
-    (sinkhorn-balanced vs raw-argmax)."""
+    (sinkhorn-balanced vs raw-argmax).
+
+    The gate/up projection output is checkpoint-named 'mlp_gate': under the
+    mlp_residual saveable policy it is the ONE saved residual of the MLP
+    branch — the activation product feeding w2 is recomputed in the backward
+    instead of being saved as a second full-width copy."""
     if cfg.moe_experts > 0:
         from galvatron_tpu.models import moe
 
@@ -925,18 +960,57 @@ def mlp_block(x, p, cfg: ModelConfig, train: bool = True):
         g = x @ p["w13"].astype(x.dtype)
         if "w13_b" in p:
             g = g + p["w13_b"].astype(x.dtype)
-        y = (jax.nn.silu(g[..., :f]) * g[..., f:]) @ p["w2"].astype(x.dtype)
+        g = checkpoint_name(g, "mlp_gate")
+        prod = lambda g_: jax.nn.silu(g_[..., :f]) * g_[..., f:]
+        if cfg.mlp_recompute == "gate" or (
+            cfg.mlp_recompute == "policy" and cfg.fused_norm
+        ):
+            # 'policy' with fused_norm: mlp_residual skips the policy region
+            # (the fused kernels carry custom-VJP residuals it cannot
+            # reach), so the one-gate-save guarantee falls back to the
+            # product-only remat here
+            prod = jax.checkpoint(prod)
+        y = prod(g) @ p["w2"].astype(x.dtype)
     else:
         g = x @ p["w1"].astype(x.dtype)
         if "w1_b" in p:
             g = g + p["w1_b"].astype(x.dtype)
+        g = checkpoint_name(g, "mlp_gate")
         act = jax.nn.relu if cfg.act_fn == "relu" else partial(
             jax.nn.gelu, approximate=True
         )
+        if cfg.mlp_recompute == "gate" or (
+            cfg.mlp_recompute == "policy" and cfg.fused_norm
+        ):
+            act = jax.checkpoint(act)
         y = act(g) @ p["w2"].astype(x.dtype)
     if "w2_b" in p:
         y = y + p["w2_b"].astype(x.dtype)
     return y
+
+
+def mlp_residual(x, p, cfg: ModelConfig, train: bool = True):
+    """x + MLP(norm(x)) — the per-layer MLP residual branch, with the
+    activation-memory saveable policy applied when cfg.mlp_recompute ==
+    'policy': jax.checkpoint over the norm+MLP region saving ONLY the
+    'mlp_gate'-named projection output, so (a) the gate is saved exactly once
+    per layer (the probe's jax.checkpoint(silu·gate) shape, now reaching the
+    norm too) and (b) no fp32-widened copies of the bf16 residual stream
+    survive into the backward — the fp32 norm statistics are recomputed from
+    the saved compute-dtype layer input. MoE layers fall back to the plain
+    branch (dispatch buffers carry their own sharding pins; the router is
+    deterministic but its recompute under a policy region is unvalidated)."""
+    if cfg.mlp_recompute == "policy" and cfg.moe_experts == 0 and not cfg.fused_norm:
+        # _norm_impl, not norm: the policy region already remats everything
+        # unnamed — a nested per-norm checkpoint would only add bookkeeping.
+        # fused_norm layers keep the plain branch (the Pallas kernels carry
+        # their own custom-VJP residuals the policy cannot reach).
+        branch = jax.checkpoint(
+            lambda x_, pn_, pm_: mlp_block(_norm_impl(x_, pn_, cfg), pm_, cfg, train=train),
+            policy=jax.checkpoint_policies.save_only_these_names("mlp_gate"),
+        )
+        return x + branch(x, p["mlp_norm"], p["mlp"])
+    return x + mlp_block(norm(x, p["mlp_norm"], cfg), p["mlp"], cfg, train=train)
 
 
 def cross_attn_block(x, enc_out, p, cfg: ModelConfig):
@@ -962,8 +1036,7 @@ def encoder_layer(x, p, cfg: ModelConfig, cos_sin=None, remat_attn: bool = False
     x = x + attn_block(
         norm(x, p["attn_norm"], cfg), p["attn"], ecfg, cos_sin, None, remat_attn=remat_attn
     )
-    x = x + mlp_block(norm(x, p["mlp_norm"], cfg), p["mlp"], cfg)
-    return x
+    return mlp_residual(x, p, cfg)
 
 
 def decoder_layer(
@@ -974,8 +1047,7 @@ def decoder_layer(
     )
     if enc_out is not None and "cross" in p:
         x = x + cross_attn_block(norm(x, p["cross_norm"], cfg), enc_out, p["cross"], cfg)
-    x = x + mlp_block(norm(x, p["mlp_norm"], cfg), p["mlp"], cfg)
-    return x
+    return mlp_residual(x, p, cfg)
 
 
 def embed(tokens, params, cfg: ModelConfig):
@@ -1125,8 +1197,7 @@ def swin_layer(x, p, cfg: ModelConfig, i: int, remat_attn: bool = False):
     if remat_attn:
         attn = jax.checkpoint(attn)
     x = x + attn(norm(x, p["attn_norm"], lcfg))
-    x = x + mlp_block(norm(x, p["mlp_norm"], lcfg), p["mlp"], lcfg)
-    return x
+    return mlp_residual(x, p, lcfg)
 
 
 def patch_merge(x, p, cfg: ModelConfig, stage: int):
@@ -1181,13 +1252,10 @@ def cls_loss_sum(params, batch, cfg: ModelConfig, layer_hook=None):
     batch contract: row = pixels ‖ label."""
     pixels, labels = split_batch(batch, cfg)
     logits = forward_vision(params, pixels, cfg, layer_hook=layer_hook)
-    return cross_entropy_sum(logits, labels)
+    return cross_entropy_sum(logits, labels, remat=ce_remat(cfg))
 
 
-def cross_entropy_sum(logits, labels, ignore_index: int = -100):
-    """(nll_sum, valid_token_count) in fp32 — the accumulation-safe form:
-    micro-batch sums combine exactly into the global token-mean even when
-    ignore_index masks are unevenly distributed across chunks."""
+def _cross_entropy_sum_impl(logits, labels, ignore_index: int = -100):
     logits = logits.astype(jnp.float32)
     mask = labels != ignore_index
     safe = jnp.where(mask, labels, 0)
@@ -1195,6 +1263,22 @@ def cross_entropy_sum(logits, labels, ignore_index: int = -100):
     picked = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
     nll = (lse - picked) * mask
     return nll.sum(), mask.sum()
+
+
+def cross_entropy_sum(logits, labels, ignore_index: int = -100, remat: bool = False):
+    """(nll_sum, valid_token_count) in fp32 — the accumulation-safe form:
+    micro-batch sums combine exactly into the global token-mean even when
+    ignore_index masks are unevenly distributed across chunks.
+
+    ``remat``: rematerialize the fp32 cast / log-sum-exp in the backward from
+    the compute-dtype logits instead of letting autodiff save the fp32-widened
+    (B, S, V/vocab_tp) copy — the "cast at the consumer" rule; loss-carrying
+    callers pass ``cfg.mlp_recompute == 'policy'``."""
+    if remat:
+        return jax.checkpoint(
+            partial(_cross_entropy_sum_impl, ignore_index=ignore_index)
+        )(logits, labels)
+    return _cross_entropy_sum_impl(logits, labels, ignore_index)
 
 
 def cross_entropy_loss(logits, labels, ignore_index: int = -100):
@@ -1226,7 +1310,7 @@ def mlm_loss_sum(params, batch, cfg: ModelConfig, layer_hook=None):
     [MASK]; only masked positions contribute loss."""
     inputs, labels = split_batch(batch, cfg)
     logits = forward(params, inputs, cfg, layer_hook=layer_hook)
-    return cross_entropy_sum(logits, labels)
+    return cross_entropy_sum(logits, labels, remat=ce_remat(cfg))
 
 
 def split_batch(batch, cfg: ModelConfig):
@@ -1250,12 +1334,18 @@ def embed_any(inputs, params, cfg: ModelConfig):
     return embed(inputs, params, cfg)
 
 
+def ce_remat(cfg: ModelConfig) -> bool:
+    """Whether loss paths should rematerialize the cross-entropy fp32 cast
+    (one rule for the GSPMD path and every pipeline engine's head seam)."""
+    return cfg.mlp_recompute == "policy"
+
+
 def head_loss_sum(y, params, labels, cfg: ModelConfig):
     """Final-norm'd features (B, S, H) → (nll_sum, count): LM head + token
     cross entropy, or pooled classification head + class cross entropy."""
     if cfg.objective == "cls":
-        return cross_entropy_sum(cls_head(y, params, cfg), labels)
-    return cross_entropy_sum(lm_head(y, params, cfg), labels)
+        return cross_entropy_sum(cls_head(y, params, cfg), labels, remat=ce_remat(cfg))
+    return cross_entropy_sum(lm_head(y, params, cfg), labels, remat=ce_remat(cfg))
 
 
 def loss_tokens_per_sample(cfg: ModelConfig, seq_len: int) -> int:
@@ -1285,11 +1375,11 @@ def lm_loss_sum(params, batch, cfg: ModelConfig, layer_hook=None):
         enc_tokens = batch[:, : cfg.enc_seq]
         dec = batch[:, cfg.enc_seq :]
         logits = forward_encdec(params, enc_tokens, dec[:, :-1], cfg, layer_hook=layer_hook)
-        return cross_entropy_sum(logits, dec[:, 1:])
+        return cross_entropy_sum(logits, dec[:, 1:], remat=ce_remat(cfg))
     tokens = batch[:, :-1]
     labels = batch[:, 1:]
     logits = forward(params, tokens, cfg, layer_hook=layer_hook)
-    return cross_entropy_sum(logits, labels)
+    return cross_entropy_sum(logits, labels, remat=ce_remat(cfg))
 
 
 def lm_loss(params, batch, cfg: ModelConfig, layer_hook=None):

@@ -179,8 +179,12 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
         s = hp.layer_strategies[i]
         x = constrain(x, mesh, activation_spec(axes, s))
         layer_cfg = cfg
+        if s.ckpt == "full" and cfg.mlp_recompute != "off":
+            # full-layer remat saves only the layer boundary — a nested
+            # gate-save policy inside the remat region is pure overhead
+            layer_cfg = layer_cfg.replace(mlp_recompute="off")
         if s.cp > 1 and s.cp_impl == "ring":
-            layer_cfg = cfg.replace(attn_impl="ring")
+            layer_cfg = layer_cfg.replace(attn_impl="ring")
         if cfg.moe_experts > 0 and s.ep > 1:
             layer_cfg = layer_cfg.replace(
                 moe_shard_ctx=(
@@ -289,6 +293,11 @@ def build_runtime(
             raise ValueError("context parallelism is not supported for enc-dec models")
     seq_len = seq_len or cfg.sample_len
 
+    # the strategy's activation-recompute mode rides the model config so
+    # every execution path (GSPMD hook, all pipeline engines, the head/loss
+    # seams) sees the same policy
+    if cfg.mlp_recompute != hp.mlp_recompute:
+        cfg = cfg.replace(mlp_recompute=hp.mlp_recompute)
     if cfg.dtype != jnp.float32 and hp.mixed_precision == "fp32":
         cfg = cfg.replace(dtype=jnp.float32)
     if hp.mixed_precision == "bf16" and cfg.dtype == jnp.float32:

@@ -363,8 +363,12 @@ def make_block_fn(
         for j, s in enumerate(strategies):
             x = constrain(x, mesh, act_spec(s))
             layer_cfg = cfg
+            if s.ckpt == "full" and cfg.mlp_recompute != "off":
+                # full-layer remat subsumes the gate-save policy — same rule
+                # as the pp=1 hook (hybrid._make_layer_hook)
+                layer_cfg = layer_cfg.replace(mlp_recompute="off")
             if cfg.moe_experts > 0 and s.ep > 1:
-                layer_cfg = cfg.replace(
+                layer_cfg = layer_cfg.replace(
                     moe_shard_ctx=(
                         mesh,
                         axes.ep_axes(s.tp, s.tp_consec, s.ep),

@@ -55,14 +55,16 @@ def _head_loss(head_sub, y, labels, cfg: ModelConfig):
     prepared by modeling.split_batch; 'cls' pools and classifies)."""
     y = modeling.norm(y, head_sub["final_norm"], cfg)
     if cfg.objective == "cls":
-        s, n = modeling.cross_entropy_sum(modeling.cls_head(y, head_sub, cfg), labels)
+        s, n = modeling.cross_entropy_sum(
+            modeling.cls_head(y, head_sub, cfg), labels, remat=modeling.ce_remat(cfg)
+        )
         return s, n.astype(jnp.float32)
     if cfg.tie_word_embeddings:
         w = head_sub["embed"]["tok"].astype(y.dtype).T
     else:
         w = head_sub["head"]["w"].astype(y.dtype)
     logits = y @ w
-    s, n = modeling.cross_entropy_sum(logits, labels)
+    s, n = modeling.cross_entropy_sum(logits, labels, remat=modeling.ce_remat(cfg))
     return s, n.astype(jnp.float32)
 
 

@@ -323,6 +323,9 @@ def _make_section_fns(cfg: ModelConfig, hp: HybridParallelConfig, mesh, axes):
         for q, s in enumerate(enc_pos):
             x = constrain(x, mesh, act_spec(s))
             lcfg = with_flash_shard_ctx(cfg, s, mesh, axes)
+            if s.ckpt == "full" and lcfg.mlp_recompute != "off":
+                # full-layer remat subsumes the gate-save policy
+                lcfg = lcfg.replace(mlp_recompute="off")
             run = lambda x_, lp_, lcfg=lcfg: modeling.encoder_layer(
                 x_, lp_, lcfg, cos_e, remat_attn=(s.ckpt == "selective")
             )
@@ -342,6 +345,8 @@ def _make_section_fns(cfg: ModelConfig, hp: HybridParallelConfig, mesh, axes):
         for q, s in enumerate(dec_pos):
             x = constrain(x, mesh, act_spec(s))
             lcfg = with_flash_shard_ctx(cfg, s, mesh, axes)
+            if s.ckpt == "full" and lcfg.mlp_recompute != "off":
+                lcfg = lcfg.replace(mlp_recompute="off")
             run = lambda x_, lp_, lcfg=lcfg: modeling.decoder_layer(
                 x_, lp_, lcfg, cos_d, None,
                 remat_attn=(s.ckpt == "selective"), enc_out=ctx,
@@ -465,7 +470,7 @@ def build_encdec_pipeline_runtime(
         y = constrain(y, mesh, full_spec)
         y = modeling.norm(y, params["final_norm"], cfg)
         logits = modeling.lm_head(y, params, cfg)
-        ssum, n = modeling.cross_entropy_sum(logits, labels)
+        ssum, n = modeling.cross_entropy_sum(logits, labels, remat=modeling.ce_remat(cfg))
         return ssum / jnp.maximum(n, 1)
 
     fp16 = hp.mixed_precision == "fp16"

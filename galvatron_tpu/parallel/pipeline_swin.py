@@ -284,13 +284,19 @@ def build_swin_pipeline_runtime(
             )
             for q, s in enumerate(lay.pos[k]):
                 x = constrain(x, mesh, act_spec(s))
+                # full-layer remat subsumes the gate-save policy
+                lcfg = (
+                    cfg.replace(mlp_recompute="off")
+                    if s.ckpt == "full" and cfg.mlp_recompute != "off"
+                    else cfg
+                )
 
-                def pair(x_, pp_):
+                def pair(x_, pp_, lcfg=lcfg):
                     y = modeling.swin_layer(
-                        x_, pp_["a"], cfg, i0, remat_attn=(s.ckpt == "selective")
+                        x_, pp_["a"], lcfg, i0, remat_attn=(s.ckpt == "selective")
                     )
                     return modeling.swin_layer(
-                        y, pp_["b"], cfg, i0 + 1, remat_attn=(s.ckpt == "selective")
+                        y, pp_["b"], lcfg, i0 + 1, remat_attn=(s.ckpt == "selective")
                     )
 
                 if s.ckpt == "full":
@@ -363,7 +369,9 @@ def build_swin_pipeline_runtime(
         y = ys[-1].reshape(global_batch_size, sec_len[K - 1], sec_c[K - 1])
         y = constrain(y, mesh, full_spec)
         y = modeling.norm(y, params["final_norm"], cfg)
-        ssum, n = modeling.cross_entropy_sum(modeling.cls_head(y, params, cfg), labels)
+        ssum, n = modeling.cross_entropy_sum(
+            modeling.cls_head(y, params, cfg), labels, remat=modeling.ce_remat(cfg)
+        )
         return ssum / jnp.maximum(n, 1)
 
     fp16 = hp.mixed_precision == "fp16"

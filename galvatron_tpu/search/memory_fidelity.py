@@ -93,6 +93,7 @@ def predicted_train_mb(
         pf = single_1f1b_rings_mb(
             lt, hp.layer_strategies[0], world, pp, global_bsz, hp.chunks,
             hp.mixed_precision, vpp=max(1, hp.vpp),
+            layers_per_device=max(div),
         )
     trans = transient_overhead_mb(
         costs, min(s.tp for s in hp.layer_strategies), hp.mixed_precision

@@ -197,11 +197,12 @@ class SearchEngine:
 
     def _1f1b_rings_mb(
         self, lt: ProfiledLayerType, s: LayerStrategy, world: int, pp: int,
-        global_bsz: int, chunks: int, vpp: int = 1,
+        global_bsz: int, chunks: int, vpp: int = 1, layers_per_device: int = 1,
     ) -> float:
         """See cost_model.single_1f1b_rings_mb (the one shared pricing)."""
         return single_1f1b_rings_mb(
-            lt, s, world, pp, global_bsz, chunks, self.mp, vpp=vpp
+            lt, s, world, pp, global_bsz, chunks, self.mp, vpp=vpp,
+            layers_per_device=layers_per_device,
         )
 
     def _layer_type(self, i: int) -> ProfiledLayerType:
@@ -463,7 +464,8 @@ class SearchEngine:
                 )
                 if single_ring:
                     total_mb += self._1f1b_rings_mb(
-                        lt, s, world, pp, global_bsz, chunks, vpp=vpp
+                        lt, s, world, pp, global_bsz, chunks, vpp=vpp,
+                        layers_per_device=lps,
                     )
                 mem[j, k] = max(1, int(np.ceil(total_mb / self.unit)))
                 intra[j, k] = pos_layers * layer_time_cost(
@@ -478,11 +480,29 @@ class SearchEngine:
                     cands[a], cands[b], lt0, self.hw, world, pp, global_bsz, self.mp
                 )
 
+        # XLA SPMD-partitioner CHECK-crash exclusion (BASELINE.md round 5):
+        # pp>1 × pipedream_flush × tp>1 × sp=False × vocab_tp>1 reliably
+        # CHECK-crashes the partitioner (spmd_partitioner_util.cc:506) on
+        # real TPU — a compiler bug, attention-impl independent (sp=True,
+        # gpipe, or vocab_tp=1 all compile; tests/test_topology_aot.py pins
+        # the sp=True neighbour). Structural guard: vocab_tp>1 pairs only
+        # ever run the DP over the sp-safe candidate subset (tp=1 or
+        # sp=True), so NO flag combination — including --disable_sp 1 —
+        # can emit the uncompilable cell.
+        crash_guard = pp > 1 and pipeline_type == "pipedream_flush"
+        safe_idx = (
+            np.asarray(
+                [k for k, s in enumerate(cands) if s.tp == 1 or s.sp],
+                np.int64,
+            )
+            if crash_guard
+            else np.arange(S)
+        )
         # vocab/embedding strategy is a searched dimension (reference:
         # --vocab_tp / --embed_sdp, hybrid_parallel_config.py:141-179,
         # arguments.py:128-130): sweep (vocab_tp, embed_dp_type), re-running
         # the layer DP only when the remaining budget actually changes
-        dp_cache: Dict[int, tuple] = {}
+        dp_cache: Dict[tuple, tuple] = {}
         best = None  # (total_ms, res, mem_used, vt, et, other_mb)
         pairs = list(_vocab_strategy_pairs(world, pp))
         use_measured = self._vocab_use_measured()
@@ -517,6 +537,11 @@ class SearchEngine:
             self.costs, min(s.tp for s in cands), self.mp
         )
         for vt, et in pairs:
+            guarded = crash_guard and vt > 1 and len(safe_idx) < S
+            if guarded:
+                self._restrictions.add("spmd_crash_pp_1f1b_tp_no_sp_vocab_tp")
+                if len(safe_idx) == 0:
+                    continue  # e.g. --disable_sp with only tp>1 candidates
             other_mb = other_memory_cost(
                 self.costs, world, pp, vocab_tp=vt, embed_dp_type=et,
                 global_bsz=global_bsz, chunks=chunks, mixed_precision=self.mp,
@@ -525,9 +550,19 @@ class SearchEngine:
             if budget <= 0:
                 continue
             V = int(budget / self.unit)
-            if V not in dp_cache:
-                dp_cache[V] = run_dp(mem, intra, inter, V)
-            cost, res, mem_used = dp_cache[V]
+            key = (V, guarded)
+            if key not in dp_cache:
+                if guarded:
+                    c_, r_, m_ = run_dp(
+                        mem[:, safe_idx], intra[:, safe_idx],
+                        inter[np.ix_(safe_idx, safe_idx)], V,
+                    )
+                    # map subset choices back to full candidate indices
+                    r_ = np.where(r_ >= 0, safe_idx[np.clip(r_, 0, None)], -1)
+                    dp_cache[key] = (c_, r_, m_)
+                else:
+                    dp_cache[key] = run_dp(mem, intra, inter, V)
+            cost, res, mem_used = dp_cache[key]
             if not np.isfinite(cost) or (res < 0).any():
                 continue
             if pp > 1:

@@ -120,6 +120,11 @@ def layer_activation_mb_per_sample(
     attention path additionally saves the (n_heads, S, S) probs in fp32;
     flash saves only the (S, 1) LSE. TP divides the sharded intermediates;
     SP additionally shards the replicated residual/norm tensors.
+
+    Under ``cfg.mlp_recompute`` ('gate'/'policy', the default) the MLP
+    saves ONLY the gate/up projection output — the activation product is
+    recomputed in the backward (modeling.mlp_residual) — so the mlp term
+    drops by one ffn-wide save (swiglu 3→2, gelu/relu 2→1 ffn).
     """
     S = seq_len or cfg.max_seq_len
     h, n, kvn, hd = cfg.hidden_size, cfg.num_heads, cfg.kv_heads, cfg.head_dim
@@ -130,12 +135,14 @@ def layer_activation_mb_per_sample(
     # TP-sharded intermediates
     qkv = (n + 2 * kvn) * hd * b / tp
     ctx = n * hd * b / tp
+    recompute = getattr(cfg, "mlp_recompute", "policy") in ("gate", "policy")
     if cfg.moe_experts > 0:
-        mlp = 3 * cfg.ffn * b / tp  # per routed token (capacity ~1)
+        mlp = 3 * cfg.ffn * b / tp  # per routed token (capacity ~1); the
+        # recompute policy excludes MoE layers (modeling.mlp_residual)
     elif cfg.act_fn == "swiglu":
-        mlp = 3 * cfg.ffn * b / tp
+        mlp = (2 if recompute else 3) * cfg.ffn * b / tp
     else:
-        mlp = 2 * cfg.ffn * b / tp
+        mlp = (1 if recompute else 2) * cfg.ffn * b / tp
     per_token = repl + qkv + ctx + mlp
     total = per_token * S
     if cfg.attn_impl == "xla":

@@ -531,3 +531,53 @@ def test_sweep_searches_uneven_layer_counts_at_vpp1():
     r = eng.search([8], max_chunks=4)
     assert r is not None and r.config.pp == 2 and r.config.vpp == 1
     assert sorted(r.config.pp_division) == [1, 2]
+
+
+def _crash_cell(config):
+    """True if a config matches the XLA SPMD CHECK-crash cell (BASELINE.md
+    round 5): pp>1 × pipedream_flush × tp>1 × sp=False × vocab_tp>1."""
+    return (
+        config.pp > 1
+        and config.pipeline_type == "pipedream_flush"
+        and config.vocab_tp > 1
+        and any(s.tp > 1 and not s.sp for s in config.layer_strategies)
+    )
+
+
+def test_spmd_crash_cell_structurally_unreachable():
+    """NO flag combination may emit the pp>1 × pipedream_flush × tp>1 ×
+    sp=False × vocab_tp>1 cell — it CHECK-crashes the XLA SPMD partitioner
+    on real TPU (spmd_partitioner_util.cc:506). The sweep is exercised with
+    sp allowed, sp disabled (--disable_sp: the crash-prone corner, since
+    every tp>1 candidate then carries sp=False), and a tight budget that
+    pushes the DP toward tp>1 strategies; every emitted candidate is
+    checked, not just the winner."""
+    for allow_sp in (True, False):
+        for budget in (4000.0, 900.0):
+            eng = make_engine(budget, allow_sp=allow_sp, pp_choices=[1, 2])
+            results = eng.search_topk([8, 16], k=64, max_chunks=8)
+            for r in results:
+                assert not _crash_cell(r.config), (
+                    allow_sp, budget, r.config.to_json_dict(),
+                )
+            # 1F1B × vocab_tp>1 pairs were evaluated with tp>1/sp=False
+            # candidates present, so the standing exclusion must be reported
+            if results and any(
+                r.config.pp > 1 and r.config.pipeline_type == "pipedream_flush"
+                for r in results
+            ):
+                assert any(
+                    "spmd_crash_pp_1f1b_tp_no_sp_vocab_tp"
+                    in r.details.get("search_restrictions", [])
+                    for r in results
+                )
+
+
+def test_spmd_crash_guard_keeps_safe_vocab_tp_choices():
+    """The guard must NOT delete vocab_tp>1 wholesale: under 1F1B the sp-safe
+    candidate subset (tp=1 or tp>1+sp) still competes for vocab_tp>1, and a
+    vocab-parallel winner with sp'd tp layers remains emittable."""
+    eng = make_engine(4000.0, pp_choices=[2])
+    r = eng.evaluate(2, 16, 4, "pipedream_flush")
+    assert r is not None
+    assert not _crash_cell(r.config)
