@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test test-all bench dryrun clean
+.PHONY: all native test test-all bench dryrun lint check-plan clean
 
 all: native
 
@@ -20,6 +20,14 @@ test:
 # everything, including the @slow compile-bound matrices
 test-all:
 	$(PY) -m pytest tests/ -q -m ""
+
+# static analysis (docs/DESIGN.md § Static analysis): trace-hygiene linter
+# + plan checker over the checked-in strategy configs — the CI gate
+lint:
+	$(PY) -m galvatron_tpu.analysis.lint galvatron_tpu
+
+check-plan:
+	$(PY) -m galvatron_tpu.cli check-plan configs/strategies/*.json --strict 1
 
 # headline metric on the real chip — prints one JSON line
 bench:

@@ -1,0 +1,33 @@
+"""Static analysis for plans and trace hygiene.
+
+Two pillars (neither compiles anything):
+
+- **Plan checker** (``plan_check``): validates a hybrid-parallelism plan
+  (strategy JSON × ModelConfig × mesh topology) in milliseconds, emitting
+  stable ``GTA…`` diagnostics instead of the cryptic compiler abort or
+  silent memory blowout the runtime would otherwise produce minutes into
+  startup. Trainer startup and the search engine's emit path both run it
+  (fail-fast / self-check); ``python -m galvatron_tpu.cli check-plan``
+  exposes it for CI and checked-in configs.
+- **Trace-hygiene linter** (``lint``): AST rules for JAX footguns — host
+  syncs in hot loops, Python RNG under trace, mutation of a numpy buffer
+  after async dispatch (the exact serving-engine corruption bug class),
+  recompilation hazards. ``python -m galvatron_tpu.analysis.lint <paths>``.
+
+Plus ``recompile_guard`` (``guards``): a context manager generalizing the
+``generate._cache_size()`` test pins so tests and the serving engine can
+assert bounded jit-cache growth.
+"""
+
+from galvatron_tpu.analysis.diagnostics import Diagnostic, format_report
+from galvatron_tpu.analysis.guards import RecompileError, recompile_guard
+from galvatron_tpu.analysis.plan_check import PlanError, check_plan
+
+__all__ = [
+    "Diagnostic",
+    "PlanError",
+    "RecompileError",
+    "check_plan",
+    "format_report",
+    "recompile_guard",
+]

@@ -1,0 +1,65 @@
+"""Runtime trace-hygiene guards: assert bounded jit-cache growth.
+
+Generalizes the ``generate._cache_size()`` pins the generation/serving tests
+hand-roll: wrap a traffic window in ``recompile_guard`` and any jit-cache
+growth beyond the allowance raises ``RecompileError`` naming the function
+that recompiled. The serving engine arms one over its steady-state loop when
+``GALVATRON_RECOMPILE_GUARD=1`` (debug/CI), so an accidental shape or static
+arg leak fails loudly instead of silently compiling per request.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any, Dict, Sequence
+
+
+class RecompileError(AssertionError):
+    """A jitted function compiled more programs than the guard allows."""
+
+
+def cache_sizes(fns: Sequence[Any]) -> Dict[str, int]:
+    """{name: compiled-program count} for jit-wrapped functions. Same-named
+    functions get positional suffixes so a collision cannot hide one
+    function's growth behind the other's count."""
+    out: Dict[str, int] = {}
+    for i, f in enumerate(fns):
+        name = getattr(f, "__name__", repr(f))
+        if name in out:
+            name = f"{name}#{i}"
+        out[name] = int(f._cache_size())
+    return out
+
+
+@contextmanager
+def recompile_guard(*fns, allowed: int = 0, label: str = ""):
+    """Assert the jit caches of ``fns`` grow by at most ``allowed`` entries
+    across the block.
+
+    ``allowed`` is the TOTAL growth budget across all guarded functions: 0
+    pins "everything is already compiled" (steady-state serving, sweep
+    tests); N>0 admits exactly the N programs a warmup is expected to add.
+    Growth beyond it raises ``RecompileError`` with the per-function
+    breakdown, so the offender is named instead of inferred.
+    """
+    if not fns:
+        raise ValueError("recompile_guard needs at least one jitted function")
+    for f in fns:
+        if not hasattr(f, "_cache_size"):
+            raise TypeError(
+                f"{getattr(f, '__name__', f)!r} is not a jit-wrapped function "
+                "(no _cache_size); pass the jitted callable itself"
+            )
+    before = cache_sizes(fns)
+    yield
+    after = cache_sizes(fns)
+    growth = {k: after[k] - before[k] for k in after if after[k] != before[k]}
+    total = sum(growth.values())
+    if total > allowed:
+        tag = f" [{label}]" if label else ""
+        detail = ", ".join(f"{k}: {before[k]}→{after[k]}" for k in growth)
+        raise RecompileError(
+            f"recompile_guard{tag}: jit cache grew by {total} "
+            f"(allowed {allowed}) — {detail}. A static argument or shape is "
+            "varying per call; make it a traced operand or bucket it."
+        )

@@ -1,0 +1,521 @@
+"""Trace-hygiene linter: AST rules for JAX footguns, run in CI.
+
+``python -m galvatron_tpu.analysis.lint galvatron_tpu/`` — exit 1 on any
+unsuppressed finding. Rules (codes in diagnostics.CODES):
+
+  GTL101  host-device sync (``float()``/``int()``/``.item()``/``np.asarray``/
+          ``.tolist()``/``jax.device_get``/``.block_until_ready()``) on a
+          value produced by a jitted call inside a ``for``/``while`` loop —
+          each one serializes dispatch with device compute; hot loops should
+          sync once per window, not per iteration.
+  GTL102  Python/``np.random`` RNG inside a jit-traced function — the value
+          is baked at trace time, silently constant across calls.
+  GTL103  a numpy buffer mutated after being handed to async dispatch
+          (``jnp.asarray``/``jax.device_put``/a jitted call): on CPU the
+          device array may alias the host buffer, so the mutation corrupts
+          the in-flight computation (the serving-engine prefill bug class).
+          Loop bodies are scanned twice so mutation-next-iteration is caught;
+          rebinding the name (fresh buffer) clears the hazard.
+  GTL104  Python ``if``/``while`` on a traced (non-static) parameter of a
+          jitted function — TracerBoolConversionError at best, a per-value
+          recompile at worst. ``.shape``/``.ndim``/``.dtype``/``.size``
+          accesses are static and exempt.
+  GTL105  ``jax.jit(...)`` constructed inside a loop — a fresh cache per
+          iteration, so every call recompiles.
+  GTL106  a list/dict/set literal passed as a static argument of a known
+          jitted function — unhashable, fails (or defeats) the jit cache.
+
+Suppression: the finding's line must carry ``# gta: disable=<CODE>`` WITH a
+reason after the code(s), e.g. ``# gta: disable=GTL101 — gated by sync_each``.
+A reasonless suppression is itself a finding (GTL100).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import sys
+import tokenize
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from galvatron_tpu.analysis.diagnostics import Diagnostic, format_report
+
+# host-sync call forms: bare builtins over a device value, np conversions,
+# and method calls on the value itself
+_SYNC_BUILTINS = {"float", "int", "bool"}
+_SYNC_NP_FUNCS = {"asarray", "array"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+# attribute calls treated as jit producers even without a module-level
+# definition (the runtime's step entry points)
+_PRODUCER_ATTRS = {"train_step", "eval_step"}
+# calls that hand a host buffer to async dispatch
+_DISPATCH_CHAINS = {
+    ("jnp", "asarray"),
+    ("jnp", "array"),
+    ("jax", "device_put"),
+    ("jax", "numpy", "asarray"),
+    ("jax", "numpy", "array"),
+}
+
+# codes must LOOK like codes (GTL101/GTA012) so a plain-word reason after a
+# space ("# gta: disable=GTL101 gated by flag") parses as the reason, not as
+# part of the code list
+_SUPPRESS_RE = re.compile(
+    r"#\s*gta:\s*disable=((?:GT[A-Z]\d+\s*,\s*)*GT[A-Z]\d+)(.*)"
+)
+
+
+class _Suppressions:
+    def __init__(self, src: str, path: str):
+        self.by_line: Dict[int, Set[str]] = {}
+        self.malformed: List[Diagnostic] = []
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(src).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+                reason = m.group(2).strip().lstrip("—-: ").strip()
+                if not reason:
+                    self.malformed.append(
+                        Diagnostic(
+                            "GTL100",
+                            "suppression without a reason — say why the rule "
+                            "does not apply here",
+                            hint="# gta: disable=<CODE> — <reason>",
+                            source=path,
+                            line=tok.start[0],
+                        )
+                    )
+                    continue
+                self.by_line.setdefault(tok.start[0], set()).update(codes)
+        except tokenize.TokenError:
+            pass
+
+    def active(self, line: int, code: str) -> bool:
+        return code in self.by_line.get(line, ())
+
+
+def _dotted(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """('np', 'random', 'randint') for np.random.randint; None otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    d = _dotted(node)
+    return d in (("jax", "jit"), ("jit",))
+
+
+def _jit_decoration(dec: ast.AST) -> Optional[Set[str]]:
+    """If ``dec`` marks a function as jitted, return its static argnames."""
+    if _is_jax_jit(dec):
+        return set()
+    if isinstance(dec, ast.Call):
+        if _is_jax_jit(dec.func):
+            return _static_names(dec.keywords)
+        d = _dotted(dec.func)
+        if d and d[-1] == "partial" and dec.args and _is_jax_jit(dec.args[0]):
+            return _static_names(dec.keywords)
+    return None
+
+
+def _static_names(keywords) -> Set[str]:
+    for kw in keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            return {
+                e.value for e in vals
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            }
+    return set()
+
+
+def _assigned_names(target: ast.AST) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for e in target.elts:
+            out.extend(_assigned_names(e))
+        return out
+    return []
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """Module-level jit landscape: which names are jitted callables, and
+    their static argnames (for GTL101 producers and GTL106 call sites)."""
+
+    def __init__(self):
+        self.jitted: Dict[str, Set[str]] = {}
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        for dec in node.decorator_list:
+            statics = _jit_decoration(dec)
+            if statics is not None:
+                self.jitted[node.name] = statics
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node: ast.Assign):
+        v = node.value
+        statics: Optional[Set[str]] = None
+        if isinstance(v, ast.Call) and _is_jax_jit(v.func):
+            statics = _static_names(v.keywords)
+        elif isinstance(v, ast.Call):
+            d = _dotted(v.func)
+            if d and d[-1] == "partial" and v.args and _is_jax_jit(v.args[0]):
+                statics = _static_names(v.keywords)
+        if statics is not None:
+            for name in _assigned_names(node.targets[0] if len(node.targets) == 1 else ast.Tuple(elts=node.targets)):
+                self.jitted[name] = statics
+        self.generic_visit(node)
+
+
+class Linter:
+    def __init__(self, src: str, path: str):
+        self.src = src
+        self.path = path
+        self.findings: List[Diagnostic] = []
+        self.suppressed = 0
+        self._sup_seen: set = set()
+        self.sup = _Suppressions(src, path)
+
+    def run(self) -> List[Diagnostic]:
+        try:
+            tree = ast.parse(self.src)
+        except SyntaxError as e:
+            # not this linter's job; flag nothing (py_compile/CI catches it)
+            print(f"{self.path}: skipped (syntax error: {e})", file=sys.stderr)
+            return []
+        idx = _ModuleIndex()
+        idx.visit(tree)
+        self.jitted = idx.jitted
+        self.findings.extend(self.sup.malformed)
+        # module body too: the aliasing bug class (GTL103) is just as fatal
+        # in script-style top-level code as inside a def
+        self._check_buffer_mutation(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                statics = None
+                for dec in node.decorator_list:
+                    s = _jit_decoration(dec)
+                    if s is not None:
+                        statics = s
+                if statics is not None:
+                    self._check_traced_body(node, statics)
+                self._check_buffer_mutation(node)
+            if isinstance(node, (ast.For, ast.While)):
+                self._check_loop(node)
+            if isinstance(node, ast.Call):
+                self._check_static_literal(node)
+        # nested loops are visited by the outer loop's walk too — dedup
+        seen = set()
+        unique = []
+        for f in self.findings:
+            key = (f.code, f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                unique.append(f)
+        self.findings = unique
+        return self.findings
+
+    # -- emission ----------------------------------------------------------
+
+    def _emit(self, code: str, line: int, message: str, hint: str = ""):
+        if self.sup.active(line, code):
+            # same dedup key as the findings list: the GTL103 double pass
+            # over loop bodies (and nested-loop re-walks) must not
+            # over-count one suppression
+            key = (code, line, message)
+            if key not in self._sup_seen:
+                self._sup_seen.add(key)
+                self.suppressed += 1
+            return
+        self.findings.append(
+            Diagnostic(code, message, hint=hint, source=self.path, line=line)
+        )
+
+    # -- GTL102 / GTL104: inside jit-traced functions ----------------------
+
+    def _check_traced_body(self, fn, statics: Set[str]):
+        args = fn.args
+        all_params = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        traced = {p for p in all_params if p not in statics and p != "self"}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d and (
+                    (d[0] == "random" and len(d) == 2)
+                    or (d[0] in ("np", "numpy") and len(d) >= 3 and d[1] == "random")
+                ):
+                    self._emit(
+                        "GTL102", node.lineno,
+                        f"{'.'.join(d)} inside jitted {fn.name!r}: the value is "
+                        "baked at trace time (constant across calls)",
+                        hint="thread a jax.random key through the function instead",
+                    )
+            if isinstance(node, (ast.If, ast.While)):
+                bad = self._traced_names_in_test(node.test, traced)
+                for name, line in bad:
+                    self._emit(
+                        "GTL104", line,
+                        f"Python branch on traced parameter {name!r} inside "
+                        f"jitted {fn.name!r}",
+                        hint="use jnp.where/lax.cond, or declare it in "
+                        "static_argnames if it is genuinely static",
+                    )
+
+    def _traced_names_in_test(self, test: ast.AST, traced: Set[str]):
+        parents = {}
+        for parent in ast.walk(test):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        out = []
+        for node in ast.walk(test):
+            if isinstance(node, ast.Name) and node.id in traced:
+                p = parents.get(node)
+                if isinstance(p, ast.Attribute) and p.attr in _STATIC_ATTRS:
+                    continue
+                # `x is None` / `x is not None` sentinel checks are host-side
+                if isinstance(p, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in p.ops
+                ):
+                    continue
+                out.append((node.id, node.lineno))
+        return out
+
+    # -- GTL101 / GTL105: hot loops ----------------------------------------
+
+    def _check_loop(self, loop):
+        device_names: Set[str] = set()
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if self._is_jit_producer(node.value):
+                    for t in node.targets:
+                        device_names.update(_assigned_names(t))
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d and (d in (("jax", "jit"), ("jit",)) or (
+                d[-1] == "partial" and node.args and _is_jax_jit(node.args[0])
+            )):
+                self._emit(
+                    "GTL105", node.lineno,
+                    "jax.jit constructed inside a loop: a fresh cache per "
+                    "iteration means every call recompiles",
+                    hint="hoist the jit (or the partial) out of the loop",
+                )
+            target = self._sync_target(node)
+            if target and target in device_names:
+                self._emit(
+                    "GTL101", node.lineno,
+                    f"host sync on jitted result {target!r} inside a hot "
+                    "loop: serializes dispatch with device compute",
+                    hint="sync once per window (or gate it), not per iteration",
+                )
+
+    def _is_jit_producer(self, call: ast.Call) -> bool:
+        if isinstance(call.func, ast.Name):
+            return call.func.id in self.jitted
+        d = _dotted(call.func)
+        return bool(d) and d[-1] in (_PRODUCER_ATTRS | set(self.jitted))
+
+    def _sync_target(self, call: ast.Call) -> Optional[str]:
+        """The name being host-synced by this call, if any."""
+        def root_name(node):
+            if isinstance(node, ast.Subscript):
+                node = node.value
+            return node.id if isinstance(node, ast.Name) else None
+
+        if isinstance(call.func, ast.Name) and call.func.id in _SYNC_BUILTINS:
+            return root_name(call.args[0]) if call.args else None
+        d = _dotted(call.func)
+        if d and len(d) == 2 and d[0] in ("np", "numpy") and d[1] in _SYNC_NP_FUNCS:
+            return root_name(call.args[0]) if call.args else None
+        if d and d in (("jax", "device_get"),):
+            return root_name(call.args[0]) if call.args else None
+        if isinstance(call.func, ast.Attribute) and call.func.attr in _SYNC_METHODS:
+            return root_name(call.func.value)
+        return None
+
+    # -- GTL103: buffer mutation after dispatch ----------------------------
+
+    def _check_buffer_mutation(self, fn):
+        dispatched: Dict[str, int] = {}  # name → line of the dispatch
+
+        def names_in(node) -> Set[str]:
+            return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+        def scan_dispatch(expr):
+            """Record names handed to async dispatch anywhere in ``expr``."""
+            for node in ast.walk(expr):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func)
+                is_dispatch = (
+                    (d is not None and d in _DISPATCH_CHAINS)
+                    or (isinstance(node.func, ast.Name) and node.func.id in self.jitted)
+                    or (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in (_PRODUCER_ATTRS | set(self.jitted)))
+                )
+                if is_dispatch:
+                    for arg in list(node.args) + [k.value for k in node.keywords]:
+                        for name in names_in(arg):
+                            dispatched.setdefault(name, node.lineno)
+
+        def mutation(name: str, line: int, how: str):
+            self._emit(
+                "GTL103", line,
+                f"{name!r} {how} after being handed to async dispatch at "
+                f"line {dispatched[name]}: the device array may alias this "
+                "host buffer and the in-flight computation reads garbage",
+                hint="allocate a fresh buffer per dispatch instead of "
+                "reusing and mutating this one",
+            )
+
+        def handle_simple(stmt):
+            scan_dispatch(stmt)
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name):
+                        if t.value.id in dispatched:
+                            mutation(t.value.id, stmt.lineno, "mutated in place")
+                    for name in _assigned_names(t):
+                        dispatched.pop(name, None)  # fresh binding clears it
+            elif isinstance(stmt, ast.AugAssign):
+                t = stmt.target
+                name = (
+                    t.id if isinstance(t, ast.Name)
+                    else t.value.id
+                    if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name)
+                    else None
+                )
+                if name and name in dispatched:
+                    mutation(name, stmt.lineno, "mutated (augmented assign)")
+            elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                f = stmt.value.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in ("fill", "sort", "put", "resize", "partition")
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in dispatched
+                ):
+                    mutation(f.value.id, stmt.lineno, f"mutated via .{f.attr}()")
+
+        def process_block(stmts, passes: int = 1):
+            for _ in range(passes):
+                for stmt in stmts:
+                    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                        scan_dispatch(stmt.iter)
+                        # two passes over the body: a dispatch late in
+                        # iteration k and a mutation early in k+1 is the
+                        # classic reuse bug — state survives the back edge,
+                        # a fresh binding at the top clears it
+                        process_block(stmt.body, passes=2)
+                        process_block(stmt.orelse)
+                    elif isinstance(stmt, ast.While):
+                        scan_dispatch(stmt.test)
+                        process_block(stmt.body, passes=2)
+                        process_block(stmt.orelse)
+                    elif isinstance(stmt, ast.If):
+                        scan_dispatch(stmt.test)
+                        process_block(stmt.body)
+                        process_block(stmt.orelse)
+                    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                        for item in stmt.items:
+                            scan_dispatch(item.context_expr)
+                        process_block(stmt.body)
+                    elif isinstance(stmt, ast.Try):
+                        process_block(stmt.body)
+                        for h in stmt.handlers:
+                            process_block(h.body)
+                        process_block(stmt.orelse)
+                        process_block(stmt.finalbody)
+                    elif isinstance(
+                        stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                    ):
+                        pass  # nested defs get their own pass (own state)
+                    else:
+                        handle_simple(stmt)
+
+        process_block(fn.body)
+
+    # -- GTL106: unhashable static args ------------------------------------
+
+    def _check_static_literal(self, call: ast.Call):
+        if not isinstance(call.func, ast.Name):
+            return
+        statics = getattr(self, "jitted", {}).get(call.func.id)
+        if not statics:
+            return
+        for kw in call.keywords:
+            if kw.arg in statics and isinstance(
+                kw.value,
+                (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp),
+            ):
+                self._emit(
+                    "GTL106", kw.value.lineno,
+                    f"static argument {kw.arg!r} of jitted "
+                    f"{call.func.id!r} is an unhashable literal",
+                    hint="pass a tuple (or another hashable) for static args",
+                )
+
+
+def lint_source(src: str, path: str = "<string>") -> Tuple[List[Diagnostic], int]:
+    linter = Linter(src, path)
+    findings = linter.run()
+    return findings, linter.suppressed
+
+
+def lint_paths(paths: Sequence[str]) -> Tuple[List[Diagnostic], int]:
+    findings: List[Diagnostic] = []
+    suppressed = 0
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                if "__pycache__" in root:
+                    continue
+                files += [os.path.join(root, n) for n in names if n.endswith(".py")]
+        elif p.endswith(".py"):
+            files.append(p)
+    for f in sorted(files):
+        with open(f, encoding="utf-8") as fh:
+            fs, sup = lint_source(fh.read(), f)
+        findings += fs
+        suppressed += sup
+    return findings, suppressed
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    findings, suppressed = lint_paths(argv)
+    if findings:
+        print(format_report(findings, clean=""))
+        print(f"({suppressed} suppressed)")
+        return 1
+    print(f"lint clean ({suppressed} suppressed)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

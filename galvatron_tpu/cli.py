@@ -7,6 +7,10 @@ models/<name>/{train_dist,search_dist,profiler}.py + profile_hardware):
   search            parallelism optimization → galvatron_config JSON
   profile           model computation/memory profiling → JSON
   profile-hardware  ICI bandwidth + overlap sweep → JSON
+  check-plan        static plan validation (analysis/plan_check.py): reject a
+                    bad strategy JSON in milliseconds with stable GTA…
+                    diagnostics — no device, no XLA compile; CI runs it over
+                    configs/
   generate          KV-cache text generation from a checkpoint (or random init)
   serve             REST generation server (text_generation_server equivalent);
                     continuous-batching engine by default (--num_slots,
@@ -114,6 +118,7 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             memory_budget_mb=ns.memory_constraint_gb * 1024.0,
             mixed_precision=ns.mixed_precision,
             section_pipeline=bool(cfg.swin_depths),
+            model_config=cfg, model_name=ns.model_size,
         )
         if ns.check_cost_model:
             bsz = ns.settle_bsz if ns.settle_bsz > 0 else ns.min_bsz
@@ -294,6 +299,10 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
                   f"{ns.output_dir}/state_dict.npz")
         return 0
 
+    if mode == "check-plan":
+        ns = initialize_galvatron("check_plan", rest, model_default)
+        return _check_plan_mode(ns)
+
     if mode in ("generate", "serve"):
         import jax
 
@@ -362,9 +371,103 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
 
     print(
         f"unknown mode {mode!r}; expected "
-        "train|search|profile|profile-hardware|generate|serve|export-hf"
+        "train|search|profile|profile-hardware|check-plan|generate|serve|export-hf"
     )
     return 2
+
+
+def _check_plan_mode(ns) -> int:
+    """Validate strategy JSONs statically; exit 1 on any error diagnostic
+    (warnings too under --strict). Model/world/batch/budget default to the
+    JSON's own provenance keys (search-emitted configs are self-describing)."""
+    from galvatron_tpu.analysis import plan_check
+    from galvatron_tpu.analysis.diagnostics import errors, format_report, warnings
+    from galvatron_tpu.core.arguments import model_config_from_args
+
+    paths = list(ns.config_paths or []) + list(ns.galvatron_config_path or [])
+    if not paths:
+        print("error: check-plan needs at least one strategy JSON path")
+        return 2
+    rc = 0
+    cli_model_size = ns.model_size  # per-file JSON defaults must not leak across files
+    for path in paths:
+        try:
+            with open(path) as f:
+                d = json.load(f)
+            if not isinstance(d, dict):
+                d = {}
+        except (OSError, ValueError):
+            d = {}  # check_plan reports the parse failure as GTA002
+        model_size = cli_model_size or d.get("model_size")
+        shape = d.get("model_config")
+        shape = shape if isinstance(shape, dict) else None
+        cfg = None
+        base = None
+        if model_size:
+            from galvatron_tpu.models.modeling import PRESETS
+
+            base = PRESETS.get(model_size)
+            if base is None and cli_model_size:
+                # an explicit model the user asked to validate against —
+                # falling back to anything else would answer a different
+                # question with a confident exit code
+                print(f"error: unknown --model_size {cli_model_size!r}")
+                return 2
+            if base is None and shape is None:
+                print(f"{path}: unknown model_size {model_size!r} and no "
+                      "embedded model_config; running structural checks only")
+        if cli_model_size:
+            # an EXPLICIT --model_size asks "does this plan fit THAT model" —
+            # the plan's embedded shape must not overlay it (it would make
+            # validation against a different model silently vacuous)
+            if shape is not None:
+                print(f"{path}: validating against --model_size "
+                      f"{cli_model_size} (plan's embedded model_config "
+                      "shape ignored)")
+        elif shape is not None:
+            # no explicit model: the plan's embedded EFFECTIVE shape is the
+            # default (covers search-time overrides like --num_layers);
+            # explicit per-field flags still win below
+            from galvatron_tpu.analysis.plan_check import apply_model_shape
+            from galvatron_tpu.models.modeling import ModelConfig
+
+            base = apply_model_shape(base if base is not None else ModelConfig(), shape)
+        if base is not None:
+            cfg = model_config_from_args(ns, base=base)
+        def _num(v):
+            # provenance keys come from arbitrary hand-edited JSON: a
+            # string-typed "8" must not crash the tool whose job is turning
+            # malformed configs into diagnostics
+            try:
+                return float(v)
+            except (TypeError, ValueError):
+                return 0.0
+
+        world = int(ns.num_devices or _num(d.get("num_devices")))
+        budget_gb = ns.memory_constraint_gb or _num(d.get("memory_constraint_gb"))
+        diags = plan_check.check_plan(
+            # already decoded above — re-reading the file would duplicate
+            # I/O and race a concurrent rewrite; the path branch is kept
+            # only to surface the parse failure as GTA002
+            d if d else path,
+            source=path,
+            model_config=cfg,
+            world_size=world or None,
+            global_bsz=ns.global_bsz or None,
+            memory_budget_mb=budget_gb * 1024.0 or None,
+            abstract_pass=not ns.no_abstract_pass,
+        )
+        scope = []
+        if cfg is None:
+            scope.append("no model config: structural checks only")
+        if not world:
+            scope.append("no num_devices: topology checks skipped")
+        tag = f"  ({'; '.join(scope)})" if scope else ""
+        print(f"== {path}{tag}")
+        print(format_report(diags))
+        if errors(diags) or (ns.strict and warnings(diags)):
+            rc = 1
+    return rc
 
 
 def _validate_search(cands, cfg, ns):

@@ -250,6 +250,29 @@ def _add_generate_args(p: argparse.ArgumentParser):
                    help="export-hf: directory for the HF-format checkpoint")
 
 
+def _add_check_plan_args(p: argparse.ArgumentParser):
+    """Static plan validation (analysis/plan_check.py; no device, no compile)."""
+    g = p.add_argument_group("check-plan")
+    g.add_argument("config_paths", nargs="*",
+                   help="strategy JSON files to validate (galvatron_config schema)")
+    g.add_argument("--galvatron_config_path", type=str, action="append",
+                   default=None, help="additional strategy JSON (repeatable)")
+    g.add_argument("--num_devices", type=int, default=0,
+                   help="mesh size to validate against; 0 = the JSON's own "
+                   "num_devices key (emitted by the search engine)")
+    g.add_argument("--global_bsz", type=int, default=0,
+                   help="global batch for the divisibility checks; 0 = the "
+                   "JSON's own global_bsz key")
+    g.add_argument("--memory_constraint_gb", type=float, default=0.0,
+                   help="per-device budget for the feasibility check; 0 = "
+                   "the JSON's own memory_constraint_gb key (else skipped)")
+    g.add_argument("--strict", type=int, default=0,
+                   help="1 = warnings (unknown keys, silent replication) "
+                   "also fail the check")
+    g.add_argument("--no_abstract_pass", type=int, default=0,
+                   help="1 = skip the eval_shape/AbstractMesh sharding pass")
+
+
 def _add_hardware_args(p: argparse.ArgumentParser):
     """(reference: galvatron_profile_hardware_args, core/arguments.py:186-223)"""
     g = p.add_argument_group("profile-hardware")
@@ -275,6 +298,13 @@ def build_parser(mode: str, model_default: Optional[str] = None) -> argparse.Arg
         _add_training_args(p)
     elif mode == "profile_hardware":
         _add_hardware_args(p)
+    elif mode == "check_plan":
+        _add_check_plan_args(p)
+        # model flags come from the shared model group; None (not the preset
+        # default) so the JSON's own model_size key can win when no flag is
+        # given — unless a per-family entry pinned its default above
+        if not model_default:
+            p.set_defaults(model_size=None)
     elif mode in ("generate", "serve", "export_hf"):
         _add_generate_args(p)
     else:
@@ -288,12 +318,14 @@ def initialize_galvatron(mode: str, args: Optional[Sequence[str]] = None,
     return build_parser(mode, model_default).parse_args(args)
 
 
-def model_config_from_args(ns: argparse.Namespace):
+def model_config_from_args(ns: argparse.Namespace, base=None):
     """Meta-config resolution (reference: config_from_meta/set_model_config,
-    models/*/meta_configs/config_utils.py:13-46)."""
+    models/*/meta_configs/config_utils.py:13-46). ``base`` overrides the
+    preset lookup (check-plan: a plan's embedded effective shape) — explicit
+    CLI flags still win over it."""
     import dataclasses
 
-    cfg = PRESETS[ns.model_size]
+    cfg = base if base is not None else PRESETS[ns.model_size]
     overrides = {}
     for field, attr in [
         ("vocab_size", "vocab_size"), ("hidden_size", "hidden_size"),

@@ -70,7 +70,28 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
 
     cfg = resolve_attn_impl(cfg, ns)
     world = len(jax.devices())
+    from galvatron_tpu.analysis import plan_check
+
+    if ns.galvatron_config_path:
+        # fail-fast BEFORE any mesh is built: a bad plan surfaces as
+        # structured GTA… diagnostics in milliseconds instead of a cryptic
+        # compiler abort (or a silent memory blowout) minutes into startup.
+        # The file is checked directly so even plans that fail to decode
+        # report field provenance rather than a bare codec ValueError.
+        plan_check.ensure_valid(
+            ns.galvatron_config_path, model_config=cfg, world_size=world,
+            global_bsz=ns.global_train_batch_size,
+            context=f"refusing to start: {ns.galvatron_config_path}",
+            verbose=verbose,
+        )
     hp = hybrid_config_from_args(ns, cfg.total_layers, world)
+    if not ns.galvatron_config_path:
+        plan_check.ensure_valid(
+            hp, model_config=cfg, world_size=world,
+            global_bsz=ns.global_train_batch_size,
+            context="refusing to start: invalid hybrid-parallel flags",
+            verbose=verbose,
+        )
     lr_schedule = None
     if getattr(ns, "lr_warmup_iters", 0) or getattr(ns, "lr_decay_iters", 0):
         from galvatron_tpu.core.schedules import LRSchedule
@@ -258,7 +279,7 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
                 # open while warmup compute is still in flight and overstate
                 # avg iter time
                 prof.end_iter(loss)
-                loss_val = float(loss) if sync_each else None
+                loss_val = float(loss) if sync_each else None  # gta: disable=GTL101 — deliberate sync, gated by sync_each (off unless per-iter observables or the anomaly sentinel need the realized loss)
                 # injection sits OUTSIDE the armed gate: chaos jobs force a
                 # NaN observation with or without the sentinel (a disarmed
                 # run must drive the stringified-JSONL divergence path too)

@@ -67,6 +67,15 @@ class SearchSpace:
     # interleaved virtual stages: search vpp ∈ powers of two up to max_vpp
     # (gpipe schedule only; 1 = off)
     max_vpp: int = 1
+    # model divisibility constraints (0 = unconstrained). tp candidates must
+    # divide num_heads (head-sharded attention cannot split 25 GPT-2-XL
+    # heads over tp=2) and vocab_tp candidates must divide vocab_size
+    # (50257 is odd — any vocab_tp>1 would silently replicate the embedding
+    # instead of sharding it, falsifying the memory model). Found by the
+    # emit-path self-check (analysis/plan_check GTA007/GTA008); SearchEngine
+    # fills these from model_config when given.
+    num_heads: int = 0
+    vocab_size: int = 0
 
 
 def _pow2s(n: int) -> List[int]:
@@ -77,10 +86,14 @@ def _pow2s(n: int) -> List[int]:
     return out
 
 
-def _vocab_strategy_pairs(world: int, pp: int):
+def _vocab_strategy_pairs(world: int, pp: int, vocab_size: int = 0):
     """Searched (vocab_tp, embed_dp_type) candidates — one rule shared by
-    evaluate() and check_cost_model()."""
+    evaluate() and check_cost_model(). vocab_tp must divide the vocab
+    (vocab_size=0 = unconstrained): a non-dividing degree cannot shard the
+    embedding table, so the runtime would silently replicate it."""
     for vt in _pow2s(world // pp):
+        if vocab_size and vocab_size % vt:
+            continue
         for et in ["ddp", "zero3"] if world // (pp * vt) > 1 else ["ddp"]:
             yield vt, et
 
@@ -89,7 +102,11 @@ def generate_layer_strategies(space: SearchSpace, pp: int) -> List[LayerStrategy
     """Per-layer strategy candidates for a given pp (reference:
     generate_strategies, search_engine.py:424-537)."""
     per_stage = space.world_size // pp
-    tps = [t for t in _pow2s(per_stage) if space.max_tp is None or t <= space.max_tp]
+    tps = [
+        t for t in _pow2s(per_stage)
+        if (space.max_tp is None or t <= space.max_tp)
+        and (space.num_heads == 0 or space.num_heads % t == 0)
+    ]
     out: List[LayerStrategy] = []
     for tp in tps:
         dp = per_stage // tp
@@ -152,6 +169,8 @@ class SearchEngine:
         mixed_precision: str = "bf16",
         mem_unit_mb: float = 8.0,
         section_pipeline: bool = False,
+        model_config=None,
+        model_name: str = "",
     ):
         self.costs = model_costs
         self.hw = hardware
@@ -160,6 +179,25 @@ class SearchEngine:
         self.budget_mb = memory_budget_mb
         self.mp = mixed_precision
         self.unit = mem_unit_mb
+        # provenance for save_result's emitted JSON (self-describing configs)
+        # and the emit-path self-check (analysis.plan_check): when set, every
+        # emitted plan is validated against the model before it is written
+        self.model_config = model_config
+        self.model_name = model_name
+        if model_config is not None:
+            # model divisibility constraints on the candidate space: a tp
+            # that cannot split the heads or a vocab_tp that cannot shard
+            # the vocab would emit a plan the plan checker (and the runtime)
+            # rejects — the self-check in save_result pins this. Copy, never
+            # mutate: a caller reusing one SearchSpace across engines for
+            # different models must not inherit the first model's limits.
+            self.space = space = dataclasses.replace(
+                space,
+                num_heads=space.num_heads
+                or int(getattr(model_config, "num_heads", 0) or 0),
+                vocab_size=space.vocab_size
+                or int(getattr(model_config, "vocab_size", 0) or 0),
+            )
         # structural bail-outs that fired during the last sweep (multi-type
         # schedule/shape classes the engines cannot realize) — written into
         # the emitted config as `search_restrictions` the way
@@ -220,6 +258,7 @@ class SearchEngine:
         return all(
             self.costs.vocab_measurement_for(vt, self.mp) is not None
             for vt in _pow2s(self.space.world_size // min_pp)
+            if not (self.space.vocab_size and self.space.vocab_size % vt)
         )
 
     def _feasible_strategies(self, pp: int, global_bsz: int, chunks: int):
@@ -504,7 +543,7 @@ class SearchEngine:
         # the layer DP only when the remaining budget actually changes
         dp_cache: Dict[tuple, tuple] = {}
         best = None  # (total_ms, res, mem_used, vt, et, other_mb)
-        pairs = list(_vocab_strategy_pairs(world, pp))
+        pairs = list(_vocab_strategy_pairs(world, pp, self.space.vocab_size))
         use_measured = self._vocab_use_measured()
         pf_overhead = 0.0
         if multi_type is not None and pipeline_type == "pipedream_flush":
@@ -1083,7 +1122,7 @@ class SearchEngine:
         # whether the base term is measured (profile_vocab_costs table) or
         # analytic — with the same whole-sweep consistency gate evaluate()
         # applies (a mixed sweep would bias toward unmeasured degrees)
-        pairs = list(_vocab_strategy_pairs(world, pp))
+        pairs = list(_vocab_strategy_pairs(world, pp, self.space.vocab_size))
         use_measured = self._vocab_use_measured()
         lines.append(
             f"{'vocab strategy':>16} | {'other MB':>9} | {'other ms':>8} | {'src':>8}"
@@ -1118,5 +1157,34 @@ class SearchEngine:
             d["search_restrictions"] = rs
         if "homogeneity_gap_pct" in result.details:
             d["homogeneity_gap_pct"] = result.details["homogeneity_gap_pct"]
+        # self-describing provenance: check-plan (CLI/CI) reads these back
+        # as defaults, so a checked-in config validates without extra flags
+        d["num_devices"] = self.space.world_size
+        # the budget this plan was searched under: check-plan's GTA015
+        # feasibility gate reads it back, so a regenerated config keeps the
+        # CI memory check without hand-editing
+        d["memory_constraint_gb"] = self.budget_mb / 1024.0
+        if self.model_name:
+            d["model_size"] = self.model_name
+        if self.model_config is not None:
+            # effective shape, so check-plan needs no repeated CLI overrides
+            # (a --num_layers 4 search against a 24-layer preset would
+            # otherwise read back as a spurious layer-count mismatch)
+            from galvatron_tpu.analysis.plan_check import model_shape_dict
+
+            d["model_config"] = model_shape_dict(self.model_config)
+        # emit-path self-check: the runtime materializes emitted plans
+        # blindly, so an invalid one here is a SEARCH bug — refuse to write
+        # it rather than hand the trainer a plan its own startup check (or
+        # worse, the compiler) rejects minutes later
+        from galvatron_tpu.analysis import plan_check
+
+        plan_check.ensure_valid(
+            d, model_config=self.model_config,
+            world_size=self.space.world_size,
+            memory_budget_mb=self.budget_mb,
+            context=f"search emitted an invalid plan (search bug) for {path}",
+            verbose=False,
+        )
         with open(path, "w") as f:
             json.dump(d, f, indent=2)

@@ -26,6 +26,7 @@ bit-for-bit, which is what the parity tests pin.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -146,6 +147,15 @@ class Engine:
         self._rng: Dict[int, np.random.Generator] = {}
         self._busy_s = 0.0
         self._last_step_tps = 0.0
+        # GALVATRON_RECOMPILE_GUARD=1 (debug/CI): after the first decode
+        # iteration, the engine's two programs exist — any further jit-cache
+        # growth is a static-arg/shape leak compiling per request, and the
+        # guard fails the offending step loudly (analysis/guards.py) instead
+        # of letting latency quietly collapse. Per-engine baseline: other
+        # engines compiling in the same process (different cfg) would show
+        # as growth, so arm it on single-engine runs only.
+        self._guard_armed = os.environ.get("GALVATRON_RECOMPILE_GUARD", "") not in ("", "0")
+        self._guard_baseline = None
         self._cond = threading.Condition()
         self._stop = False
         self._thread = threading.Thread(
@@ -384,10 +394,44 @@ class Engine:
                 self._last_logits[slot] = logits[slot]
         self.counters.inc("steps")
         self.counters.inc("tokens_generated", appended)
+        if self._guard_armed:
+            self.assert_cache_bounded()
         dt = time.perf_counter() - t0
         self._busy_s += dt
         if dt > 0:
             self._last_step_tps = sampled / dt
+
+    def assert_cache_bounded(self) -> None:
+        """Pin "exactly two compiled programs for the engine lifetime": the
+        first call records the post-warmup baseline, later calls raise
+        ``RecompileError`` on any growth (a static-arg or shape leak)."""
+        from galvatron_tpu.analysis.guards import RecompileError, cache_sizes
+
+        sizes = cache_sizes((_prefill_chunk, _decode_step))
+        if self._guard_baseline is None:
+            # warmup isn't over until BOTH programs exist: a first step whose
+            # requests all retire before the shared forward (1-token answers,
+            # instant eos) never compiles _decode_step, and baselining its
+            # count at 0 would make the next request's legitimate warmup
+            # compile trip the guard and fail every in-flight request
+            if all(v > 0 for v in sizes.values()):
+                self._guard_baseline = sizes
+            return
+        grown = {
+            k: (self._guard_baseline[k], v)
+            for k, v in sizes.items()
+            if v > self._guard_baseline[k]
+        }
+        if grown:
+            # re-baseline BEFORE raising: one recompile reports once — a
+            # stale baseline would otherwise fail every subsequent step
+            # (and request) against growth that already happened
+            self._guard_baseline = sizes
+            detail = ", ".join(f"{k}: {a}→{b}" for k, (a, b) in grown.items())
+            raise RecompileError(
+                f"serving engine recompiled after warmup ({detail}): a "
+                "static argument or shape is varying per request"
+            )
 
     def _retire(self, slot: int) -> None:
         req = self._by_slot.pop(slot)

@@ -1,0 +1,536 @@
+"""Static plan checker + recompile guard tests.
+
+Table-driven negatives: one deliberately-broken plan per diagnostic code,
+asserting exactly that code fires and the hint/field provenance names the
+offending field. Plus the search→check round trip (an emitted plan that
+fails check_plan is a search bug) and the recompile_guard behavior."""
+
+import json
+import time
+
+import pytest
+
+from galvatron_tpu.analysis import (
+    PlanError,
+    RecompileError,
+    check_plan,
+    format_report,
+    recompile_guard,
+)
+from galvatron_tpu.analysis.diagnostics import CODES, errors, warnings
+from galvatron_tpu.analysis.plan_check import KNOWN_KEYS, ensure_valid
+from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy
+from galvatron_tpu.models.modeling import ModelConfig, PRESETS
+
+CFG = ModelConfig(
+    num_layers=4, num_heads=8, hidden_size=64, vocab_size=1024, max_seq_len=64
+)
+
+
+def codes(diags):
+    return sorted({d.code for d in diags})
+
+
+def error_codes(diags):
+    return sorted({d.code for d in errors(diags)})
+
+
+def uniform_dict(**kw):
+    L = kw.pop("num_layers", 4)
+    return HybridParallelConfig.uniform(L, **kw).to_json_dict()
+
+
+# ---------------------------------------------------------------------------
+# negative table: one broken plan per code
+# ---------------------------------------------------------------------------
+
+def _case_gta001():
+    d = uniform_dict()
+    d["mlp_recompue"] = "policy"  # the classic silent-no-op typo
+    return dict(plan=d, model_config=CFG, world_size=8), "GTA001", "mlp_recompue"
+
+
+def _case_gta002():
+    d = uniform_dict()
+    d["tp_sizes_enc"] = "3,3,3,3"  # not a power of two
+    return dict(plan=d, world_size=8), "GTA002", ""
+
+
+def _case_gta002_length():
+    d = uniform_dict()
+    d["sp_flags"] = "1,0"  # 2 entries vs 4 layers
+    return dict(plan=d, world_size=8), "GTA002", "sp_flags"
+
+
+def _case_gta003():
+    return (
+        dict(plan=HybridParallelConfig.uniform(4), world_size=6),
+        "GTA003", "pp_deg",
+    )
+
+
+def _case_gta004():
+    return (
+        dict(plan=HybridParallelConfig.uniform(4, tp=16), world_size=8),
+        "GTA004", "tp_sizes_enc[0]",
+    )
+
+
+def _case_gta005():
+    hp = HybridParallelConfig.uniform(4, pp=2, chunks=2)
+    hp.pp_division = [3, 2]  # sums to 5, not 4
+    return dict(plan=hp, world_size=8), "GTA005", "pp_division"
+
+
+def _case_gta006():
+    return (
+        dict(plan=HybridParallelConfig.uniform(6), model_config=CFG, world_size=8),
+        "GTA006", "tp_sizes_enc",
+    )
+
+
+def _case_gta007():
+    cfg = ModelConfig(num_layers=4, num_heads=6, hidden_size=96,
+                      vocab_size=1024, max_seq_len=64)
+    return (
+        dict(plan=HybridParallelConfig.uniform(4, tp=4), model_config=cfg,
+             world_size=8),
+        "GTA007", "tp_sizes_enc[0]",
+    )
+
+
+def _case_gta008():
+    cfg = ModelConfig(num_layers=4, num_heads=8, hidden_size=64,
+                      vocab_size=1001, max_seq_len=64)
+    return (
+        dict(plan=HybridParallelConfig.uniform(4, vocab_tp=2),
+             model_config=cfg, world_size=8),
+        "GTA008", "vocab_tp",
+    )
+
+
+def _case_gta009():
+    return (
+        dict(plan=HybridParallelConfig.uniform(4, chunks=4), world_size=8,
+             global_bsz=6),  # 6 % 4 chunks
+        "GTA009", "chunks",
+    )
+
+
+def _case_gta009_dp():
+    return (
+        dict(plan=HybridParallelConfig.uniform(4), world_size=8,
+             global_bsz=4),  # micro-batch 4 over dp=8
+        "GTA009", "tp_sizes_enc[0]",
+    )
+
+
+def _case_gta010():
+    cfg = ModelConfig(num_layers=4, num_heads=8, hidden_size=64,
+                      vocab_size=1024, max_seq_len=100)
+    return (
+        dict(plan=HybridParallelConfig.uniform(4, tp=8, sp=True),
+             model_config=cfg, world_size=8),
+        "GTA010", "sp_flags[0]",
+    )
+
+
+def _case_gta011():
+    hp = HybridParallelConfig.uniform(24, pp=2, vpp=2, chunks=3)
+    return dict(plan=hp, world_size=8), "GTA011", "chunks"
+
+
+def _case_gta012():
+    hp = HybridParallelConfig.uniform(
+        4, pp=2, tp=2, sp=False, chunks=2,
+        pipeline_type="pipedream_flush", vocab_tp=2,
+    )
+    return dict(plan=hp, model_config=CFG, world_size=8), "GTA012", "sp_flags[0]"
+
+
+def _case_gta013():
+    ls = [LayerStrategy(tp=1)] * 2 + [LayerStrategy(tp=2)] * 2
+    hp = HybridParallelConfig(pp=2, layer_strategies=ls, chunks=2)
+    return dict(plan=hp, world_size=8), "GTA013", "tp_sizes_enc"
+
+
+def _case_gta014():
+    return (
+        dict(plan=HybridParallelConfig.uniform(4, ep=2), model_config=CFG,
+             world_size=8),
+        "GTA014", "ep_sizes_enc[0]",
+    )
+
+
+def _case_gta015():
+    return (
+        dict(plan=HybridParallelConfig.uniform(4), model_config=CFG,
+             world_size=8, global_bsz=8, memory_budget_mb=0.5),
+        "GTA015", "memory_mb",
+    )
+
+
+def _case_gta015_recorded():
+    d = uniform_dict()
+    d["memory_mb"] = 99999.0
+    return (
+        dict(plan=d, world_size=8, memory_budget_mb=1024.0),
+        "GTA015", "memory_mb",
+    )
+
+
+def _case_gta016():
+    cfg = ModelConfig(num_layers=2, num_heads=8, hidden_size=64,
+                      vocab_size=1024, max_seq_len=64, ffn_dim=100)
+    return (
+        dict(plan=HybridParallelConfig.uniform(2, tp=8), model_config=cfg,
+             world_size=8),
+        "GTA016", "",
+    )
+
+
+_CASES = [
+    _case_gta001, _case_gta002, _case_gta002_length, _case_gta003,
+    _case_gta004, _case_gta005, _case_gta006, _case_gta007, _case_gta008,
+    _case_gta009, _case_gta009_dp, _case_gta010, _case_gta011, _case_gta012,
+    _case_gta013, _case_gta014, _case_gta015, _case_gta015_recorded,
+    _case_gta016,
+]
+
+
+@pytest.mark.parametrize("case", _CASES, ids=lambda c: c.__name__[6:])
+def test_negative_table(case):
+    kw, expected, field_substr = case()
+    diags = check_plan(**kw)
+    assert codes(diags) == [expected], format_report(diags)
+    d = diags[0]
+    assert d.code == expected
+    assert d.severity == CODES[expected][1]
+    assert d.hint, "every diagnostic carries a fix hint"
+    if field_substr:
+        assert any(field_substr in x.field for x in diags), (
+            field_substr, [x.field for x in diags]
+        )
+
+
+def test_clean_plan_zero_diagnostics_under_one_second():
+    cfg = PRESETS["llama-0.3b"]
+    hp = HybridParallelConfig.uniform(
+        cfg.total_layers, pp=2, tp=2, sp=True, chunks=4,
+        pipeline_type="pipedream_flush", vocab_tp=1, dp_type="zero3",
+    )
+    t0 = time.monotonic()
+    diags = check_plan(hp, model_config=cfg, world_size=8, global_bsz=8)
+    dt = time.monotonic() - t0
+    assert diags == [], format_report(diags)
+    assert dt < 1.0, f"check_plan took {dt:.2f}s — it must not compile anything"
+
+
+def test_distinct_invalid_classes_count():
+    """Acceptance: >= 10 distinct invalid-plan classes with stable codes."""
+    seen = set()
+    for case in _CASES:
+        kw, expected, _ = case()
+        got = codes(check_plan(**kw))
+        assert got == [expected]
+        seen.add(expected)
+    assert len(seen) >= 10, sorted(seen)
+
+
+def test_decode_scalar_and_name_list_mismatches():
+    """Hand-edit failure modes must stay structured diagnostics, never raw
+    TypeError/IndexError: a scalar where a per-layer list belongs, and a
+    length mismatch in the NAME lists (dp_type_names/cp_impls)."""
+    d = uniform_dict()
+    d["checkpoint"] = 0  # scalar, not a per-layer list
+    diags = check_plan(d, world_size=8)
+    assert codes(diags) == ["GTA002"] and diags[0].field == "checkpoint"
+    d = uniform_dict()
+    d["dp_type_names"] = "ddp,ddp,ddp"  # 3 entries vs 4 layers
+    diags = check_plan(d, world_size=8)
+    assert codes(diags) == ["GTA002"]
+    assert any(x.field == "dp_type_names" for x in diags)
+
+
+def test_string_typed_provenance_keys_do_not_crash(tmp_path):
+    """global_bsz/num_devices/memory_constraint_gb are provenance, often
+    hand-edited — string values must degrade, not traceback."""
+    from galvatron_tpu import cli
+
+    d = uniform_dict()
+    d.update(global_bsz="16x", num_devices="8x", memory_constraint_gb="24")
+    p = tmp_path / "weird.json"
+    with open(p, "w") as f:
+        json.dump(d, f)
+    assert codes(check_plan(dict(d), world_size=8)) == []
+    # CLI path: unparseable world → structural-only run, still no crash
+    assert cli.main(["check-plan", str(p), "--num_layers", "4"]) in (0, 1)
+
+
+def test_file_provenance_and_parse_error(tmp_path):
+    p = tmp_path / "plan.json"
+    p.write_text("{not json")
+    diags = check_plan(str(p))
+    assert codes(diags) == ["GTA002"] and diags[0].source == str(p)
+    hp = HybridParallelConfig.uniform(4, tp=16)
+    hp.save(str(p))
+    diags = check_plan(str(p), world_size=8)
+    assert codes(diags) == ["GTA004"] and diags[0].source == str(p)
+
+
+def test_ensure_valid_raises_with_report():
+    hp = HybridParallelConfig.uniform(4, tp=16)
+    with pytest.raises(PlanError) as ei:
+        ensure_valid(hp, world_size=8, context="unit test")
+    assert "GTA004" in str(ei.value) and "unit test" in str(ei.value)
+    assert ei.value.diagnostics
+    # warnings alone do not raise
+    d = uniform_dict()
+    d["mlp_recompue"] = "x"
+    assert codes(ensure_valid(d, world_size=8, verbose=False)) == ["GTA001"]
+
+
+def test_known_keys_cover_save_result_schema(tmp_path):
+    """Every key save_result writes must be KNOWN — otherwise the checker
+    would flag the search engine's own output as typos."""
+    from galvatron_tpu.search.cost_model import ProfiledHardware
+    from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+    from galvatron_tpu.search.theoretical import analytic_model_costs
+
+    eng = SearchEngine(
+        analytic_model_costs(CFG), ProfiledHardware(), num_layers=4,
+        space=SearchSpace(world_size=8), memory_budget_mb=4096.0,
+        model_config=CFG, model_name="unit",
+    )
+    r = eng.search([8], max_chunks=2)
+    assert r is not None
+    out = tmp_path / "cfg.json"
+    eng.save_result(r, str(out))
+    with open(out) as f:
+        saved = json.load(f)
+    assert set(saved) <= KNOWN_KEYS, set(saved) - KNOWN_KEYS
+    assert saved["num_devices"] == 8 and saved["model_size"] == "unit"
+
+
+# ---------------------------------------------------------------------------
+# search → check round trip (self-check closure over a few topologies)
+# ---------------------------------------------------------------------------
+
+
+def _roundtrip(cfg, worlds, bszs, budget_mb, tmp_path, tag, **space_kw):
+    from galvatron_tpu.search.cost_model import ProfiledHardware
+    from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+    from galvatron_tpu.search.theoretical import analytic_model_costs
+
+    costs = analytic_model_costs(cfg)
+    checked = 0
+    for world in worlds:
+        eng = SearchEngine(
+            costs, ProfiledHardware(), num_layers=cfg.total_layers,
+            space=SearchSpace(world_size=world, **space_kw),
+            memory_budget_mb=budget_mb, model_config=cfg, model_name="",
+        )
+        results = eng.search_topk(bszs, k=8, max_chunks=4)
+        assert results, f"{tag}: no feasible plan at world={world}"
+        for j, r in enumerate(results):
+            path = tmp_path / f"{tag}_{world}_{j}.json"
+            eng.save_result(r, str(path))  # emit-path self-check runs inside
+            diags = check_plan(
+                str(path), model_config=cfg, world_size=world,
+                memory_budget_mb=budget_mb,
+            )
+            assert diags == [], (
+                f"{tag} world={world} pp={r.config.pp}: emitted plan fails "
+                f"check-plan (search bug):\n{format_report(diags)}"
+            )
+            checked += 1
+    return checked
+
+
+def test_search_roundtrip_zero_diagnostics(tmp_path):
+    n = _roundtrip(CFG, (4, 8), [4, 8], 4096.0, tmp_path, "dense")
+    assert n >= 6  # several distinct (pp, chunks, schedule) plans got checked
+
+
+def test_search_roundtrip_encdec(tmp_path):
+    cfg = ModelConfig(
+        num_layers=2, enc_layers=2, enc_seq=32, num_heads=8, hidden_size=64,
+        vocab_size=1024, max_seq_len=64, causal=True,
+    )
+    _roundtrip(cfg, (8,), [8], 4096.0, tmp_path, "encdec")
+
+
+def test_search_respects_model_divisibility(tmp_path):
+    """GPT-2-XL class: 25 heads / 50257 vocab — neither splits over any
+    power of two, so the search must never emit tp>1 or vocab_tp>1 (the
+    emit self-check turns the old behavior into a hard failure)."""
+    cfg = ModelConfig(
+        num_layers=4, num_heads=25, hidden_size=400, vocab_size=50257,
+        max_seq_len=64,
+    )
+    n = _roundtrip(cfg, (8,), [8], 8192.0, tmp_path, "gpt2xl")
+    assert n > 0
+
+
+# ---------------------------------------------------------------------------
+# recompile guard
+# ---------------------------------------------------------------------------
+
+
+def test_recompile_guard_catches_induced_recompile():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    f(jnp.ones(3))
+    with recompile_guard(f):
+        f(jnp.zeros(3))  # same shape: cache hit
+    with pytest.raises(RecompileError) as ei:
+        with recompile_guard(f, label="shape sweep"):
+            f(jnp.ones(5))  # new shape: recompiles
+    assert "f" in str(ei.value) and "shape sweep" in str(ei.value)
+    with recompile_guard(f, allowed=1):
+        f(jnp.ones(7))  # explicit warmup allowance
+
+
+def test_recompile_guard_rejects_non_jitted():
+    with pytest.raises(TypeError):
+        with recompile_guard(lambda x: x):
+            pass
+    with pytest.raises(ValueError):
+        with recompile_guard():
+            pass
+
+
+def test_emitted_plan_with_shape_overrides_self_describes(tmp_path):
+    """A search run with shape overrides (CFG is a 4-layer model, but the
+    advertised model_size preset has 24) must emit a plan check-plan
+    validates with NO flags: the effective shape rides in model_config."""
+    from galvatron_tpu import cli
+    from galvatron_tpu.search.cost_model import ProfiledHardware
+    from galvatron_tpu.search.search_engine import SearchEngine, SearchSpace
+    from galvatron_tpu.search.theoretical import analytic_model_costs
+
+    eng = SearchEngine(
+        analytic_model_costs(CFG), ProfiledHardware(), num_layers=4,
+        space=SearchSpace(world_size=8), memory_budget_mb=4096.0,
+        model_config=CFG, model_name="llama-0.3b",
+    )
+    r = eng.search([8], max_chunks=2)
+    out = tmp_path / "override.json"
+    eng.save_result(r, str(out))
+    saved = json.load(open(out))
+    assert saved["model_config"]["num_layers"] == 4
+    # the budget rides along, so regenerated configs keep the GTA015 gate
+    assert saved["memory_constraint_gb"] == 4.0
+    assert cli.main(["check-plan", str(out), "--strict", "1"]) == 0
+    # an EXPLICIT --model_size must validate against THAT model, not be
+    # silently overlaid by the plan's embedded shape (4 layers vs the
+    # 24-layer preset → GTA006)
+    assert cli.main(["check-plan", str(out), "--model_size", "llama-0.3b"]) == 1
+    # library calls resolve the same self-describing keys the CLI does:
+    # no-arg check_plan runs the FULL check set, not a structural subset
+    assert check_plan(str(out)) == []
+    d = saved.copy()
+    d["tp_sizes_enc"] = ",".join(["16"] * 4)
+    assert "GTA004" in codes(check_plan(d))  # world came from num_devices
+    # garbage embedded shape values are dropped, never crash the checker
+    d = saved.copy()
+    d["model_config"] = dict(saved["model_config"], num_layers="4x")
+    check_plan(d)  # must not raise
+    d["model_config"]["num_layers"] = "4"  # string-typed int coerces
+    assert check_plan(d) == []
+
+
+def test_search_space_not_mutated_across_models():
+    """One SearchSpace reused for two engines: the first model's
+    divisibility limits must not leak into the second's candidate space
+    (or back into the caller's object)."""
+    from galvatron_tpu.search.cost_model import ProfiledHardware
+    from galvatron_tpu.search.search_engine import (
+        SearchEngine, SearchSpace, generate_layer_strategies,
+    )
+    from galvatron_tpu.search.theoretical import analytic_model_costs
+
+    space = SearchSpace(world_size=8)
+    odd = ModelConfig(num_layers=4, num_heads=25, hidden_size=400,
+                      vocab_size=50257, max_seq_len=64)
+    e1 = SearchEngine(analytic_model_costs(odd), ProfiledHardware(), 4,
+                      space, 4096.0, model_config=odd)
+    assert space.num_heads == 0 and space.vocab_size == 0  # caller untouched
+    assert all(s.tp == 1 for s in generate_layer_strategies(e1.space, 1))
+    e2 = SearchEngine(analytic_model_costs(CFG), ProfiledHardware(), 4,
+                      space, 4096.0, model_config=CFG)
+    assert any(s.tp == 2 for s in generate_layer_strategies(e2.space, 1))
+
+
+def test_trainer_refuses_invalid_plan_before_mesh(tmp_path):
+    """Startup fail-fast: the diagnostic surfaces before any mesh/runtime
+    is built, for both the JSON path and the flags path."""
+    from galvatron_tpu.core.arguments import initialize_galvatron
+    from galvatron_tpu.core.trainer import train
+
+    bad = HybridParallelConfig.uniform(
+        4, pp=2, tp=2, sp=False, chunks=4,
+        pipeline_type="pipedream_flush", vocab_tp=2,
+    )
+    p = tmp_path / "bad.json"
+    bad.save(str(p))
+    ns = initialize_galvatron("train", [
+        "--model_size", "llama-0.3b", "--num_layers", "4",
+        "--train_iters", "1", "--galvatron_config_path", str(p),
+    ])
+    with pytest.raises(PlanError) as ei:
+        train(ns, verbose=False)
+    assert "GTA012" in str(ei.value) and str(p) in str(ei.value)
+    ns2 = initialize_galvatron("train", [
+        "--model_size", "llama-0.3b", "--num_layers", "4",
+        "--train_iters", "1", "--pp_deg", "2", "--global_tp_deg", "8",
+    ])
+    with pytest.raises(PlanError) as ei2:
+        train(ns2, verbose=False)
+    assert "GTA004" in str(ei2.value)
+
+
+def test_check_plan_cli_mode(tmp_path, capsys):
+    """`cli check-plan`: exit 1 on errors, 0 on clean, strict mode gates
+    warnings, and self-describing JSON keys supply model/world defaults."""
+    from galvatron_tpu import cli
+
+    good = HybridParallelConfig.uniform(CFG.total_layers, tp=2)
+    gd = good.to_json_dict()
+    gd.update(model_size="llama-0.3b", num_devices=8)
+    gp = tmp_path / "good.json"
+    with open(gp, "w") as f:
+        json.dump(gd, f)
+    # llama-0.3b preset has 24 layers; our plan has 4 → the CLI must pick
+    # the model up from the JSON and flag the mismatch
+    assert cli.main(["check-plan", str(gp)]) == 1
+    out = capsys.readouterr().out
+    assert "GTA006" in out
+    # with the matching override the plan is clean
+    assert cli.main(["check-plan", str(gp), "--num_layers", "4"]) == 0
+    # a typo'd key passes by default but fails --strict
+    gd["mlp_recompue"] = "x"
+    with open(gp, "w") as f:
+        json.dump(gd, f)
+    capsys.readouterr()
+    assert cli.main(["check-plan", str(gp), "--num_layers", "4"]) == 0
+    assert "GTA001" in capsys.readouterr().out
+    assert cli.main(["check-plan", str(gp), "--num_layers", "4",
+                     "--strict", "1"]) == 1
+
+
+def test_diagnostic_codes_documented():
+    """DESIGN.md's diagnostic table and the registry must not drift."""
+    import os
+
+    design = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                          "docs", "DESIGN.md")
+    with open(design) as f:
+        text = f.read()
+    missing = [c for c in CODES if c not in text]
+    assert not missing, f"codes missing from DESIGN.md: {missing}"

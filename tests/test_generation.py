@@ -120,19 +120,19 @@ def test_traced_sampling_params_do_not_recompile():
     params = modeling.init_model_params(jax.random.key(0), cfg)
     prompt = [1, 2, 3, 4, 5]
     kw = dict(max_new_tokens=3, top_k=2)
+    from galvatron_tpu.analysis import recompile_guard
+
     generation.generate_np(params, cfg, [prompt], temperature=0.5, top_p=0.5, **kw)
-    n0 = generation.generate._cache_size()
-    for temp, top_p in [(0.1, 0.3), (0.9, 0.95), (2.0, 0.5), (0.7, 0.2)]:
-        generation.generate_np(params, cfg, [prompt], temperature=temp,
-                               top_p=top_p, **kw)
-    assert generation.generate._cache_size() == n0
+    with recompile_guard(generation.generate, label="nucleus param sweep"):
+        for temp, top_p in [(0.1, 0.3), (0.9, 0.95), (2.0, 0.5), (0.7, 0.2)]:
+            generation.generate_np(params, cfg, [prompt], temperature=temp,
+                                   top_p=top_p, **kw)
     # the greedy/no-nucleus program is a second entry (use_top_p is static),
     # but sweeping temperature within it stays flat too
     generation.generate_np(params, cfg, [prompt], temperature=0.5, **kw)
-    n1 = generation.generate._cache_size()
-    for temp in (0.0, 0.3, 1.5):
-        generation.generate_np(params, cfg, [prompt], temperature=temp, **kw)
-    assert generation.generate._cache_size() == n1
+    with recompile_guard(generation.generate, label="greedy temp sweep"):
+        for temp in (0.0, 0.3, 1.5):
+            generation.generate_np(params, cfg, [prompt], temperature=temp, **kw)
 
 
 def test_dataloader_start_batch_equivalence():

@@ -233,15 +233,15 @@ def test_prefill_window_at_slot_end(params):
 
 def test_engine_jit_cache_stays_bounded(params):
     """The whole point of fixed shapes: traffic of any mix compiles exactly
-    one prefill program and one decode program."""
+    one prefill program and one decode program (recompile_guard raises,
+    naming the offender, if any traffic mix grows the cache)."""
+    from galvatron_tpu.analysis import recompile_guard
+
     with Engine(params, CFG, num_slots=2, prefill_chunk=4) as eng:
         eng.generate(_prompts(3, seed=6), max_new_tokens=3)
-        pre0 = _prefill_chunk._cache_size()
-        dec0 = _decode_step._cache_size()
-        eng.generate(_prompts(4, lo=5, hi=13, seed=7), max_new_tokens=5,
-                     temperature=0.7, top_k=3, top_p=0.9)
-        assert _prefill_chunk._cache_size() == pre0
-        assert _decode_step._cache_size() == dec0
+        with recompile_guard(_prefill_chunk, _decode_step, label="traffic mix"):
+            eng.generate(_prompts(4, lo=5, hi=13, seed=7), max_new_tokens=5,
+                         temperature=0.7, top_k=3, top_p=0.9)
 
 
 def test_slotwise_forward_matches_scalar_offset(params):
