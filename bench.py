@@ -240,6 +240,41 @@ def main():
             )
 
     fwd = layer_diff_ms(base, bsz, seq, l1, l2, rounds=rounds, train=False)
+
+    # per-phase breakdown + utilization (obs/stepstats.py): the perf
+    # trajectory starts with attribution — where a layer's time goes (fwd vs
+    # bwd) and how far from the chip's peak it sits — not just a throughput
+    # scalar. MFU uses model FLOPs; on hosts with no known peak (CPU) the
+    # mfu fields are omitted rather than invented. Failure-isolated like the
+    # other non-headline sections.
+    try:
+        from galvatron_tpu.obs import stepstats as ss
+
+        flops_fwd = ss.layer_fwd_flops_per_token(base, seq) * seq  # /layer/sample
+        peak = ss.peak_flops_per_device()
+        extra = {"fwd_ms": round(fwd, 4), "fwdbwd_ms": round(fwdbwd, 4),
+                 "flops_fwd_per_layer_per_sample": flops_fwd}
+        if fwd > 0:
+            extra["achieved_fwd_tflops"] = round(flops_fwd / (fwd / 1e3) / 1e12, 3)
+            if peak:
+                extra["mfu_fwd"] = round(flops_fwd / (fwd / 1e3) / peak, 4)
+        if fwdbwd > 0 and fwd > 0:
+            extra["bwd_ms"] = round(fwdbwd - fwd, 4)
+            extra["bwd_over_fwd"] = round((fwdbwd - fwd) / fwd, 3)
+            extra["achieved_fwdbwd_tflops"] = round(
+                3.0 * flops_fwd / (fwdbwd / 1e3) / 1e12, 3
+            )
+            if peak:
+                extra["mfu_fwdbwd"] = round(3.0 * flops_fwd / (fwdbwd / 1e3) / peak, 4)
+        if peak:
+            extra["peak_tflops_per_device"] = round(peak / 1e12, 1)
+        emit("llama7b_shape_phase_breakdown", round(fwd, 4), "ms", **extra)
+    except Exception as e:
+        emit(
+            "llama7b_shape_phase_breakdown",
+            0, "ms", skipped=f"{type(e).__name__}: {e}"[:200],
+        )
+
     # headline LAST: single-line consumers (the driver) parse the tail line
     emit(
         "llama7b_shape_fwd_ms_per_layer_per_sample_bf16",

@@ -11,6 +11,9 @@ models/<name>/{train_dist,search_dist,profiler}.py + profile_hardware):
                     bad strategy JSON in milliseconds with stable GTA…
                     diagnostics — no device, no XLA compile; CI runs it over
                     configs/
+  trace-export      convert a crash flight-recorder dump (flight_<ts>.json)
+                    or raw span records into Chrome trace-event JSON loadable
+                    in Perfetto / chrome://tracing (obs/tracing.py)
   generate          KV-cache text generation from a checkpoint (or random init)
   serve             REST generation server (text_generation_server equivalent);
                     continuous-batching engine by default (--num_slots,
@@ -303,6 +306,10 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         ns = initialize_galvatron("check_plan", rest, model_default)
         return _check_plan_mode(ns)
 
+    if mode == "trace-export":
+        ns = initialize_galvatron("trace_export", rest, model_default)
+        return _trace_export_mode(ns)
+
     if mode in ("generate", "serve"):
         import jax
 
@@ -371,9 +378,41 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
 
     print(
         f"unknown mode {mode!r}; expected "
-        "train|search|profile|profile-hardware|check-plan|generate|serve|export-hf"
+        "train|search|profile|profile-hardware|check-plan|trace-export|"
+        "generate|serve|export-hf"
     )
     return 2
+
+
+def _trace_export_mode(ns) -> int:
+    """Flight dump / span records → Chrome trace-event JSON (Perfetto)."""
+    from galvatron_tpu.obs.flight import FLIGHT_SCHEMA
+    from galvatron_tpu.obs.tracing import chrome_trace
+
+    try:
+        with open(ns.input_path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot read {ns.input_path}: {e}")
+        return 2
+    if isinstance(doc, dict) and doc.get("schema") == FLIGHT_SCHEMA:
+        spans = doc.get("spans", [])
+    elif isinstance(doc, dict) and "traceEvents" in doc:
+        print(f"{ns.input_path} is already Chrome trace-event JSON; nothing to do")
+        return 2
+    elif isinstance(doc, list):
+        spans = doc
+    else:
+        print(
+            f"error: {ns.input_path} is neither a {FLIGHT_SCHEMA} flight dump "
+            "nor a JSON list of span records"
+        )
+        return 2
+    out = ns.output or ns.input_path + ".trace.json"
+    with open(out, "w") as f:
+        json.dump(chrome_trace(spans), f)
+    print(f"wrote {len(spans)} events → {out} (load in Perfetto or chrome://tracing)")
+    return 0
 
 
 def _check_plan_mode(ns) -> int:

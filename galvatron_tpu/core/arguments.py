@@ -83,6 +83,36 @@ def _add_training_args(p: argparse.ArgumentParser):
                    help="capture a jax.profiler trace of the measured "
                    "iterations to this directory (XLA op/kernel timeline; "
                    "the torch.profiler/CUDA-events counterpart, SURVEY §5)")
+    # observability layer (obs/, DESIGN.md § Observability)
+    g.add_argument("--trace_spans", type=str, default=None,
+                   help="enable host-side span tracing (step/data/fwd_bwd/"
+                   "sync/ckpt + synthetic pipeline stage spans) and export "
+                   "a Chrome trace-event / Perfetto JSON to this path on "
+                   "exit; adds one host sync per iteration while enabled "
+                   "(OFF = zero added syncs)")
+    g.add_argument("--trace_ring", type=int, default=4096,
+                   help="span-tracer ring capacity (also the flight "
+                   "recorder's last-N window)")
+    g.add_argument("--profile_steps", type=str, default=None,
+                   metavar="START:STOP",
+                   help="capture a jax.profiler window over iterations "
+                   "[START, STOP) into --trace_dir (or a temp dir) — the "
+                   "bounded alternative to tracing the whole run")
+    g.add_argument("--obs_port", type=int, default=0,
+                   help="serve GET /metrics (Prometheus text) + /healthz on "
+                   "this port (loopback) from a sidecar thread for headless "
+                   "training runs; implies one host sync per iteration so "
+                   "the loss/iter_ms/MFU gauges are live (0 = off)")
+    g.add_argument("--flight_dir", type=str, default=None,
+                   help="crash flight-recorder directory: an exceptional "
+                   "exit dumps the last --trace_ring spans to "
+                   "flight_<ts>.json here. Arms span tracing by itself "
+                   "(same per-iter sync as --trace_spans); with only "
+                   "--trace_spans set, dumps land alongside that path")
+    g.add_argument("--peak_tflops", type=float, default=0.0,
+                   help="per-device peak dense TFLOP/s for MFU (default: "
+                   "auto from the TPU generation, or the "
+                   "GALVATRON_PEAK_TFLOPS env; unknown = mfu omitted)")
     # hybrid-parallel GLOBAL flags (used when no galvatron_config_path)
     g.add_argument("--pp_deg", type=int, default=1)
     g.add_argument("--pp_division", type=_int_list, default=None,
@@ -273,6 +303,16 @@ def _add_check_plan_args(p: argparse.ArgumentParser):
                    help="1 = skip the eval_shape/AbstractMesh sharding pass")
 
 
+def _add_trace_export_args(p: argparse.ArgumentParser):
+    """Span/flight dump → Chrome trace-event JSON (obs/tracing.py)."""
+    g = p.add_argument_group("trace-export")
+    g.add_argument("input_path",
+                   help="a flight_<ts>.json dump (obs/flight.py) or a raw "
+                   "span-record JSON list")
+    g.add_argument("--output", "-o", type=str, default=None,
+                   help="output path (default: <input>.trace.json)")
+
+
 def _add_hardware_args(p: argparse.ArgumentParser):
     """(reference: galvatron_profile_hardware_args, core/arguments.py:186-223)"""
     g = p.add_argument_group("profile-hardware")
@@ -305,6 +345,8 @@ def build_parser(mode: str, model_default: Optional[str] = None) -> argparse.Arg
         # given — unless a per-family entry pinned its default above
         if not model_default:
             p.set_defaults(model_size=None)
+    elif mode == "trace_export":
+        _add_trace_export_args(p)
     elif mode in ("generate", "serve", "export_hf"):
         _add_generate_args(p)
     else:

@@ -50,6 +50,7 @@ import numpy as np
 
 from galvatron_tpu.core import faults
 from galvatron_tpu.core.retry import with_retries
+from galvatron_tpu.obs.tracing import tracer as _obs_tracer
 
 MANIFEST_NAME = "manifest.json"
 MANIFEST_VERSION = 1
@@ -454,6 +455,16 @@ def save_checkpoint(
     steps after the new one lands. ``meta`` (JSON-serializable) rides along
     in the manifest — the trainer records batches-consumed there, which
     diverges from the step count once anomaly skips happen."""
+    # observability: saves are the dominant non-step pause in a training
+    # timeline — one span per save (tracing off: no-op singleton, zero cost)
+    with _obs_tracer.span("ckpt_save", step=int(step)):
+        return _save_checkpoint_impl(ckpt_dir, state, step, keep_last_n, meta)
+
+
+def _save_checkpoint_impl(
+    ckpt_dir: str, state: Any, step: int, keep_last_n: int = 0,
+    meta: Optional[Dict[str, Any]] = None,
+) -> str:
     ocp = _ocp()
     base = os.path.abspath(ckpt_dir)
     final = os.path.join(base, f"step_{step}")
@@ -540,6 +551,13 @@ def restore_checkpoint(ckpt_dir: str, abstract_state: Any, step: Optional[int] =
     interleaved-only code no longer restores, and a silent reshape would
     scramble q/k/v (the interleave is per head-group, not per slot). Such a
     restore fails with an explicit migration error instead."""
+    with _obs_tracer.span("ckpt_restore", step=-1 if step is None else int(step)):
+        return _restore_checkpoint_impl(ckpt_dir, abstract_state, step)
+
+
+def _restore_checkpoint_impl(
+    ckpt_dir: str, abstract_state: Any, step: Optional[int] = None
+) -> Any:
     ocp = _ocp()
     if step is None:
         step = latest_step(ckpt_dir)
@@ -750,6 +768,7 @@ def _try_newest_first(
             print(f"checkpoint step {s} corrupt, falling back: {str(e)[:200]}")
             if metrics is not None:
                 metrics.log("ckpt_fallback", step=s, error=str(e)[:300])
+            _obs_tracer.instant("ckpt_fallback", step=s, error=str(e)[:120])
             if quarantine_base is not None and not isinstance(
                 e, CheckpointVerificationIOError
             ):

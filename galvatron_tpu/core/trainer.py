@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import argparse
 import math
+import os
+import time
 
 import jax
 import numpy as np
@@ -36,6 +38,68 @@ from galvatron_tpu.profiling.runtime import RuntimeProfiler
 
 
 def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
+    from galvatron_tpu.obs import tracing as obs_tracing
+
+    # span tracer lifecycle wrapper: enable happens out here so that a
+    # setup failure ANYWHERE in _train_impl (corrupt restore, loader build,
+    # sidecar bind, ...) cannot leak the enabled process-wide singleton into
+    # a later run — which would silently force per-iter syncs and record
+    # spans nobody exports. --flight_dir arms tracing too: a flight
+    # recorder with no span ring would be a silent no-op exactly when the
+    # operator asked for crash forensics.
+    tracer = obs_tracing.tracer
+    tracer_owned = False
+    if getattr(ns, "trace_spans", None) or getattr(ns, "flight_dir", None):
+        tracer.enable(capacity=getattr(ns, "trace_ring", 4096))
+        tracer_owned = True
+    try:
+        return _train_impl(ns, verbose, tracer, tracer_owned)
+    except BaseException as e:
+        # _train_impl's own finally exports + dumps on every path that
+        # reached the training loop; the tracer still being enabled here
+        # means SETUP crashed before that try was entered — the forensics
+        # the flags promise (a corrupt-restore fallback trail, most
+        # commonly) must still land before the ring is dropped
+        if tracer_owned and tracer.enabled:
+            _export_obs_artifacts(
+                ns, tracer, e, extra={"phase": "setup"}, verbose=verbose
+            )
+        raise
+    finally:
+        if tracer_owned and tracer.enabled:
+            tracer.disable()
+            tracer.clear()
+
+
+def _export_obs_artifacts(ns, tracer, exc, extra=None, verbose=True) -> None:
+    """Flight-recorder dump (exceptional exits only) + span-trace export.
+    Best-effort by contract: callers sit in crash/teardown paths where an
+    observability failure must never mask the original exception."""
+    try:
+        if exc is not None:
+            fdir = getattr(ns, "flight_dir", None)
+            if not fdir and getattr(ns, "trace_spans", None):
+                fdir = os.path.dirname(os.path.abspath(ns.trace_spans))
+            if fdir:
+                from galvatron_tpu.obs.flight import dump_flight
+
+                fpath = dump_flight(
+                    fdir, tracer,
+                    reason=f"{type(exc).__name__}: {str(exc)[:200]}",
+                    extra=extra,
+                )
+                if fpath:
+                    print(f"flight recorder → {fpath}")
+        if getattr(ns, "trace_spans", None) and jax.process_index() == 0:
+            out = tracer.export_chrome_trace(ns.trace_spans)
+            if verbose:
+                print(f"span trace → {out}")
+    except Exception as obs_err:  # noqa: BLE001 — observability is best-effort
+        print(f"observability export failed: {obs_err!r}")
+
+
+def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
+                tracer_owned: bool) -> dict:
     faults.init_from_env()  # chaos hooks: no-ops unless GALVATRON_FAULTS is set
     if getattr(ns, "multihost", 0):
         # join the multi-host job (TPU pods: coordinator/process id are
@@ -139,11 +203,18 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
         global_batch_size=ns.global_train_batch_size, seq_len=seq,
     )
 
+    from galvatron_tpu.obs import tracing as obs_tracing
     from galvatron_tpu.utils.metrics import MetricsLogger
 
     # opened before restore so a corrupt-latest fallback (ckpt_fallback) is
-    # visible in the same JSONL stream as the training events
-    metrics = MetricsLogger(getattr(ns, "metrics_path", None))
+    # visible in the same JSONL stream as the training events. Multihost:
+    # O_APPEND does not serialize cross-process writers on network
+    # filesystems, so the JSONL sink is process-0-only (the other hosts get
+    # a no-op logger; see MetricsLogger's docstring).
+    metrics_path = getattr(ns, "metrics_path", None)
+    if metrics_path and jax.process_index() != 0:
+        metrics_path = None
+    metrics = MetricsLogger(metrics_path)
     start_step = 0
     batch_offset = 0
     if ns.load and latest_step(ns.load) is not None:
@@ -190,16 +261,77 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
     # anomaly sentinel (which must classify the realized loss). Otherwise let
     # dispatch run free and time a window (TPU-idiomatic async training).
     sentinel = AnomalySentinel(getattr(ns, "anomaly_max_skips", 0))
+    # span tracing syncs each iteration too: spans measure realized step
+    # time, and an async span would just time dispatch (documented
+    # observational overhead of tracing ON)
+    # the sidecar is a per-iteration observable too: without the sync its
+    # loss/iter_ms/mfu gauges would stay None (windowed profiling measures
+    # nothing until the end of the run) — an operator who opened a metrics
+    # port asked for live numbers. Process-0-gated like the server itself.
+    obs_on = bool(getattr(ns, "obs_port", 0)) and jax.process_index() == 0
+    # metrics.path, not ns.metrics_path: on a pod only process 0 owns the
+    # JSONL sink — the other hosts must not pay a per-iter sync for a no-op
+    # logger (their sentinel/tracing terms still apply to all hosts alike)
     sync_each = bool(
-        ns.check_loss or getattr(ns, "metrics_path", None) or sentinel.armed
+        ns.check_loss or metrics.path or sentinel.armed or tracer.enabled
+        or obs_on
     )
     prof = RuntimeProfiler(warmup_iters=1, windowed=not sync_each)
+    # step accounting (obs/stepstats.py): tokens/s + achieved TFLOP/s + MFU
+    # per train_iter record and for the sidecar/summary — derived, no
+    # extra measurement
+    from galvatron_tpu.obs.stepstats import StepStats
+
+    stepstats = StepStats(
+        cfg, ns.global_train_batch_size, seq, hp=hp,
+        peak_tflops_override=getattr(ns, "peak_tflops", 0.0),
+    )
     # jax.profiler trace of the training loop (op/kernel timeline viewable in
     # TensorBoard/Perfetto) — the tracing counterpart of the reference's
     # torch.profiler + CUDA-event instrumentation (SURVEY §5). Started after
     # the warmup iteration so compile/warmup spans don't drown the timeline.
     trace_dir = getattr(ns, "trace_dir", None)
     trace_started = False
+    # step-bounded profiler window (--profile_steps A:B) — the precise
+    # alternative to the whole-run --trace_dir capture; when both are given
+    # the window wins (profiler traces cannot nest)
+    pw = None
+    if getattr(ns, "profile_steps", None):
+        import tempfile
+
+        from galvatron_tpu.obs.flight import ProfilerWindow, parse_profile_steps
+
+        a, b = parse_profile_steps(ns.profile_steps)
+        pw = ProfilerWindow(
+            trace_dir or tempfile.mkdtemp(prefix="galvatron_profile_"), a, b
+        )
+    # pipeline schedules run inside ONE jitted scan — per-stage activity is
+    # rendered from the schedule's structural clock model instead
+    # (obs/tracing.emit_tick_spans; spans are labeled synthetic)
+    sched_ticks = None
+    if tracer.enabled and hp.pp > 1 and hp.vpp == 1:
+        if hp.pipeline_type == "pipedream_flush":
+            from galvatron_tpu.parallel.pipeline_1f1b import (
+                pipedream_schedule_ticks as _schedule_ticks,
+            )
+        else:
+            from galvatron_tpu.parallel.pipeline import (
+                gpipe_schedule_ticks as _schedule_ticks,
+            )
+        sched_ticks = _schedule_ticks(hp.pp, max(1, hp.chunks))
+    obs_server = train_obs = None
+    if obs_on:
+        # headless-run scrape endpoint: GET /metrics + /healthz on a sidecar
+        # thread (process 0 only on a pod — one scrape target per job).
+        # Started LAST in setup: everything after this point down to the
+        # main try is pure arithmetic, so a setup failure cannot strand the
+        # listener thread on its port
+        from galvatron_tpu.obs.prom import ObsServer, TrainStats
+
+        train_obs = TrainStats()
+        obs_server = ObsServer(train_obs.render, port=ns.obs_port)
+        if verbose:
+            print(f"obs sidecar: http://127.0.0.1:{obs_server.port}/metrics")
     losses = []
     # consumed-samples bookkeeping: under rampup, replay the schedule from
     # step 0 so a resumed run sees exactly the sizes (and per-size stream
@@ -240,106 +372,156 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
                         print(f"signal {exit_handler.signaled} received; stopping at iter {it}")
                     break
                 # start after the warmup/compile iteration so the timeline
-                # shows steady-state steps, not one giant compile span
-                if trace_dir and not trace_started and iters_run >= 1:
+                # shows steady-state steps, not one giant compile span (a
+                # --profile_steps window supersedes the whole-run capture:
+                # profiler traces cannot nest)
+                if trace_dir and pw is None and not trace_started and iters_run >= 1:
                     jax.profiler.start_trace(trace_dir)
                     trace_started = True
-                if rampup is not None:
-                    bs = rampup(consumed)
-                    if bs != cur_bs or it == batch_offset:
-                        cur_bs = bs
-                        loader = build_dataloader(
-                            cfg, bs, seq, seed=ns.seed + bs,
-                            start_batch=batches_at_size.get(bs, 0),
-                            data_path=getattr(ns, "data_path", None),
-                        )
-                    batches_at_size[bs] = batches_at_size.get(bs, 0) + 1
-                    consumed += bs
-                else:
-                    consumed += cur_bs
-                batch = rt.shard_batch(next(loader))
-                # counted only once the batch is actually consumed: iters_run
-                # feeds the batches_consumed manifest record, and a crash in
-                # the fetch itself must not make resume skip a real batch
-                iters_run += 1
-                # rollback copy — the train step donates its input buffers,
-                # so a discarded update is unrecoverable without it (None
-                # when the sentinel is disarmed: no memory cost)
-                snap = sentinel.snapshot(state)
-                prof.begin_iter()
-                new_state, loss = rt.train_step(state, batch)
-                # rebind NOW: the old buffers were donated into train_step,
-                # so `state` must never name them again — an XLA error
-                # surfacing at float(loss) below would otherwise hand the
-                # emergency-save path deleted arrays
-                state = new_state
-                # always hand end_iter the loss: per-iter mode syncs each
-                # step (sync_each implies that's wanted); windowed mode syncs
-                # ONCE, to close the warmup — without it the window would
-                # open while warmup compute is still in flight and overstate
-                # avg iter time
-                prof.end_iter(loss)
-                loss_val = float(loss) if sync_each else None  # gta: disable=GTL101 — deliberate sync, gated by sync_each (off unless per-iter observables or the anomaly sentinel need the realized loss)
-                # injection sits OUTSIDE the armed gate: chaos jobs force a
-                # NaN observation with or without the sentinel (a disarmed
-                # run must drive the stringified-JSONL divergence path too)
-                if loss_val is not None and faults.force_nan(it):
-                    loss_val = float("nan")
-                if sentinel.armed:
-                    verdict = sentinel.observe(loss_val, it)
-                    if verdict != "ok":
-                        # discard the poisoned update: drop the batch, roll
-                        # the state back to the pre-step snapshot
-                        state = snap
-                        if verdict == "abort":
-                            raise AnomalyAbort(
-                                it, sentinel.consecutive, sentinel.max_skips
+                if pw is not None:
+                    # stop is checked at the loop TOP (previous iteration's
+                    # index) so an anomaly-skip `continue` cannot carry the
+                    # window past its STOP boundary; the run-end close lives
+                    # in the finally below
+                    pw.maybe_stop(it - 1, verbose=verbose)
+                    pw.maybe_start(it)
+                step_sp = tracer.span("step", step=it)
+                with step_sp:
+                    if rampup is not None:
+                        bs = rampup(consumed)
+                        if bs != cur_bs or it == batch_offset:
+                            cur_bs = bs
+                            loader = build_dataloader(
+                                cfg, bs, seq, seed=ns.seed + bs,
+                                start_batch=batches_at_size.get(bs, 0),
+                                data_path=getattr(ns, "data_path", None),
                             )
-                        # loss serialized as a string: bare NaN/Infinity is
-                        # not valid JSON and would break strict JSONL readers
-                        metrics.log(
-                            "anomaly_skip", step=it, loss=str(loss_val),
-                            consecutive=sentinel.consecutive,
+                        batches_at_size[bs] = batches_at_size.get(bs, 0) + 1
+                        consumed += bs
+                    else:
+                        consumed += cur_bs
+                    with tracer.span("data", step=it):
+                        batch = rt.shard_batch(next(loader))
+                    # counted only once the batch is actually consumed: iters_run
+                    # feeds the batches_consumed manifest record, and a crash in
+                    # the fetch itself must not make resume skip a real batch
+                    iters_run += 1
+                    # rollback copy — the train step donates its input buffers,
+                    # so a discarded update is unrecoverable without it (None
+                    # when the sentinel is disarmed: no memory cost)
+                    snap = sentinel.snapshot(state)
+                    prof.begin_iter()
+                    t_step0 = time.perf_counter() if sched_ticks is not None else None
+                    with tracer.span("fwd_bwd", step=it):
+                        new_state, loss = rt.train_step(state, batch)
+                    # rebind NOW: the old buffers were donated into train_step,
+                    # so `state` must never name them again — an XLA error
+                    # surfacing at float(loss) below would otherwise hand the
+                    # emergency-save path deleted arrays
+                    state = new_state
+                    with tracer.span("sync", step=it) as sync_sp:
+                        # always hand end_iter the loss: per-iter mode syncs each
+                        # step (sync_each implies that's wanted); windowed mode syncs
+                        # ONCE, to close the warmup — without it the window would
+                        # open while warmup compute is still in flight and overstate
+                        # avg iter time
+                        prof.end_iter(loss)
+                        loss_val = float(loss) if sync_each else None  # gta: disable=GTL101 — deliberate sync, gated by sync_each (off unless per-iter observables, span tracing, or the anomaly sentinel need the realized loss)
+                        sync_sp.sync(loss)
+                    if sched_ticks is not None:
+                        # the fwd_bwd+sync window is the realized step; render
+                        # the schedule's per-stage tick grid onto it so 1F1B
+                        # bubbles are visible on the timeline
+                        obs_tracing.emit_tick_spans(
+                            tracer, sched_ticks[0], sched_ticks[1],
+                            tracer.pc_to_us(t_step0),
+                            (time.perf_counter() - t_step0) * 1e6, step=it,
                         )
+                    # injection sits OUTSIDE the armed gate: chaos jobs force a
+                    # NaN observation with or without the sentinel (a disarmed
+                    # run must drive the stringified-JSONL divergence path too)
+                    if loss_val is not None and faults.force_nan(it):
+                        loss_val = float("nan")
+                    if sentinel.armed:
+                        verdict = sentinel.observe(loss_val, it)
+                        if verdict != "ok":
+                            # discard the poisoned update: drop the batch, roll
+                            # the state back to the pre-step snapshot
+                            state = snap
+                            if verdict == "abort":
+                                raise AnomalyAbort(
+                                    it, sentinel.consecutive, sentinel.max_skips
+                                )
+                            # loss serialized as a string: bare NaN/Infinity is
+                            # not valid JSON and would break strict JSONL readers
+                            metrics.log(
+                                "anomaly_skip", step=it, loss=str(loss_val),
+                                consecutive=sentinel.consecutive,
+                            )
+                            tracer.instant(
+                                "anomaly_skip", step=it, loss=str(loss_val),
+                                consecutive=sentinel.consecutive,
+                            )
+                            if train_obs is not None:
+                                train_obs.anomaly_skips = sentinel.total_skips
+                            if verbose:
+                                print(
+                                    f"iter {it}: non-finite loss; update skipped "
+                                    f"({sentinel.consecutive}/{sentinel.max_skips})"
+                                )
+                            continue
+                    if sync_each:
+                        losses.append(loss_val)
                         if verbose:
-                            print(
-                                f"iter {it}: non-finite loss; update skipped "
-                                f"({sentinel.consecutive}/{sentinel.max_skips})"
-                            )
-                        continue
-                if sync_each:
-                    losses.append(loss_val)
-                    if verbose:
-                        print(f"iter {it}: loss {loss_val:.4f}")
-                if metrics.path:
-                    metrics.log(
-                        "train_iter", step=it,
-                        # a disarmed run can still diverge: bare NaN/Infinity
-                        # is not valid JSON (same reason anomaly_skip
-                        # stringifies), so non-finite losses log as strings
-                        loss=(
-                            loss_val
-                            if loss_val is None or math.isfinite(loss_val)
-                            else str(loss_val)
-                        ),
-                        batch_size=cur_bs,
-                        iter_ms=(prof.iter_times_ms[-1] if prof.iter_times_ms else None),
+                            print(f"iter {it}: loss {loss_val:.4f}")
+                    iter_ms = prof.iter_times_ms[-1] if prof.iter_times_ms else None
+                    stat = (
+                        stepstats.per_iter(iter_ms, cur_bs)
+                        if metrics.path or train_obs is not None
+                        else {}
                     )
-                if next_save_at is not None and (it + 1) >= next_save_at:
-                    # dir name = the state's actual optimizer step: skipped
-                    # iterations (this run's AND pre-crash ones) advanced
-                    # `it` but not the state, and the exit-save dedup
-                    # compares latest_step against it
-                    actual_step = it + 1 - prior_skips - sentinel.total_skips
-                    save_checkpoint_portable(
-                        ns.save, state, actual_step, rt, keep_last_n=keep_n,
-                        meta={"batches_consumed": batch_offset + iters_run},
-                    )
-                    next_save_at = (
-                        (it + 1) // ns.save_interval + 1
-                    ) * ns.save_interval
-                    if verbose:
-                        print(f"saved step {actual_step} → {ns.save}")
+                    if metrics.path:
+                        metrics.log(
+                            "train_iter", step=it,
+                            # a disarmed run can still diverge: bare NaN/Infinity
+                            # is not valid JSON (same reason anomaly_skip
+                            # stringifies), so non-finite losses log as strings
+                            loss=(
+                                loss_val
+                                if loss_val is None or math.isfinite(loss_val)
+                                else str(loss_val)
+                            ),
+                            batch_size=cur_bs,
+                            iter_ms=iter_ms,
+                            **stat,
+                        )
+                    if train_obs is not None:
+                        train_obs.iterations += 1
+                        if loss_val is not None:
+                            train_obs.last_loss = loss_val
+                        if iter_ms is not None:
+                            train_obs.last_iter_ms = iter_ms
+                            train_obs.tokens_per_s = stat.get("tokens_per_s")
+                            train_obs.tflops_per_device = stat.get("tflops_per_device")
+                            train_obs.mfu = stat.get("mfu")
+                            train_obs.hfu = stat.get("hfu")
+                    if next_save_at is not None and (it + 1) >= next_save_at:
+                        # dir name = the state's actual optimizer step: skipped
+                        # iterations (this run's AND pre-crash ones) advanced
+                        # `it` but not the state, and the exit-save dedup
+                        # compares latest_step against it
+                        actual_step = it + 1 - prior_skips - sentinel.total_skips
+                        save_checkpoint_portable(
+                            ns.save, state, actual_step, rt, keep_last_n=keep_n,
+                            meta={"batches_consumed": batch_offset + iters_run},
+                        )
+                        if train_obs is not None:
+                            train_obs.checkpoints_saved += 1
+                        next_save_at = (
+                            (it + 1) // ns.save_interval + 1
+                        ) * ns.save_interval
+                        if verbose:
+                            print(f"saved step {actual_step} → {ns.save}")
         prof.finish(loss if iters_run else None)
     except BaseException as e:
         train_exc = e
@@ -350,6 +532,8 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
         # a stop_trace failure (e.g. flushing to broken storage) must not
         # rob the crash path of its emergency checkpoint below, nor mask
         # the original training exception
+        if pw is not None:
+            pw.close(verbose=verbose)
         if trace_started:
             try:
                 jax.profiler.stop_trace()
@@ -422,6 +606,22 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
         finally:
             # crash runs flush their JSONL tail too
             metrics.close()
+        # observability teardown: flight dump on exceptional exits, span
+        # export, sidecar shutdown — all best-effort, never masking the
+        # original exception (the emergency checkpoint above already ran)
+        try:
+            _export_obs_artifacts(
+                ns, tracer, train_exc,
+                extra={"iter": batch_offset + iters_run}, verbose=verbose,
+            )
+        finally:
+            if obs_server is not None:
+                obs_server.close()
+            if tracer_owned:
+                # this run turned tracing on; turn it off (and drop the
+                # ring) so spans cannot leak into a later run in-process
+                tracer.disable()
+                tracer.clear()
     # throughput from actual samples processed (rampup runs at smaller sizes)
     avg_bs = (consumed - consumed_at_start) / iters_run if iters_run else 0
     # cost-model fidelity: predicted-vs-measured iteration time when training
@@ -440,7 +640,7 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
         except (OSError, ValueError):
             pass
     report = (
-        prof.report(avg_bs, seq, predicted_ms=predicted_ms)
+        prof.report(avg_bs, seq, predicted_ms=predicted_ms, step_stats=stepstats)
         if prof.iter_times_ms
         else ""
     )

@@ -1,0 +1,179 @@
+"""Crash flight recorder + programmatic jax.profiler capture windows.
+
+Flight recorder (MegaScale's event recorder, NSDI '24 §5): the tracer's
+bounded ring IS the recorder — the last N spans/instants before a crash.
+``dump_flight`` serializes it to ``flight_<ts>.json`` and is called from the
+trainer's PR 1 crash ``finally`` path, so every exceptional exit leaves the
+seconds-before-the-crash timeline on disk next to the emergency checkpoint.
+``galvatron_tpu.cli trace-export flight_*.json`` turns a dump back into a
+Perfetto-loadable trace.
+
+Profiler capture: ``--profile_steps A:B`` (trainer) and ``POST
+/profile?steps=N`` (server) open a bounded ``jax.profiler`` window — the
+full XLA op/kernel timeline for exactly the steps asked for, instead of the
+whole-run ``--trace_dir`` firehose. Backends without xprof support degrade
+to a logged warning: profiling is an observation, never a crash source.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+FLIGHT_SCHEMA = "galvatron-flight-v1"
+
+
+def dump_flight(
+    out_dir: str, trc, reason: str, extra: Optional[Dict[str, Any]] = None
+) -> Optional[str]:
+    """Write the tracer ring (+ context) to ``<out_dir>/flight_<ts>.json``.
+    Returns the path, or None when there is nothing to record (tracing was
+    never enabled and the ring is empty). Never raises — callers sit in
+    crash ``finally`` blocks where a dump failure must not mask the crash."""
+    try:
+        spans = trc.snapshot()
+        if not spans and not trc.enabled:
+            return None
+        os.makedirs(out_dir, exist_ok=True)
+        ts = time.strftime("%Y%m%d_%H%M%S")
+        path = os.path.join(out_dir, f"flight_{ts}_{os.getpid()}.json")
+        doc: Dict[str, Any] = {
+            "schema": FLIGHT_SCHEMA,
+            "wall_time": time.time(),
+            "epoch_wall": trc.epoch_wall,  # wall clock at span ts=0
+            "reason": reason,
+            "spans": spans,
+        }
+        if extra:
+            doc["extra"] = extra
+        try:
+            from galvatron_tpu.obs.stepstats import hbm_gauges
+
+            doc["hbm_bytes"] = hbm_gauges()
+        except Exception:
+            pass
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+    except Exception as e:  # noqa: BLE001 — crash-path best effort
+        print(f"flight-recorder dump failed: {e!r}")
+        return None
+
+
+def read_flight(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != FLIGHT_SCHEMA:
+        raise ValueError(f"{path}: not a {FLIGHT_SCHEMA} dump")
+    return doc
+
+
+def parse_profile_steps(spec: str) -> Tuple[int, int]:
+    """``"A:B"`` → (A, B): capture iterations A..B-1 (half-open, like range).
+    Validated loudly — a silently-ignored malformed window would look like a
+    backend limitation instead of a typo."""
+    m = re.fullmatch(r"(\d+):(\d+)", spec.strip())
+    if not m:
+        raise ValueError(
+            f"--profile_steps expects START:STOP (e.g. 3:6), got {spec!r}"
+        )
+    a, b = int(m.group(1)), int(m.group(2))
+    if b <= a:
+        raise ValueError(f"--profile_steps {spec!r}: STOP must be > START")
+    return a, b
+
+
+class ProfilerWindow:
+    """Step-bounded jax.profiler capture: ``maybe_start(it)`` /
+    ``maybe_stop(it)`` around the trainer iteration. A backend without xprof
+    (start_trace raising) disables the window with a warning and the run
+    continues untraced."""
+
+    def __init__(self, trace_dir: str, start_step: int, stop_step: int):
+        self.trace_dir = trace_dir
+        self.start_step = start_step
+        self.stop_step = stop_step
+        self.active = False
+        self.failed = False
+        self.done = False
+
+    def maybe_start(self, it: int) -> None:
+        # >= not ==: a resumed run whose batch offset already passed START
+        # must still capture (from where it is) rather than silently skip
+        if self.failed or self.active or self.done or it < self.start_step:
+            return
+        if it >= self.stop_step:
+            self.done = True  # resumed entirely past the window: nothing to do
+            return
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+            self.active = True
+        except Exception as e:  # noqa: BLE001 — degrade, don't crash training
+            self.failed = True
+            print(f"--profile_steps: backend lacks profiler support ({e!r}); "
+                  "continuing without capture")
+
+    def maybe_stop(self, it: int, verbose: bool = True) -> None:
+        if not self.active or it + 1 < self.stop_step:
+            return
+        self.close(verbose=verbose)
+
+    def close(self, verbose: bool = True) -> None:
+        """Idempotent stop — also called from the trainer ``finally`` so a
+        crash inside the window cannot wedge process-wide profiler state."""
+        if not self.active:
+            return
+        self.active = False
+        self.done = True
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            if verbose:
+                print(f"profiler window [{self.start_step}:{self.stop_step}) "
+                      f"→ {self.trace_dir}")
+        except Exception as e:  # noqa: BLE001
+            print(f"failed to close profiler window: {e!r}")
+
+
+def capture_profile(
+    trace_dir: str, n_steps: int, counter_fn: Callable[[], int],
+    timeout_s: float = 30.0, poll_s: float = 0.02,
+) -> Dict[str, Any]:
+    """On-demand capture (server ``POST /profile``): start a jax.profiler
+    trace, wait until ``counter_fn`` advances by ``n_steps`` (engine decode
+    iterations) or ``timeout_s`` elapses, stop, report what happened.
+    Raises RuntimeError when the backend cannot start a trace at all."""
+    import jax
+
+    start_count = counter_fn()
+    try:
+        jax.profiler.start_trace(trace_dir)
+    except Exception as e:
+        raise RuntimeError(f"profiler unavailable on this backend: {e!r}") from e
+    deadline = time.time() + timeout_s
+    try:
+        while counter_fn() - start_count < n_steps and time.time() < deadline:
+            time.sleep(poll_s)
+    finally:
+        captured = counter_fn() - start_count
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 — report, the capture dir may still be usable
+            return {"trace_dir": trace_dir, "steps_captured": captured,
+                    "requested": n_steps, "stop_error": repr(e)}
+    return {
+        "trace_dir": trace_dir,
+        "steps_captured": captured,
+        "requested": n_steps,
+        "timed_out": captured < n_steps,
+    }

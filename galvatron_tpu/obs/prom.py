@@ -1,0 +1,234 @@
+"""Prometheus text exposition (v0.0.4) + the headless-trainer sidecar.
+
+Renders the stats the system already keeps — ``utils.metrics.Counters``,
+``QuantileWindow`` readouts, arbitrary gauges — in the Prometheus text
+format, so a scraper pointed at ``GET /metrics`` (served by ``server.py``
+next to ``/healthz``, or by the ``--obs_port`` sidecar on a headless
+training run) gets standard, labeled families instead of bespoke JSON.
+
+Metric names (DESIGN.md § Observability has the full table):
+
+  galvatron_server_requests_total{outcome=...}     server request counters
+  galvatron_serving_*_total                        engine counters
+  galvatron_serving_ttft_seconds{quantile=...}     TTFT readout
+  galvatron_serving_{queue_depth,active_slots,occupancy,tokens_per_s}
+  galvatron_train_*                                trainer sidecar gauges
+  galvatron_hbm_bytes{device=...,kind=...}         HBM gauges
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _fmt_value(v: Any) -> Optional[str]:
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_label(v: Any) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class PromText:
+    """Accumulates samples; emits one ``# HELP``/``# TYPE`` header per family
+    (first add wins) and validates names — a malformed family would make the
+    whole scrape unparseable."""
+
+    def __init__(self, prefix: str = "galvatron_"):
+        self.prefix = prefix
+        self._lines: list = []
+        self._declared: set = set()
+
+    def add(self, name: str, value: Any, *, labels: Optional[Dict[str, Any]] = None,
+            mtype: str = "gauge", help_: str = "") -> None:
+        fv = _fmt_value(value)
+        if fv is None:
+            return
+        full = self.prefix + name
+        if not _NAME_RE.match(full):
+            raise ValueError(f"invalid metric name {full!r}")
+        if full not in self._declared:
+            self._declared.add(full)
+            if help_:
+                self._lines.append(f"# HELP {full} {help_}")
+            self._lines.append(f"# TYPE {full} {mtype}")
+        label_s = ""
+        if labels:
+            for k in labels:
+                if not _LABEL_RE.match(k):
+                    raise ValueError(f"invalid label name {k!r}")
+            label_s = (
+                "{" + ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels.items()) + "}"
+            )
+        self._lines.append(f"{full}{label_s} {fv}")
+
+    def render(self) -> str:
+        return "\n".join(self._lines) + "\n"
+
+
+def render_hbm(out: PromText) -> None:
+    from galvatron_tpu.obs.stepstats import hbm_gauges
+
+    for key, v in hbm_gauges().items():
+        dev, _, kind = key.partition("_")
+        out.add("hbm_bytes", v, labels={"device": dev, "kind": kind},
+                help_="per-device HBM usage where the backend reports it")
+
+
+def server_metrics_text(service) -> str:
+    """Exposition for ``server.GenerationService``: request counters, the
+    legacy gate, and — with the continuous-batching engine — the full serving
+    stats incl. TTFT quantiles."""
+    out = PromText()
+    out.add("server_uptime_seconds", time.time() - service.started_at,
+            help_="seconds since the generation service started")
+    for outcome, v in service.counters.snapshot().items():
+        out.add("server_requests_total", v, labels={"outcome": outcome},
+                mtype="counter", help_="API requests by outcome")
+    if service.gate is not None:
+        g = service.gate.snapshot()
+        out.add("server_gate_in_use", g["in_use"])
+        out.add("server_gate_capacity", g["capacity"])
+        out.add("server_gate_rejected_total", g["rejected"], mtype="counter")
+    eng = service.engine
+    if eng is not None:
+        s = eng.stats()
+        for name in ("steps", "prefill_chunks", "prefill_tokens",
+                     "tokens_generated", "submitted", "admitted", "completed",
+                     "failed", "expired"):
+            out.add(f"serving_{name}_total", s[name], mtype="counter")
+        out.add("serving_rejected_queue_full_total", s["rejected_queue_full"],
+                mtype="counter")
+        for name in ("queue_depth", "queue_capacity", "active_slots",
+                     "num_slots", "occupancy", "tokens_per_s",
+                     "tokens_per_s_last_step"):
+            out.add(f"serving_{name}", s[name])
+        out.add("serving_queue_saturated", s["queue_saturated"])
+        for q, key in (("0.5", "ttft_p50_s"), ("0.95", "ttft_p95_s")):
+            out.add("serving_ttft_seconds", s[key], labels={"quantile": q},
+                    help_="time-to-first-token over the recent-request window")
+    c = service.cfg
+    out.add("model_info", 1, labels={
+        "vocab_size": c.vocab_size, "hidden_size": c.hidden_size,
+        "num_layers": c.num_layers, "num_heads": c.num_heads,
+        "max_seq_len": c.max_seq_len,
+    }, help_="model shape (constant 1; shape in labels)")
+    render_hbm(out)
+    return out.render()
+
+
+class TrainStats:
+    """Mutable per-run gauge set the trainer updates each iteration and the
+    sidecar renders on scrape. Plain attribute writes under the GIL — the
+    trainer loop must not pay a lock for observability."""
+
+    def __init__(self):
+        self.started_at = time.time()
+        self.iterations = 0
+        self.last_loss: Optional[float] = None
+        self.last_iter_ms: Optional[float] = None
+        self.tokens_per_s: Optional[float] = None
+        self.tflops_per_device: Optional[float] = None
+        self.mfu: Optional[float] = None
+        self.hfu: Optional[float] = None
+        self.anomaly_skips = 0
+        self.checkpoints_saved = 0
+
+    def render(self) -> str:
+        out = PromText()
+        out.add("train_uptime_seconds", time.time() - self.started_at)
+        out.add("train_iterations_total", self.iterations, mtype="counter",
+                help_="optimizer iterations completed this run")
+        out.add("train_anomaly_skips_total", self.anomaly_skips, mtype="counter")
+        out.add("train_checkpoints_saved_total", self.checkpoints_saved,
+                mtype="counter")
+        loss = self.last_loss
+        out.add("train_last_loss", loss if loss is None or math.isfinite(loss)
+                else float("nan"))
+        out.add("train_last_iter_ms", self.last_iter_ms)
+        out.add("train_tokens_per_s", self.tokens_per_s)
+        out.add("train_tflops_per_device", self.tflops_per_device,
+                help_="achieved model TFLOP/s per device")
+        out.add("train_mfu", self.mfu, help_="model FLOPs utilization (PaLM convention)")
+        out.add("train_hfu", self.hfu, help_="hardware FLOPs utilization (incl. remat)")
+        render_hbm(out)
+        return out.render()
+
+
+class ObsServer:
+    """Sidecar HTTP listener for headless runs (``--obs_port``): serves
+    ``GET /metrics`` (Prometheus text from ``metrics_fn``) and ``GET
+    /healthz`` on its own daemon thread, so a training job with no serving
+    stack is still scrapeable. ``port=0`` binds an ephemeral port (tests)."""
+
+    def __init__(self, metrics_fn: Callable[[], str], port: int = 0,
+                 host: str = "127.0.0.1"):
+        # loopback by default, matching run_server: an unauthenticated
+        # telemetry endpoint must not silently bind all interfaces
+        self.metrics_fn = metrics_fn
+        obs = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                path = self.path.split("?")[0].rstrip("/")
+                try:
+                    if path == "/metrics":
+                        body = obs.metrics_fn().encode()
+                        ctype = CONTENT_TYPE
+                    elif path == "/healthz":
+                        body = json.dumps({"status": "ok"}).encode()
+                        ctype = "application/json"
+                    else:
+                        body = b'{"error": "use /metrics or /healthz"}'
+                        self._send(404, body, "application/json")
+                        return
+                    self._send(200, body, ctype)
+                except Exception as e:  # noqa: BLE001 — scrape must not kill the run
+                    self._send(500, f"# render error: {e}\n".encode(), "text/plain")
+
+            def _send(self, code, body, ctype):
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    self.close_connection = True
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-sidecar", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=10)

@@ -100,12 +100,23 @@ class RuntimeProfiler:
                 out[f"dev{d.id}_peak_bytes_mb"] = st.get("peak_bytes_in_use", 0) / 1e6
         return out
 
-    def report(self, global_bsz: int, seq_len: int, predicted_ms: Optional[float] = None):
+    def report(self, global_bsz: int, seq_len: int, predicted_ms: Optional[float] = None,
+               step_stats=None):
         tp = self.throughput(global_bsz, seq_len)
         lines = [
             f"avg iter: {tp['iter_ms']:.2f} ms | "
             f"{tp['samples_per_s']:.2f} samples/s | {tp['tokens_per_s']:.0f} tokens/s"
         ]
+        if step_stats is not None and np.isfinite(tp["iter_ms"]):
+            # achieved model TFLOP/s + MFU/HFU from the analytic FLOPs
+            # estimate (obs.stepstats.StepStats) — utilization next to
+            # throughput in every training summary
+            st = step_stats.per_iter(tp["iter_ms"], global_bsz)
+            if st["tflops_per_device"] is not None:
+                line = f"achieved {st['tflops_per_device']:.2f} TFLOP/s/device"
+                if st["mfu"] is not None:
+                    line += f" | MFU {st['mfu'] * 100:.1f}% | HFU {st['hfu'] * 100:.1f}%"
+                lines.append(line)
         if predicted_ms is not None and np.isfinite(tp["iter_ms"]):
             fidelity = predicted_ms / tp["iter_ms"]
             lines.append(

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from galvatron_tpu.core.strategy import HybridParallelConfig, LayerStrategy, form_strategy
+from galvatron_tpu.obs.tracing import tracer as _obs_tracer
 from galvatron_tpu.search.cost_model import (
     REMAT_FULL_FACTOR,
     single_1f1b_rings_mb,
@@ -350,6 +351,17 @@ class SearchEngine:
     # -- single (pp, bsz, chunks, pipeline_type) evaluation ------------------
 
     def evaluate(
+        self, pp: int, global_bsz: int, chunks: int, pipeline_type: str, vpp: int = 1
+    ) -> Optional[SearchResult]:
+        # one span per DP phase: the search timeline shows where the sweep's
+        # time goes (per-candidate per-layer DP), not just its total
+        with _obs_tracer.span(
+            "search_dp", bsz=global_bsz, pp=pp, chunks=chunks,
+            schedule=pipeline_type, vpp=vpp,
+        ):
+            return self._evaluate(pp, global_bsz, chunks, pipeline_type, vpp)
+
+    def _evaluate(
         self, pp: int, global_bsz: int, chunks: int, pipeline_type: str, vpp: int = 1
     ) -> Optional[SearchResult]:
         space = self.space
@@ -755,15 +767,16 @@ class SearchEngine:
         for measured validation (CLI --validate_top_k)."""
         seen = set()
         out: List[SearchResult] = []
-        for r in self._iter_results(global_bsz_list, max_chunks, verbose=verbose):
-            key = (
-                r.global_bsz, r.config.pp, r.config.chunks, r.config.pipeline_type,
-                r.config.vpp, tuple(map(str, r.config.layer_strategies)),
-            )
-            if key in seen:
-                continue
-            seen.add(key)
-            out.append(r)
+        with _obs_tracer.span("search_sweep", phase="topk", k=k):
+            for r in self._iter_results(global_bsz_list, max_chunks, verbose=verbose):
+                key = (
+                    r.global_bsz, r.config.pp, r.config.chunks, r.config.pipeline_type,
+                    r.config.vpp, tuple(map(str, r.config.layer_strategies)),
+                )
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(r)
         out.sort(key=lambda r: -r.throughput_samples_per_s)
         rs = self._active_restrictions()
         if rs:
@@ -780,11 +793,12 @@ class SearchEngine:
         """Sweep (bsz, pp, chunks, schedule); maximize throughput (reference:
         parallelism_optimization, search_engine.py:168-324)."""
         best: Optional[SearchResult] = None
-        for r in self._iter_results(global_bsz_list, max_chunks, verbose=verbose):
-            if best is None or (
-                r.throughput_samples_per_s > best.throughput_samples_per_s
-            ):
-                best = r
+        with _obs_tracer.span("search_sweep", phase="best"):
+            for r in self._iter_results(global_bsz_list, max_chunks, verbose=verbose):
+                if best is None or (
+                    r.throughput_samples_per_s > best.throughput_samples_per_s
+                ):
+                    best = r
         if best is not None:
             rs = self._active_restrictions()
             if rs:

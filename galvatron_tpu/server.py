@@ -24,6 +24,13 @@ API (POST or PUT /api, JSON body):
 GET /healthz → {"status": "ok", "uptime_s": ..., "requests": {succeeded/
                 failed/rejected}, "gate" | "serving": saturation + engine
                 stats, "model": {vocab/hidden/layers/heads/max_seq_len}}
+GET /metrics → the same stats in Prometheus text exposition (obs/prom.py):
+               request counters, engine counters, TTFT quantiles, occupancy,
+               HBM gauges — a scraper target next to the probe.
+POST /profile?steps=N (or JSON {"steps": N, "timeout_s": S, "dir": ...})
+             → on-demand jax.profiler capture over the next N engine decode
+               iterations (obs/flight.capture_profile); 409 while another
+               capture runs, 503 where the backend lacks xprof support.
 
 Connections are handled on threads — /healthz answers while generations are
 in flight — and each carries a socket timeout (``request_timeout_s``) so a
@@ -100,6 +107,8 @@ class GenerationService:
         self.started_at = time.time()
         self.counters = Counters("succeeded", "failed", "rejected")
         self.gate: Optional[_Gate] = None  # set by run_server (legacy path)
+        # one capture at a time: jax.profiler state is process-global
+        self._profile_lock = threading.Lock()
 
     @property
     def requests_served(self) -> int:
@@ -190,6 +199,42 @@ class GenerationService:
             for f in futures:
                 f.cancel()
 
+    def profile_capture(self, steps: int, trace_dir: Optional[str] = None,
+                        timeout_s: float = 30.0) -> dict:
+        """On-demand jax.profiler window over the next ``steps`` engine decode
+        iterations (POST /profile). Raises ``ValueError`` for usage errors,
+        ``ServiceBusy`` when a capture is already running, ``RuntimeError``
+        when the backend has no xprof support (→ 503, not a crash)."""
+        if self.engine is None:
+            raise ValueError(
+                "on-demand profiling needs the continuous-batching engine "
+                "(--num_slots > 0): captures are bounded by decode iterations"
+            )
+        steps = int(steps)
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        # clamp client-supplied bounds: the capture holds the PROCESS-GLOBAL
+        # jax.profiler plus a handler thread, and every concurrent /profile
+        # 409s until it ends — an unbounded steps/timeout_s would let one
+        # request pin both for as long as it likes
+        steps = min(steps, 10_000)
+        timeout_s = min(max(float(timeout_s), 1.0), 300.0)
+        if not self._profile_lock.acquire(blocking=False):
+            raise ServiceBusy("a profiler capture is already in progress")
+        try:
+            import tempfile
+
+            from galvatron_tpu.obs.flight import capture_profile
+
+            return capture_profile(
+                trace_dir or tempfile.mkdtemp(prefix="galvatron_profile_"),
+                steps,
+                lambda: self.engine.counters.get("steps"),
+                timeout_s=timeout_s,
+            )
+        finally:
+            self._profile_lock.release()
+
     def _generate_serialized(self, body: dict, tok_prompts, n_new: int):
         """Legacy single-shot path: full prefill+decode per request under
         the global lock (generation holds the chip anyway)."""
@@ -219,14 +264,16 @@ def _make_handler(service: GenerationService, request_timeout_s: float):
         timeout = request_timeout_s
 
         def _reply(self, code: int, payload: dict):
+            self._reply_raw(code, json.dumps(payload).encode(), "application/json")
+
+        def _reply_raw(self, code: int, data: bytes, ctype: str):
             # a client that disconnected mid-generation must not blow a
             # traceback out of the handler (nor can the 500-path itself be
             # allowed to throw) — drop the dead connection like the
             # stalled-read TimeoutError path does
             try:
-                data = json.dumps(payload).encode()
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -234,7 +281,11 @@ def _make_handler(service: GenerationService, request_timeout_s: float):
                 self.close_connection = True
 
         def _handle(self):
-            if self.path.rstrip("/") != "/api":
+            route, _, query = self.path.partition("?")
+            route = route.rstrip("/")
+            if route == "/profile":
+                return self._do_profile(query)
+            if route != "/api":
                 return self._reply(404, {"error": "use /api"})
             # bounded pending work (legacy path only): the threading server
             # gives every connection a thread, and a thread parked on the
@@ -274,13 +325,54 @@ def _make_handler(service: GenerationService, request_timeout_s: float):
                 if gate is not None:
                     gate.release()
 
+        def _do_profile(self, query: str):
+            """POST /profile — bounded on-demand profiler capture."""
+            from urllib.parse import parse_qs
+
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("request body must be a JSON object")
+                qs = parse_qs(query)
+                steps = body.get("steps", qs.get("steps", [1])[0])
+                timeout_s = body.get("timeout_s", qs.get("timeout_s", [30.0])[0])
+                return self._reply(200, service.profile_capture(
+                    steps, trace_dir=body.get("dir"), timeout_s=float(timeout_s)
+                ))
+            except TimeoutError:
+                self.close_connection = True
+                return
+            except ServiceBusy as e:
+                return self._reply(409, {"error": str(e)})
+            except ValueError as e:
+                return self._reply(400, {"error": str(e)})
+            except RuntimeError as e:
+                # no xprof on this backend: an honest 503, not a traceback
+                return self._reply(503, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — surface to client
+                return self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
         do_POST = _handle
         do_PUT = _handle
 
         def do_GET(self):
-            if self.path.rstrip("/") == "/healthz":
+            route = self.path.partition("?")[0].rstrip("/")
+            if route == "/healthz":
                 return self._reply(200, service.health())
-            return self._reply(404, {"error": "use /api (POST/PUT) or /healthz (GET)"})
+            if route == "/metrics":
+                from galvatron_tpu.obs.prom import CONTENT_TYPE, server_metrics_text
+
+                try:
+                    text = server_metrics_text(service)
+                except Exception as e:  # noqa: BLE001 — scrape must not kill serving
+                    return self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                return self._reply_raw(200, text.encode(), CONTENT_TYPE)
+            return self._reply(
+                404,
+                {"error": "use /api (POST/PUT), /healthz, /metrics (GET), "
+                          "or /profile (POST)"},
+            )
 
         def log_message(self, *a):  # quiet
             pass

@@ -40,6 +40,7 @@ import numpy as np
 from galvatron_tpu.models import generation
 from galvatron_tpu.models.generation import KVCache
 from galvatron_tpu.models.modeling import ModelConfig
+from galvatron_tpu.obs.tracing import tracer as _obs_tracer
 from galvatron_tpu.serving.kv_slots import SlotKVCache
 from galvatron_tpu.serving.scheduler import Request, Scheduler
 from galvatron_tpu.utils.metrics import Counters, QuantileWindow
@@ -308,6 +309,12 @@ class Engine:
                     req.future.set_exception(e)
 
     def _prefill(self, req: Request) -> None:
+        # engine iteration spans (prefill/decode/sample) land on the same
+        # process timeline as everything else; tracing off = no-op singleton
+        with _obs_tracer.span("prefill", rid=req.rid, tokens=len(req.tokens)):
+            self._prefill_impl(req)
+
+    def _prefill_impl(self, req: Request) -> None:
         t0 = time.perf_counter()
         slot = self.slots.alloc()
         assert slot is not None
@@ -358,38 +365,43 @@ class Engine:
         sampled = 0
         appended = 0
         retired: List[int] = []
-        for slot in self.slots.active_slots():
-            req = self._by_slot[slot]
-            tok = _sample_host(
-                self._rng[slot], self._last_logits[slot],
-                req.temperature, req.top_k, req.top_p,
-            )
-            sampled += 1
-            now = time.time()
-            if req.first_token_at is None:
-                req.first_token_at = now
-                self.ttft.add(now - req.submitted_at)
-            if self.eos_id >= 0 and tok == self.eos_id:
-                retired.append(slot)
-                continue
-            req.generated.append(tok)
-            appended += 1
-            if len(req.generated) >= req.max_new_tokens:
-                retired.append(slot)
-                continue
-            tokens[slot] = tok
-            offsets[slot] = self.slots.lengths[slot]
-            self.slots.lengths[slot] += 1
+        with _obs_tracer.span("sample", active=self.slots.active_count):
+            for slot in self.slots.active_slots():
+                req = self._by_slot[slot]
+                tok = _sample_host(
+                    self._rng[slot], self._last_logits[slot],
+                    req.temperature, req.top_k, req.top_p,
+                )
+                sampled += 1
+                now = time.time()
+                if req.first_token_at is None:
+                    req.first_token_at = now
+                    self.ttft.add(now - req.submitted_at)
+                if self.eos_id >= 0 and tok == self.eos_id:
+                    retired.append(slot)
+                    continue
+                req.generated.append(tok)
+                appended += 1
+                if len(req.generated) >= req.max_new_tokens:
+                    retired.append(slot)
+                    continue
+                tokens[slot] = tok
+                offsets[slot] = self.slots.lengths[slot]
+                self.slots.lengths[slot] += 1
         for slot in retired:
             self._retire(slot)
         still = self.slots.active_slots()
         if still:
-            logits, cache = _decode_step(
-                self.params, self.cfg, self.slots.cache,
-                jnp.asarray(tokens), jnp.asarray(offsets),
-            )
-            self.slots.cache = cache
-            logits = np.asarray(logits)
+            with _obs_tracer.span("decode", active=len(still)):
+                logits, cache = _decode_step(
+                    self.params, self.cfg, self.slots.cache,
+                    jnp.asarray(tokens), jnp.asarray(offsets),
+                )
+                self.slots.cache = cache
+                # np.asarray is the engine's own readback sync (it needs the
+                # logits on host to sample the next token), so the decode
+                # span closes on realized compute, not dispatch
+                logits = np.asarray(logits)
             for slot in still:
                 self._last_logits[slot] = logits[slot]
         self.counters.inc("steps")

@@ -13,11 +13,22 @@ import json
 import os
 import threading
 import time
+import warnings
 from typing import Any, Dict, Optional
 
 
 class MetricsLogger:
-    """Append-only JSONL metrics writer; no-op when ``path`` is None."""
+    """Append-only JSONL metrics writer; no-op when ``path`` is None.
+
+    The file is opened in append mode (``O_APPEND``): each ``write`` lands
+    atomically at the current end of file, so a restart (resume) appends
+    after the previous run's records instead of truncating them. Multihost
+    note: O_APPEND does NOT make concurrent writers from multiple processes
+    safe on network filesystems — on a pod, only process 0 may own the path
+    (the trainer guards this: every other process gets ``path=None``).
+    A crash can still leave a torn FINAL line (a record cut mid-write);
+    ``read_metrics`` skips it with a warning instead of failing the reader.
+    """
 
     def __init__(self, path: Optional[str] = None, flush_every: int = 1):
         self.path = path
@@ -26,6 +37,7 @@ class MetricsLogger:
         self.flush_every = max(1, flush_every)
         if path:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            _repair_torn_tail(path)
             self._f = open(path, "a")
 
     def log(self, event: str, step: Optional[int] = None, **fields: Any) -> Dict[str, Any]:
@@ -59,9 +71,74 @@ class MetricsLogger:
         self.close()
 
 
+def _repair_torn_tail(path: str) -> None:
+    """Reopen-for-append repair: a crash mid-write can leave a final line
+    with no trailing newline. Appending onto it would merge the resumed
+    run's first record into the partial one — turning a skippable torn TAIL
+    into mid-file corruption ``read_metrics`` rightly refuses. A tail that
+    still parses as a complete JSON record just gets its newline; an
+    unparseable tail is BY THE WRITER'S CONTRACT a partial record (records
+    are written newline-terminated in one call) and is truncated away, with
+    a warning."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return  # no existing file: nothing to repair
+    if size == 0:
+        return
+    with open(path, "rb+") as f:
+        window = min(size, 1 << 20)  # records are small; 1 MB is generous
+        f.seek(size - window)
+        data = f.read(window)
+        if data.endswith(b"\n"):
+            return
+        nl = data.rfind(b"\n")
+        tail = data[nl + 1:]
+        if nl < 0 and window < size:
+            # torn line longer than the window — implausible for this
+            # writer; leave the bytes alone rather than truncate blind
+            f.write(b"\n")
+            return
+        try:
+            json.loads(tail)
+            f.write(b"\n")  # complete record, just unterminated
+            return
+        except ValueError:
+            pass
+        warnings.warn(
+            f"{path}: dropping torn final JSONL record from a previous "
+            f"crash before appending: {tail[:80]!r}"
+        )
+        f.truncate(size - len(tail))
+
+
 def read_metrics(path: str):
+    """Load a JSONL metrics file. A torn FINAL line (crash mid-write — the
+    writer appends record-at-a-time, so only the tail can be partial) is
+    skipped with a warning; a malformed line anywhere ELSE is real
+    corruption and still raises, chained to the offending line number."""
     with open(path) as f:
-        return [json.loads(line) for line in f if line.strip()]
+        raw = f.readlines()
+    # physical line indices of the non-blank records: error messages must
+    # name the line the operator will actually find in the file
+    record_lines = [i for i, ln in enumerate(raw) if ln.strip()]
+    out = []
+    for pos, i in enumerate(record_lines):
+        line = raw[i]
+        try:
+            out.append(json.loads(line))
+        except ValueError as e:
+            if pos == len(record_lines) - 1:
+                warnings.warn(
+                    f"{path}: skipping torn final JSONL record "
+                    f"(crash mid-write): {line[:80]!r}"
+                )
+                break
+            raise ValueError(
+                f"{path}: malformed JSONL record on line {i + 1} "
+                f"(not the final line, so not a torn tail): {line[:80]!r}"
+            ) from e
+    return out
 
 
 class Counters:
@@ -108,11 +185,19 @@ class QuantileWindow:
             self._i = (self._i + 1) % self.size
             self._n += 1
 
-    def quantile(self, q: float) -> Optional[float]:
+    def _snapshot(self) -> list:
+        """Copy the ring under the lock. The copy is O(size) and cheap; the
+        O(size log size) sort happens in ``quantile`` AFTER release, so a
+        reader computing quantiles over a large window can never stall
+        ``add()`` on the engine hot loop (pinned by test)."""
         with self._lock:
-            buf = sorted(self._buf)
+            return list(self._buf)
+
+    def quantile(self, q: float) -> Optional[float]:
+        buf = self._snapshot()
         if not buf:
             return None
+        buf.sort()
         idx = min(len(buf) - 1, max(0, int(round(q * (len(buf) - 1)))))
         return buf[idx]
 

@@ -30,12 +30,30 @@ class _Timer:
         self._started = None
         self.count += 1
 
-    def elapsed(self, reset: bool = False) -> float:
-        """Total elapsed seconds (not counting a currently-running interval)."""
+    def elapsed(self, reset: bool = False, running_ok: bool = False) -> float:
+        """Total elapsed seconds.
+
+        A currently-running interval is INCLUDED when ``running_ok=True``
+        (crash dumps read timers mid-span — silently excluding the open
+        interval would under-report exactly the phase that crashed);
+        otherwise reading a running timer raises, so the old
+        silently-wrong readout can't happen by accident. With both
+        ``running_ok`` and ``reset``, the open interval restarts at now
+        so the included portion is never counted twice."""
+        now = time.perf_counter()
         e = self._elapsed
+        if self._started is not None:
+            if not running_ok:
+                raise RuntimeError(
+                    f"timer {self.name!r} is running; pass running_ok=True to "
+                    "include the open interval (e.g. a crash-path readout)"
+                )
+            e += now - self._started
         if reset:
             self._elapsed = 0.0
             self.count = 0
+            if self._started is not None:
+                self._started = now
         return e
 
 
@@ -61,6 +79,12 @@ class Timers:
         parts = []
         for name in names or self.names():
             if name in self._timers:
-                ms = self._timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                # running_ok: a periodic log readout mid-phase is exactly the
+                # open-interval case elapsed()'s raise exists to surface —
+                # here the inclusion is wanted, not an accident
+                ms = (
+                    self._timers[name].elapsed(reset=reset, running_ok=True)
+                    * 1000.0 / normalizer
+                )
                 parts.append(f"{name}: {ms:.2f}")
         return "time (ms) | " + " | ".join(parts)

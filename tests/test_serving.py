@@ -2,6 +2,7 @@
 shared decode iterations, TTL/backpressure, and the HTTP end-to-end path."""
 
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -336,6 +337,54 @@ def test_http_overlapping_requests_share_engine():
         assert s["active_slots"] == 0 and s["queue_depth"] == 0
         assert s["ttft_p50_s"] is not None and s["ttft_p95_s"] >= s["ttft_p50_s"]
         assert s["tokens_per_s"] > 0
+        # GET /metrics next to /healthz: Prometheus text exposition carrying
+        # the serving counters and TTFT quantiles (obs/prom.py)
+        from test_obs import assert_valid_exposition
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert_valid_exposition(text)
+        assert f"galvatron_serving_completed_total {len(prompts)}" in text
+        assert f"galvatron_server_requests_total{{outcome=\"succeeded\"}} " \
+               f"{len(prompts)}" in text
+        assert 'galvatron_serving_ttft_seconds{quantile="0.5"}' in text
+        assert 'galvatron_serving_ttft_seconds{quantile="0.95"}' in text
+        assert "galvatron_serving_tokens_generated_total" in text
+        assert "galvatron_model_info{" in text
+    finally:
+        svc.httpd.shutdown()
+        engine.close()
+
+
+def test_http_profile_capture_endpoint():
+    """POST /profile: bounded on-demand jax.profiler capture keyed to engine
+    decode iterations; bad params 400; no engine → 400."""
+    svc, engine, port, params, tok = _start_engine_server(num_slots=2)
+    try:
+        # drive some decode activity concurrently so the capture sees steps
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            gen = ex.submit(
+                _post, port, {"prompts": ["profile me"], "tokens_to_generate": 24}
+            )
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/profile?steps=2&timeout_s=20",
+                data=b"{}", method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                resp = json.loads(r.read())
+            gen.result(timeout=60)
+        assert resp["requested"] == 2 and os.path.isdir(resp["trace_dir"])
+        assert resp["steps_captured"] >= 0
+        # usage errors are 400s, not tracebacks
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/profile?steps=0", data=b"{}", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=30)
+        assert ei.value.code == 400
     finally:
         svc.httpd.shutdown()
         engine.close()
