@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test test-all bench dryrun lint check-plan clean
+.PHONY: all native test test-all bench dryrun lint check-plan chaos clean
 
 all: native
 
@@ -28,6 +28,20 @@ lint:
 
 check-plan:
 	$(PY) -m galvatron_tpu.cli check-plan configs/strategies/*.json --strict 1
+
+# one elastic chaos scenario (docs/DESIGN.md § Elastic training): an 8→4
+# simulated shrink under the supervisor must end in a committed checkpoint
+# (CI runs the full GALVATRON_FAULTS matrix — see .github/workflows/ci.yml)
+chaos:
+	rm -rf /tmp/galvatron_chaos
+	env JAX_PLATFORMS=cpu GALVATRON_FAULTS="preempt_at_step=1" \
+	  GALVATRON_FAULTS_WORLD="8,4" $(PY) -m galvatron_tpu.cli run-elastic \
+	  --model_size llama-0.3b --num_layers 2 --hidden_size 32 --num_heads 2 \
+	  --ffn_dim 64 --vocab_size 128 --seq_length 16 \
+	  --global_train_batch_size 8 --mixed_precision fp32 --global_tp_deg 2 \
+	  --train_iters 4 --save /tmp/galvatron_chaos --save_interval 2 \
+	  --max_restarts 3 --step_timeout_s 5 --replan_search_space dp+tp
+	$(PY) -c "from galvatron_tpu.core.checkpoint import latest_step; s = latest_step('/tmp/galvatron_chaos'); assert s == 4, s; print('chaos shrink ok: committed step', s)"
 
 # headline metric on the real chip — prints one JSON line
 bench:

@@ -34,6 +34,7 @@ CODES = {
     "GTA014": ("expert-parallel degree invalid for the model's expert count", ERROR),
     "GTA015": ("cost-model memory estimate exceeds the device budget", ERROR),
     "GTA016": ("abstract sharding pass: annotated dim unsharded or spec invalid", WARN),
+    "GTA017": ("checkpoint topology/plan fingerprint does not match the live mesh", ERROR),
     # --- trace-hygiene linter (GTL1xx) ---
     "GTL100": ("malformed suppression: '# gta: disable=<rule>' needs a reason", ERROR),
     "GTL101": ("host-device sync on a jitted result inside a hot loop", WARN),

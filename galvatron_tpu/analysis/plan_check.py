@@ -35,6 +35,11 @@ The checks, in order:
     consistent (spec axes exist, shard shapes divide) and complete (a
     tp/fsdp-annotated dim the spec could not shard is silently replicated —
     real HBM; GTA016). No device, no compile.
+
+Separately, :func:`check_topology_fingerprint` (GTA017) compares a
+checkpoint's recorded topology fingerprint against the live mesh — the
+resume-path check the trainer and the elastic supervisor
+(`core/elastic.py`) run before training under a stale plan.
 """
 
 from __future__ import annotations
@@ -299,6 +304,50 @@ def ensure_valid(
     if verbose and diags:
         print(format_report(diags))
     return diags
+
+
+def check_topology_fingerprint(
+    fingerprint: Dict[str, Any],
+    world_size: Optional[int],
+    source: Optional[str] = None,
+) -> List[Diagnostic]:
+    """GTA017: a checkpoint's recorded topology vs the live mesh.
+
+    ``fingerprint`` is the manifest-meta record the trainer writes with
+    every save (``world_size``, ``mesh_shape``, ``plan_hash``,
+    ``global_bsz``). A mismatching world size — the preemption/slice-shrink
+    signature — is an ERROR: the plan the checkpoint was training under was
+    searched for a mesh that no longer exists, and silently resuming it
+    would train a different (typically memory-infeasible or throughput-
+    pessimal) parallelization than anything the search ever endorsed. The
+    elastic supervisor (`cli run-elastic`) treats this diagnostic as its
+    re-plan trigger; plain ``train`` refuses with it. A changed *plan hash*
+    or mesh axis layout on the SAME device count is deliberately not
+    flagged: portable checkpoints reshard across plans by design
+    (``mesh_shape`` rides the fingerprint for forensics, not as a gate).
+    """
+    out: List[Diagnostic] = []
+    if not isinstance(fingerprint, dict):
+        return out
+    try:
+        rec_world = int(fingerprint.get("world_size") or 0)
+    except (TypeError, ValueError):
+        rec_world = 0
+    if rec_world and world_size and rec_world != world_size:
+        out.append(
+            Diagnostic(
+                "GTA017",
+                f"checkpoint was written on {rec_world} devices but the live "
+                f"topology has {world_size}",
+                hint="re-search a plan for this mesh and resume the portable "
+                "checkpoint under it — `cli run-elastic` does this "
+                "automatically (plan cache: <ckpt>/replans/, "
+                "configs/strategies/)",
+                field="fingerprint.world_size",
+                source=source,
+            )
+        )
+    return out
 
 
 # ---------------------------------------------------------------------------

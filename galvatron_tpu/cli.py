@@ -4,6 +4,13 @@ Modes mirror the reference's per-model entry scripts (reference L7,
 models/<name>/{train_dist,search_dist,profiler}.py + profile_hardware):
 
   train             hybrid-parallel training (train_dist equivalent)
+  run-elastic       train under the preemption-aware elastic supervisor
+                    (core/elastic.py): child exits are classified
+                    (completed / preempted-save / anomaly / watchdog hang /
+                    crash) into restart-with-jittered-backoff or give-up
+                    decisions, a topology change (pod shrink) triggers an
+                    automatic re-search + portable resume under the new
+                    plan, and --step_timeout_s arms a hang watchdog
   search            parallelism optimization → galvatron_config JSON
   profile           model computation/memory profiling → JSON
   profile-hardware  ICI bandwidth + overlap sweep → JSON
@@ -48,6 +55,14 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         ns = initialize_galvatron("train", rest, model_default)
         train(ns)
         return 0
+
+    if mode == "run-elastic":
+        # the supervisor parses the SAME train flags (plus --max_restarts /
+        # --step_timeout_s / --replan_*) and forwards them verbatim to each
+        # child, so a train command line becomes elastic by renaming the mode
+        from galvatron_tpu.core.elastic import run_elastic
+
+        return run_elastic(rest, model_default)
 
     if mode == "search":
         ns = initialize_galvatron("search", rest, model_default)
@@ -100,22 +115,9 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             moe_experts=cfg.moe_experts,
             max_vpp=ns.max_vpp_deg,
         )
-        if ns.search_space == "dp":
-            sspace.max_tp, sspace.pp_choices = 1, [1]
-        elif ns.search_space == "tp":
-            sspace.pp_choices = [1]
-        elif ns.search_space == "pp":
-            sspace.max_tp = 1
-        elif ns.search_space == "dp+tp":
-            sspace.pp_choices = [1]
-        elif ns.search_space == "dp+pp":
-            sspace.max_tp = 1
-        elif ns.search_space == "sdp":
-            sspace.max_tp, sspace.pp_choices = 1, [1]
-        elif ns.search_space == "3d":
-            # pure pp x tp x dp grid: no ZeRO/ckpt/layout/SP variants
-            sspace.allow_zero2 = sspace.allow_zero3 = False
-            sspace.allow_ckpt = sspace.allow_sp = sspace.allow_strided = False
+        from galvatron_tpu.search.search_engine import apply_search_space
+
+        apply_search_space(sspace, ns.search_space)
         eng = SearchEngine(
             costs, hw, num_layers=cfg.total_layers, space=sspace,
             memory_budget_mb=ns.memory_constraint_gb * 1024.0,
@@ -378,8 +380,8 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
 
     print(
         f"unknown mode {mode!r}; expected "
-        "train|search|profile|profile-hardware|check-plan|trace-export|"
-        "generate|serve|export-hf"
+        "train|run-elastic|search|profile|profile-hardware|check-plan|"
+        "trace-export|generate|serve|export-hf"
     )
     return 2
 

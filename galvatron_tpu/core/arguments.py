@@ -172,6 +172,38 @@ def _add_training_args(p: argparse.ArgumentParser):
                    "LLaMA-architecture checkpoint directory (models/convert.py; "
                    "overrides the model shape from the HF config)")
     g.add_argument("--save_interval", type=int, default=0)
+    # elastic training (core/elastic.py + core/watchdog.py; docs/DESIGN.md
+    # § Elastic training). --step_timeout_s is read by the trainer itself
+    # (any run can arm the watchdog); the rest steer the run-elastic
+    # supervisor and its child's topology re-plan.
+    g.add_argument("--step_timeout_s", type=float, default=0.0,
+                   help="hang watchdog: a train step exceeding this deadline "
+                   "dumps all-thread stacks + the flight ring, attempts an "
+                   "emergency save of the last bound state, and exits with "
+                   "the hang code (77) so run-elastic restarts instead of "
+                   "burning the pod on a stalled collective. The first step "
+                   "of a process gets 10x (XLA compile). Implies a per-iter "
+                   "sync. 0 = off")
+    g.add_argument("--max_restarts", type=int, default=10,
+                   help="run-elastic: give up after this many CONSECUTIVE "
+                   "restarts without progress (a newer committed checkpoint "
+                   "step resets the counter; preemptions that saved always "
+                   "progress)")
+    g.add_argument("--restart_backoff_s", type=float, default=1.0,
+                   help="run-elastic: base of the full-jitter exponential "
+                   "backoff before crash/hang restarts (preempted-save "
+                   "children restart immediately)")
+    g.add_argument("--restart_backoff_cap_s", type=float, default=60.0,
+                   help="run-elastic: backoff ceiling")
+    g.add_argument("--replan_search_space", type=str, default="full",
+                   choices=["full", "dp+tp", "dp+pp", "3d", "dp", "tp", "pp", "sdp"],
+                   help="topology-change re-plan: restrict the re-search to "
+                   "this strategy subspace (same presets as search "
+                   "--search_space)")
+    g.add_argument("--replan_memory_gb", type=float, default=16.0,
+                   help="topology-change re-plan: per-device memory budget "
+                   "for the re-search (no profile exists for a mesh that "
+                   "appeared mid-run; analytic costs are used)")
 
 
 def _add_search_args(p: argparse.ArgumentParser):

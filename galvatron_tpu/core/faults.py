@@ -24,18 +24,35 @@ Supported faults:
                            loop with enough budget rides through).
 - ``nan_at_step=K`` (+ ``nan_count=N``, default 1) — the observed loss at
   training steps K..K+N-1 is forced to NaN (the silent-divergence scenario).
+- ``preempt_at_step=N``  — SIGTERM is delivered to the process itself
+  mid-step at global batch index N (the maintenance-event/preemption
+  scenario: the graceful handler latches it, the trainer checkpoints and
+  exits, and the elastic supervisor sees ``EXIT_PREEMPTED``).
+- ``hang_at_step=N`` (+ ``hang_s=S``, default 300) — the step at global
+  batch index N sleeps S seconds before dispatch (the stalled-collective
+  scenario ``core/signals.py`` documents), tripping ``--step_timeout_s``'s
+  hang watchdog.
 
 The hooks are called from the real code paths (checkpoint save/commit, the
-retry wrapper, the trainer's loss observation), so an injected fault
-exercises exactly the machinery a real one would.
+retry wrapper, the trainer's loss observation and step loop), so an
+injected fault exercises exactly the machinery a real one would.
+
+Topology simulation: the separate ``GALVATRON_FAULTS_WORLD`` env var (a
+comma list of device counts, e.g. ``"8,4"``) is read by the elastic
+supervisor (`core/elastic.py`), which gives its k-th child a virtual CPU
+platform of that width — a preemption that shrinks the world from 8 to 4
+devices across a restart becomes reproducible on any host.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Dict, Optional
+import signal as _signal
+import time as _time
+from typing import Dict, List, Optional
 
 ENV_VAR = "GALVATRON_FAULTS"
+WORLD_ENV_VAR = "GALVATRON_FAULTS_WORLD"
 
 _active: Dict[str, int] = {}
 
@@ -100,6 +117,54 @@ def force_nan(step: int) -> bool:
     if k is None:
         return False
     return k <= step < k + _active.get("nan_count", 1)
+
+
+def maybe_preempt(step: int) -> None:
+    """Armed ``preempt_at_step=N``: deliver SIGTERM to this process at batch
+    index N — once. Sent mid-step (after the batch fetch, before the
+    update), exactly the window a real maintenance event lands in; the
+    trainer's :class:`~galvatron_tpu.core.signals.GracefulExitHandler`
+    latches it and the loop checkpoints-then-exits at the next boundary."""
+    k = _active.get("preempt_at_step")
+    if k is not None and step == int(k):
+        del _active["preempt_at_step"]
+        os.kill(os.getpid(), _signal.SIGTERM)
+
+
+def maybe_hang(step: int) -> None:
+    """Armed ``hang_at_step=N``: sleep ``hang_s`` seconds inside the step at
+    batch index N — once. Simulates the stalled collective of a half-dead
+    pod; the hang watchdog (``--step_timeout_s``) must convert it into a
+    flight dump + emergency save + hang-coded exit."""
+    k = _active.get("hang_at_step")
+    if k is not None and step == int(k):
+        del _active["hang_at_step"]
+        _time.sleep(_active.get("hang_s", 300))
+
+
+def world_schedule(env: Optional[str] = None) -> List[int]:
+    """Parse ``GALVATRON_FAULTS_WORLD`` (comma list of device counts). The
+    elastic supervisor runs its k-th child on entry ``min(k, len-1)`` — a
+    one-entry list pins a constant simulated world, ``"8,4"`` simulates a
+    shrink at the first restart. Empty/unset → no simulation (children see
+    the real backend)."""
+    spec = env if env is not None else os.environ.get(WORLD_ENV_VAR, "")
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            n = int(part)
+        except ValueError:
+            raise ValueError(
+                f"{WORLD_ENV_VAR}: expected comma-separated device counts, "
+                f"got {part!r}"
+            ) from None
+        if n < 1:
+            raise ValueError(f"{WORLD_ENV_VAR}: device counts must be >= 1, got {n}")
+        out.append(n)
+    return out
 
 
 def after_commit(step_dir: str) -> None:

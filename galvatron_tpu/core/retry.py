@@ -10,14 +10,24 @@ deterministic ``OSError`` subclasses in ``policy.non_retryable``
 (missing path, permission denied), which retrying cannot fix —
 propagates immediately.
 
+Backoff delays carry **full jitter** (AWS architecture-blog sense: each
+delay is uniform in ``[0, base·backoff^n]``, capped). A deterministic
+schedule synchronizes every host in a pod: after a shared storage blip all
+N hosts retry at exactly base, then exactly 2·base, ... — a thundering
+herd that re-creates the overload it is backing off from on NFS/GCS.
+``jitter="none"`` restores the deterministic schedule for callers that
+need reproducible timing.
+
 Every attempt first passes through :func:`faults.maybe_fail_io`, so any
 retry-protected site is automatically a fault-injection point for the
-``fail_io=N`` fault (tests/test_resilience.py proves the ride-through).
+``fail_io=N`` fault (tests/test_resilience.py proves the ride-through and
+pins the jitter bounds).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import random
 import time
 from typing import Any, Callable, Optional, Tuple, Type
 
@@ -44,10 +54,29 @@ class RetryPolicy:
         IsADirectoryError,
         NotADirectoryError,
     )
+    # "full" (default): uniform in [0, capped exponential] — decorrelates
+    # the hosts of a pod retrying the same shared-storage fault; "none":
+    # the old deterministic schedule (reproducible-timing callers only)
+    jitter: str = "full"
 
-    def delay(self, attempt: int) -> float:
-        """Backoff before retry ``attempt`` (0-based): base * backoff^n."""
+    def __post_init__(self):
+        if self.jitter not in ("full", "none"):
+            raise ValueError(f"jitter must be 'full' or 'none', got {self.jitter!r}")
+
+    def max_delay(self, attempt: int) -> float:
+        """Deterministic ceiling for retry ``attempt`` (0-based):
+        min(max_delay_s, base * backoff^n) — the jitter's upper bound."""
         return min(self.max_delay_s, self.base_delay_s * self.backoff ** attempt)
+
+    def delay(self, attempt: int, rng=random) -> float:
+        """Backoff before retry ``attempt``: full jitter draws uniformly
+        from [0, :meth:`max_delay`]; ``jitter='none'`` returns the ceiling
+        itself. ``rng`` (anything with ``.uniform``) is injectable so tests
+        can pin the distribution."""
+        cap = self.max_delay(attempt)
+        if self.jitter == "none" or cap <= 0:
+            return cap
+        return rng.uniform(0.0, cap)
 
 
 def with_retries(

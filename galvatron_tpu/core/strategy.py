@@ -333,6 +333,26 @@ class HybridParallelConfig:
         return cls(pp=pp, layer_strategies=[s] * num_layers, vocab_tp=kw.pop("vocab_tp", tp), **kw)
 
 
+def plan_hash(plan) -> str:
+    """Stable content hash of a parallelism plan's SEMANTIC fields.
+
+    ``plan`` is a :class:`HybridParallelConfig` or a strategy JSON dict;
+    dicts are decoded first, so provenance keys (``search_cost_ms``,
+    ``num_devices``, ``model_config``, ...) and key ordering never change
+    the hash — re-searching the identical strategy for the same mesh hashes
+    identically. Checkpoint manifests record this hash in their topology
+    fingerprint (trainer), the elastic supervisor exposes it as
+    ``current_plan_hash``, and a cross-plan resume is detected by comparing
+    it (a *mismatch* is legal — portable checkpoints reshard — but worth an
+    event)."""
+    import hashlib
+
+    if isinstance(plan, dict):
+        plan = HybridParallelConfig.from_json_dict(plan)
+    payload = json.dumps(plan.to_json_dict(), sort_keys=True)
+    return "sha256:" + hashlib.sha256(payload.encode()).hexdigest()
+
+
 def balanced_division(num_layers: int, pp: int) -> List[int]:
     """Even layer split across stages, remainder to the middle stages — the
     uniform fallback of the reference's memory-balanced division

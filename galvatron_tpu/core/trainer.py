@@ -46,10 +46,15 @@ def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
     # a later run — which would silently force per-iter syncs and record
     # spans nobody exports. --flight_dir arms tracing too: a flight
     # recorder with no span ring would be a silent no-op exactly when the
-    # operator asked for crash forensics.
+    # operator asked for crash forensics — and so does --step_timeout_s:
+    # the hang watchdog's whole output IS the flight dump it takes on fire.
     tracer = obs_tracing.tracer
     tracer_owned = False
-    if getattr(ns, "trace_spans", None) or getattr(ns, "flight_dir", None):
+    if (
+        getattr(ns, "trace_spans", None)
+        or getattr(ns, "flight_dir", None)
+        or getattr(ns, "step_timeout_s", 0)
+    ):
         tracer.enable(capacity=getattr(ns, "trace_ring", 4096))
         tracer_owned = True
     try:
@@ -215,6 +220,19 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
     if metrics_path and jax.process_index() != 0:
         metrics_path = None
     metrics = MetricsLogger(metrics_path)
+    # topology + plan fingerprint: rides every manifest so a restart can
+    # tell "same world, same plan" from "the pod shrank under me" (GTA017)
+    # and from a legal cross-plan resume. mesh_shape/axes are forensic;
+    # world_size is the gate (plan_check.check_topology_fingerprint).
+    from galvatron_tpu.core.strategy import plan_hash
+
+    fingerprint = {
+        "world_size": world,
+        "mesh_shape": [int(x) for x in rt.mesh.devices.shape],
+        "mesh_axes": [str(a) for a in rt.mesh.axis_names],
+        "plan_hash": plan_hash(hp),
+        "global_bsz": int(ns.global_train_batch_size),
+    }
     start_step = 0
     batch_offset = 0
     if ns.load and latest_step(ns.load) is not None:
@@ -226,8 +244,86 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
         # so the restored step's manifest is addressable here).
         batch_offset = start_step
         m = read_manifest(step_path(ns.load, start_step))
-        if m and isinstance(m.get("meta"), dict):
-            batch_offset = int(m["meta"].get("batches_consumed", start_step))
+        meta = m.get("meta") if m and isinstance(m.get("meta"), dict) else {}
+        if meta:
+            batch_offset = int(meta.get("batches_consumed", start_step))
+        saved_fp = meta.get("fingerprint")
+        if isinstance(saved_fp, dict):
+            from galvatron_tpu.analysis.plan_check import (
+                PlanError,
+                check_topology_fingerprint,
+            )
+
+            diags = check_topology_fingerprint(saved_fp, world, source=ns.load)
+            if diags and not getattr(ns, "allow_topology_change", False):
+                # the plan this run would train was never searched for the
+                # live mesh — refuse, pointing at the supervised path that
+                # re-plans automatically (run-elastic sets the allow flag
+                # after installing a validated plan for THIS topology)
+                raise PlanError(
+                    diags,
+                    context=f"refusing to resume {ns.load} on a changed topology",
+                )
+            if diags:
+                metrics.log(
+                    "topology_resume", step=start_step,
+                    old_world=saved_fp.get("world_size"), new_world=world,
+                    old_plan=saved_fp.get("plan_hash"),
+                    new_plan=fingerprint["plan_hash"],
+                )
+                tracer.instant(
+                    "topology_resume", step=start_step,
+                    old_world=saved_fp.get("world_size"), new_world=world,
+                )
+                if verbose:
+                    print(
+                        f"topology-change resume: {saved_fp.get('world_size')} "
+                        f"→ {world} devices (checkpoint resharded portably)"
+                    )
+            elif saved_fp.get("plan_hash") not in (None, fingerprint["plan_hash"]):
+                # cross-plan resume on the SAME topology is the portable
+                # checkpoint working as designed — an event, not an error
+                metrics.log(
+                    "plan_change", step=start_step,
+                    old_plan=saved_fp.get("plan_hash"),
+                    new_plan=fingerprint["plan_hash"],
+                )
+                tracer.instant("plan_change", step=start_step)
+        # sample-domain resume: the batch domain is only meaningful at the
+        # batch size that consumed it — after a re-plan (or an operator
+        # decision) changed the global batch, the cursor converts through
+        # samples so no example is skipped or replayed
+        rec_bsz = meta.get("global_bsz")
+        if not rec_bsz and isinstance(saved_fp, dict):
+            rec_bsz = saved_fp.get("global_bsz")
+        samples_rec = meta.get("samples_consumed")
+        if (
+            samples_rec is not None
+            and rec_bsz
+            and int(rec_bsz) != ns.global_train_batch_size
+        ):
+            if getattr(ns, "rampup_batch_size", None):
+                raise ValueError(
+                    "cannot combine --rampup_batch_size with a changed "
+                    f"--global_train_batch_size on resume (checkpoint "
+                    f"records bsz {rec_bsz}): the rampup schedule replays "
+                    "in the batch domain"
+                )
+            if int(samples_rec) % ns.global_train_batch_size:
+                raise ValueError(
+                    f"cannot resume at --global_train_batch_size "
+                    f"{ns.global_train_batch_size}: the checkpoint consumed "
+                    f"{samples_rec} samples (at bsz {rec_bsz}), which is not "
+                    f"divisible — a partial batch would be skipped or "
+                    f"replayed. Pick a batch size dividing {samples_rec}."
+                )
+            batch_offset = int(samples_rec) // ns.global_train_batch_size
+            if verbose:
+                print(
+                    f"sample-domain resume: {samples_rec} samples consumed "
+                    f"at bsz {rec_bsz} → batch cursor {batch_offset} at "
+                    f"bsz {ns.global_train_batch_size}"
+                )
         if verbose:
             print(f"resumed from {ns.load} at step {start_step}")
     elif ns.load and uncommitted_steps(ns.load):
@@ -269,12 +365,16 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
     # nothing until the end of the run) — an operator who opened a metrics
     # port asked for live numbers. Process-0-gated like the server itself.
     obs_on = bool(getattr(ns, "obs_port", 0)) and jax.process_index() == 0
+    # the hang watchdog bounds REALIZED step time: without a per-iter sync
+    # the loop would run ahead of a stalled collective by the dispatch
+    # depth and the deadline would measure dispatch, not the hang
+    watchdog_on = bool(getattr(ns, "step_timeout_s", 0.0))
     # metrics.path, not ns.metrics_path: on a pod only process 0 owns the
     # JSONL sink — the other hosts must not pay a per-iter sync for a no-op
     # logger (their sentinel/tracing terms still apply to all hosts alike)
     sync_each = bool(
         ns.check_loss or metrics.path or sentinel.armed or tracer.enabled
-        or obs_on
+        or obs_on or watchdog_on
     )
     prof = RuntimeProfiler(warmup_iters=1, windowed=not sync_each)
     # step accounting (obs/stepstats.py): tokens/s + achieved TFLOP/s + MFU
@@ -346,6 +446,11 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
     else:
         consumed = batch_offset * ns.global_train_batch_size
     consumed_at_start = consumed
+    # samples actually COUNTED into manifests (increments with iters_run,
+    # one fetched batch at a time — `consumed` runs one batch ahead inside
+    # an iteration, and a crash between the two must not claim a sample
+    # the stream never delivered)
+    samples_done = consumed
     cur_bs = ns.global_train_batch_size
     keep_n = getattr(ns, "keep_last_n", 0)
     # due-based save schedule instead of a bare modulus: an anomaly-skipped
@@ -363,6 +468,119 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
     # emitted for different batches
     prior_skips = batch_offset - start_step
     iters_run = 0
+
+    def _save_meta():
+        # one schema for every save path (interval, exit, watchdog): the
+        # stream cursor in BOTH domains plus the topology fingerprint
+        return {
+            "batches_consumed": batch_offset + iters_run,
+            "samples_consumed": samples_done,
+            "global_bsz": int(ns.global_train_batch_size),
+            "fingerprint": fingerprint,
+        }
+
+    # hang watchdog (--step_timeout_s; core/watchdog.py): armed around each
+    # step, fires on a stalled collective — stacks + flight dump + a
+    # best-effort emergency save of the last BOUND state (the holder is
+    # invalidated across each donating dispatch), then exit EXIT_HANG so
+    # the elastic supervisor restarts instead of burning the pod silently
+    wd = holder = None
+    if watchdog_on:
+        import contextlib
+        import sys as _sys
+
+        from galvatron_tpu.core import watchdog as wdmod
+
+        holder = wdmod.StateHolder()
+        holder.set(state, step=start_step, batches=batch_offset, samples=consumed)
+
+        def _on_hang(step_it):
+            stacks = wdmod.dump_all_stacks()
+            print(
+                f"watchdog: step {step_it} exceeded --step_timeout_s "
+                f"{ns.step_timeout_s}s; all-thread stacks:\n{stacks}",
+                file=_sys.stderr, flush=True,
+            )
+            tracer.instant("watchdog_hang", step=step_it)
+            snap_h = holder.snapshot()
+            try:
+                metrics.log(
+                    "watchdog_hang", step=step_it,
+                    save_possible=snap_h is not None,
+                )
+            except Exception:
+                pass  # the JSONL sink must not block the forensics below
+            fdir = getattr(ns, "flight_dir", None)
+            if not fdir and getattr(ns, "trace_spans", None):
+                fdir = os.path.dirname(os.path.abspath(ns.trace_spans))
+            if not fdir:
+                fdir = ns.save
+            if fdir:
+                from galvatron_tpu.obs.flight import dump_flight
+
+                fpath = dump_flight(
+                    fdir, tracer,
+                    reason=f"watchdog hang at step {step_it} "
+                           f"(deadline {ns.step_timeout_s}s)",
+                    extra={"step": step_it, "stacks": stacks[-20000:]},
+                )
+                if fpath:
+                    print(f"flight recorder → {fpath}", file=_sys.stderr, flush=True)
+            if ns.save and snap_h is not None:
+                # on a REAL stalled collective the held buffers may be
+                # unreachable and this save may fail or block — best-effort
+                # by contract; the last committed interval save is the floor
+                try:
+                    save_checkpoint_portable(
+                        ns.save, snap_h["state"], snap_h["step"], rt,
+                        keep_last_n=keep_n,
+                        meta={
+                            "batches_consumed": snap_h["batches"],
+                            "samples_consumed": snap_h["samples"],
+                            "global_bsz": int(ns.global_train_batch_size),
+                            "fingerprint": fingerprint,
+                        },
+                    )
+                    print(
+                        f"watchdog emergency checkpoint step {snap_h['step']} "
+                        f"→ {ns.save}", file=_sys.stderr, flush=True,
+                    )
+                except Exception as save_err:  # noqa: BLE001
+                    print(f"watchdog emergency save failed: {save_err!r}",
+                          file=_sys.stderr, flush=True)
+            # HangWatchdog os._exits with EXIT_HANG when this returns
+
+        wd = wdmod.HangWatchdog(ns.step_timeout_s, _on_hang)
+
+        @contextlib.contextmanager
+        def _watchdog_step(it):
+            # a rampup batch-size transition recompiles the step: give it
+            # the compile-length (warmup) deadline, or the transition of a
+            # healthy run would be declared a hang
+            wd.arm(
+                it,
+                warmup=rampup is not None and rampup(consumed) != cur_bs,
+            )
+            try:
+                yield
+            finally:
+                wd.disarm()
+            # normal exits only (incl. the anomaly-skip `continue`): rebind
+            # the holder to the now-valid state. On an exception `state`
+            # may still name donated buffers — the holder stays invalid and
+            # the crash path's own exit save (bound post-rebind) takes over.
+            holder.set(
+                state,
+                step=it + 1 - prior_skips - sentinel.total_skips,
+                batches=batch_offset + iters_run,
+                samples=samples_done,
+            )
+    else:
+        import contextlib
+
+        def _watchdog_step(it):  # noqa: ARG001 — uniform call site
+            return contextlib.nullcontext()
+
     train_exc = None
     try:
         with GracefulExitHandler() as exit_handler:
@@ -386,7 +604,7 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                     pw.maybe_stop(it - 1, verbose=verbose)
                     pw.maybe_start(it)
                 step_sp = tracer.span("step", step=it)
-                with step_sp:
+                with _watchdog_step(it), step_sp:
                     if rampup is not None:
                         bs = rampup(consumed)
                         if bs != cur_bs or it == batch_offset:
@@ -406,12 +624,27 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                     # feeds the batches_consumed manifest record, and a crash in
                     # the fetch itself must not make resume skip a real batch
                     iters_run += 1
+                    samples_done += cur_bs
+                    # chaos hooks (core/faults.py): a simulated preemption
+                    # SIGTERM mid-step, and a simulated stalled collective
+                    # (sleep) that the armed watchdog must convert into a
+                    # flight dump + emergency save + hang-coded exit. Both
+                    # sit BEFORE the donating dispatch: the fetched batch is
+                    # counted but untrained, exactly a real preemption's
+                    # window, and the watchdog's holder is still valid.
+                    faults.maybe_preempt(it)
+                    faults.maybe_hang(it)
                     # rollback copy — the train step donates its input buffers,
                     # so a discarded update is unrecoverable without it (None
                     # when the sentinel is disarmed: no memory cost)
                     snap = sentinel.snapshot(state)
                     prof.begin_iter()
                     t_step0 = time.perf_counter() if sched_ticks is not None else None
+                    if holder is not None:
+                        # the dispatch below donates `state`: an emergency
+                        # save between here and the post-step rebind would
+                        # read freed buffers
+                        holder.invalidate()
                     with tracer.span("fwd_bwd", step=it):
                         new_state, loss = rt.train_step(state, batch)
                     # rebind NOW: the old buffers were donated into train_step,
@@ -511,9 +744,16 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                         # `it` but not the state, and the exit-save dedup
                         # compares latest_step against it
                         actual_step = it + 1 - prior_skips - sentinel.total_skips
+                        if wd is not None:
+                            # the save legitimately outlasts a step deadline
+                            # (large state, slow GCS); killed mid-commit it
+                            # would deterministically repeat at this step
+                            # until the restart budget ran out — same
+                            # stand-down the exit save gets
+                            wd.disarm()
                         save_checkpoint_portable(
                             ns.save, state, actual_step, rt, keep_last_n=keep_n,
-                            meta={"batches_consumed": batch_offset + iters_run},
+                            meta=_save_meta(),
                         )
                         if train_obs is not None:
                             train_obs.checkpoints_saved += 1
@@ -527,6 +767,11 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
         train_exc = e
         raise
     finally:
+        # the watchdog stands down FIRST: the exit checkpoint below can
+        # legitimately outlast --step_timeout_s, and an armed deadline
+        # firing mid-commit would turn a clean exit into a hang-coded kill
+        if wd is not None:
+            wd.close()
         # always close the trace — an exception mid-loop must not lose the
         # captured data or wedge the process-wide profiler state. Guarded:
         # a stop_trace failure (e.g. flushing to broken storage) must not
@@ -584,7 +829,7 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                 if not already_committed:
                     save_checkpoint_portable(
                         ns.save, state, final_step, rt, keep_last_n=keep_n,
-                        meta={"batches_consumed": batches_now},
+                        meta=_save_meta(),
                     )
                 if train_exc is not None:
                     # the event fires even when the write was skipped (e.g.
@@ -650,4 +895,7 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
         "losses": losses,
         "iter_ms": prof.avg_iter_ms if prof.iter_times_ms else None,
         "state": state,
+        # the elastic child maps this to EXIT_PREEMPTED: a signal-stop run
+        # completed nothing abnormal, but the supervisor must restart it
+        "signaled": exit_handler.signaled,
     }

@@ -1,0 +1,167 @@
+"""Hang watchdog: a heartbeat deadline around each training step.
+
+The preemption signature `core/signals.py` documents — a device vanishing
+mid-collective — does not crash the survivors: their next collective simply
+never completes, and the job burns pod-hours in silence (MegaScale NSDI '24
+§5 reports stalled collectives as the dominant *undetected* failure mode).
+This module converts that silence into a supervised restart:
+
+- :class:`HangWatchdog` — a daemon thread armed around each train step
+  (``arm(step)`` / ``disarm()``) with a ``--step_timeout_s`` deadline. A
+  step that outlives its deadline fires ``on_hang(step)`` exactly once
+  (all-thread stack dump + flight-recorder dump + best-effort emergency
+  save, wired by the trainer) and then hard-exits with :data:`EXIT_HANG`,
+  so the supervisor (`core/elastic.py`) restarts instead of waiting forever.
+- :class:`StateHolder` — the last *bound* train state (post-rebind, pre-
+  donation). The train step donates its input buffers, so an emergency save
+  from the watchdog thread is only legal while the holder is marked valid;
+  the trainer invalidates it across each donating dispatch. On a real
+  stalled collective the held buffers may be unreachable anyway — the save
+  is best-effort by contract, and the last committed interval checkpoint
+  remains the floor.
+- :func:`dump_all_stacks` — every thread's Python stack, for the flight
+  dump and stderr (the "where was everyone when the collective stalled"
+  forensic the operator otherwise reconstructs by hand).
+
+The first armed step of a process gets its deadline scaled by
+``warmup_scale`` (default 10x): it carries XLA compilation, and declaring a
+compile a hang would turn every cold start into a crash loop.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+#: child exit code the supervisor maps to "watchdog-declared hang"
+#: (the full contract lives in core/elastic.py)
+EXIT_HANG = 77
+
+
+def dump_all_stacks() -> str:
+    """Format the Python stack of every live thread (watchdog thread
+    included — its own frames are the cheapest proof the dump worked)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    parts = []
+    for tid, frame in sys._current_frames().items():
+        parts.append(
+            f"--- thread {names.get(tid, '?')} (ident {tid}) ---\n"
+            + "".join(traceback.format_stack(frame))
+        )
+    return "\n".join(parts)
+
+
+class StateHolder:
+    """Thread-safe holder of the last bound (non-donated) train state.
+
+    The trainer calls ``set`` after each completed iteration's rebind and
+    ``invalidate`` immediately before the next donating ``train_step``
+    dispatch; the watchdog's emergency save reads ``snapshot`` and gets
+    ``None`` whenever saving would touch donated buffers."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state: Any = None
+        self._meta: Dict[str, Any] = {}
+        self._valid = False
+
+    def set(self, state: Any, **meta: Any) -> None:
+        with self._lock:
+            self._state = state
+            self._meta = dict(meta)
+            self._valid = True
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._valid = False
+
+    def snapshot(self) -> Optional[Dict[str, Any]]:
+        """``{"state": ..., **meta}`` while valid, else None."""
+        with self._lock:
+            if not self._valid or self._state is None:
+                return None
+            return {"state": self._state, **self._meta}
+
+
+class HangWatchdog:
+    """Deadline thread: ``arm(step)`` starts a countdown, ``disarm()``
+    cancels it; an expired countdown fires ``on_hang(step)`` once and then
+    ``os._exit(exit_code)`` (``exit_code=None`` skips the exit — unit
+    tests observe the firing without killing the interpreter).
+
+    ``on_hang`` failures are printed, never raised, and never prevent the
+    exit: a broken forensics path must not leave the process hanging —
+    that is the exact failure this class exists to end."""
+
+    def __init__(
+        self,
+        timeout_s: float,
+        on_hang: Callable[[int], None],
+        exit_code: Optional[int] = EXIT_HANG,
+        warmup_scale: float = 10.0,
+        poll_s: Optional[float] = None,
+    ):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.on_hang = on_hang
+        self.exit_code = exit_code
+        self.warmup_scale = max(1.0, float(warmup_scale))
+        self.fired = False
+        self._armed_before = False
+        self._lock = threading.Lock()
+        self._deadline: Optional[float] = None
+        self._step: int = -1
+        self._stop = threading.Event()
+        self._poll_s = poll_s if poll_s else max(0.02, min(0.5, timeout_s / 4))
+        self._thread = threading.Thread(
+            target=self._run, name="hang-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def arm(self, step: int, warmup: bool = False) -> None:
+        """Start the countdown for ``step``. ``warmup=True`` applies the
+        compile-length deadline to THIS step too — the trainer passes it on
+        any step it knows will recompile (a rampup batch-size transition),
+        not just the process's first step; a 1x deadline there would
+        declare a healthy recompile a hang."""
+        scale = self.warmup_scale if (warmup or not self._armed_before) else 1.0
+        self._armed_before = True
+        with self._lock:
+            self._step = int(step)
+            self._deadline = time.monotonic() + self.timeout_s * scale
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._deadline = None
+
+    @property
+    def armed(self) -> bool:
+        with self._lock:
+            return self._deadline is not None
+
+    def close(self) -> None:
+        """Stop the thread (trainer teardown — also disarms, so a slow exit
+        checkpoint cannot be declared a hang)."""
+        self.disarm()
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            with self._lock:
+                deadline, step = self._deadline, self._step
+            if deadline is None or time.monotonic() < deadline:
+                continue
+            self.fired = True
+            try:
+                self.on_hang(step)
+            except Exception as e:  # noqa: BLE001 — forensics must not block the exit
+                print(f"watchdog on_hang failed: {e!r}", file=sys.stderr, flush=True)
+            if self.exit_code is not None:
+                os._exit(self.exit_code)
+            return  # exit_code None (tests): fire once, then stand down

@@ -177,17 +177,81 @@ class TrainStats:
         return out.render()
 
 
+class ElasticStats:
+    """Supervisor-side gauge set for elastic training (`core/elastic.py`):
+    the ``--obs_port`` sidecar of a supervised run is owned by the
+    SUPERVISOR (the child gets its port stripped — two listeners on one
+    port), and what an operator needs from it is the restart story: is this
+    a re-planning topology resume or a crash loop? Rendered on ``/metrics``
+    and, as plain JSON, on ``/healthz`` (:meth:`health`)."""
+
+    def __init__(self):
+        self.started_at = time.time()
+        self.restarts_total = 0
+        self.replans_total = 0
+        self.last_exit_mode: Optional[str] = None
+        self.last_exit_code: Optional[int] = None
+        self.watchdog_armed = False  # current child launched with --step_timeout_s
+        self.child_alive = False
+        self.current_plan_hash: Optional[str] = None
+        self.world_size: Optional[int] = None
+        self.last_step: Optional[int] = None
+
+    def health(self) -> Dict[str, Any]:
+        """The ``/healthz`` JSON body — the same supervisor state, scrapeless."""
+        return {
+            "status": "ok",
+            "restarts_total": self.restarts_total,
+            "replans_total": self.replans_total,
+            "last_exit_mode": self.last_exit_mode,
+            "last_exit_code": self.last_exit_code,
+            "watchdog_armed": self.watchdog_armed,
+            "child_alive": self.child_alive,
+            "current_plan_hash": self.current_plan_hash,
+            "world_size": self.world_size,
+            "last_step": self.last_step,
+        }
+
+    def render(self) -> str:
+        out = PromText()
+        out.add("elastic_uptime_seconds", time.time() - self.started_at)
+        out.add("elastic_restarts_total", self.restarts_total, mtype="counter",
+                help_="child restarts issued by the elastic supervisor")
+        out.add("elastic_replans_total", self.replans_total, mtype="counter",
+                help_="topology-change re-plans (GTA017 resumes)")
+        out.add("elastic_child_alive", self.child_alive)
+        out.add("elastic_watchdog_armed", self.watchdog_armed,
+                help_="current child runs under a --step_timeout_s hang watchdog")
+        if self.last_exit_mode is not None:
+            out.add("elastic_last_exit_mode_info", 1,
+                    labels={"mode": self.last_exit_mode,
+                            "code": self.last_exit_code},
+                    help_="most recent child exit classification (mode in labels)")
+        if self.current_plan_hash is not None:
+            out.add("elastic_current_plan_info", 1,
+                    labels={"plan_hash": self.current_plan_hash},
+                    help_="plan hash the run is currently training under")
+        out.add("elastic_world_size", self.world_size)
+        out.add("elastic_last_step", self.last_step,
+                help_="newest committed checkpoint step")
+        return out.render()
+
+
 class ObsServer:
     """Sidecar HTTP listener for headless runs (``--obs_port``): serves
     ``GET /metrics`` (Prometheus text from ``metrics_fn``) and ``GET
     /healthz`` on its own daemon thread, so a training job with no serving
-    stack is still scrapeable. ``port=0`` binds an ephemeral port (tests)."""
+    stack is still scrapeable. ``health_fn`` (optional) supplies the
+    ``/healthz`` JSON body — the elastic supervisor publishes its restart
+    state there. ``port=0`` binds an ephemeral port (tests)."""
 
     def __init__(self, metrics_fn: Callable[[], str], port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 health_fn: Optional[Callable[[], Dict[str, Any]]] = None):
         # loopback by default, matching run_server: an unauthenticated
         # telemetry endpoint must not silently bind all interfaces
         self.metrics_fn = metrics_fn
+        self.health_fn = health_fn
         obs = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -198,7 +262,8 @@ class ObsServer:
                         body = obs.metrics_fn().encode()
                         ctype = CONTENT_TYPE
                     elif path == "/healthz":
-                        body = json.dumps({"status": "ok"}).encode()
+                        doc = obs.health_fn() if obs.health_fn else {"status": "ok"}
+                        body = json.dumps(doc).encode()
                         ctype = "application/json"
                     else:
                         body = b'{"error": "use /metrics or /healthz"}'

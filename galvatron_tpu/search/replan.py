@@ -1,0 +1,251 @@
+"""Topology-change re-plan: the search engine as a *resume* subsystem.
+
+The paper's premise is that the best parallelism plan is a function of the
+hardware topology — so when a TPU pod shrinks under a run (preemption,
+slice maintenance), the correct response is not "retry the old plan on
+whatever is left" (Varuna/Bamboo approximate this with hand-built
+reconfiguration tables) but a *re-search*: run the DP for the mesh that
+actually exists and resume the portable checkpoint under the winner.
+
+This module is that entry point, called by the elastic supervisor's child
+(`core/elastic.py`) when the checkpoint's topology fingerprint trips
+GTA017:
+
+1. :func:`find_cached_plan` — scan the plan caches (``<ckpt>/replans/``
+   first: plans earlier restarts of THIS run searched; then
+   ``configs/strategies/``: the checked-in exemplars) for a plan whose
+   provenance matches (model, live world size, global batch) and that
+   passes ``check_plan`` cleanly. A second restart at the same shrunken
+   world must not pay the search again.
+2. :func:`replan_for_world` — run :class:`SearchEngine` for the new mesh on
+   analytic model costs (no profile exists for a topology that appeared
+   mid-run; the analytic model is exactly the "search before profiling"
+   path `search/theoretical.py` provides) at the run's own global batch
+   size, and save the result through ``save_result`` — which self-checks
+   the emitted plan and stamps the self-describing provenance the next
+   cache lookup keys on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, List, Optional, Tuple
+
+
+class ReplanInfeasibleError(RuntimeError):
+    """No strategy fits the live topology under the re-plan budget. The
+    elastic child maps this to its own exit code so the supervisor gives up
+    instead of re-running the identical doomed search every restart."""
+
+
+def default_cache_dirs(load_dir: Optional[str]) -> List[str]:
+    """The plan-cache tiers, in lookup order: the run's own ``replans/``
+    (plans earlier restarts searched), then the repo's checked-in
+    ``configs/strategies/`` — resolved against the PACKAGE root, not the
+    cwd, so a run launched from anywhere still sees it."""
+    dirs = []
+    if load_dir:
+        dirs.append(os.path.join(os.path.abspath(load_dir), "replans"))
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    dirs.append(os.path.join(root, "configs", "strategies"))
+    return dirs
+
+
+def scan_plan_cache(
+    cache_dirs: List[str], match: Callable[[str, Any], bool]
+) -> Optional[str]:
+    """First strategy JSON for which ``match(path, decoded)`` holds.
+    Directories are scanned in order and files within one in sorted order
+    (deterministic choice); unreadable/non-JSON candidates are skipped."""
+    for cd in cache_dirs:
+        if not cd or not os.path.isdir(cd):
+            continue
+        for name in sorted(os.listdir(cd)):
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(cd, name)
+            try:
+                with open(path) as f:
+                    d = json.load(f)
+            except (OSError, ValueError):
+                continue
+            try:
+                if match(path, d):
+                    return path
+            except Exception:
+                continue  # a malformed candidate is "no match", never a crash
+    return None
+
+
+def find_plan_by_hash(cache_dirs: List[str], want_hash: str) -> Optional[str]:
+    """Cached plan whose semantic hash equals ``want_hash`` (the plan-
+    continuity lookup: a same-topology restart re-adopting the plan the
+    checkpoint was actually training)."""
+    from galvatron_tpu.core.strategy import plan_hash
+
+    return scan_plan_cache(
+        cache_dirs,
+        lambda _path, d: isinstance(d, dict) and plan_hash(d) == want_hash,
+    )
+
+
+def plan_provenance_matches(
+    d: Any, model_name: str, world: int, global_bsz: int
+) -> bool:
+    """True when a strategy JSON's self-describing provenance says it was
+    searched for exactly this (model, world, batch) cell."""
+    if not isinstance(d, dict):
+        return False
+
+    def _as_int(key):
+        try:
+            return int(d.get(key) or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    if _as_int("num_devices") != world:
+        return False
+    if global_bsz and _as_int("global_bsz") != global_bsz:
+        return False
+    if model_name and d.get("model_size") and d["model_size"] != model_name:
+        return False
+    return True
+
+
+def find_cached_plan(
+    cache_dirs: List[str],
+    model_config,
+    model_name: str,
+    world: int,
+    global_bsz: int,
+    memory_budget_mb: Optional[float] = None,
+    verbose: bool = True,
+) -> Optional[str]:
+    """First cached plan (provenance match + clean ``check_plan``) for the
+    live topology, or None. ``memory_budget_mb`` is the LIVE re-plan budget:
+    without it check_plan would fall back to the candidate's own embedded
+    ``memory_constraint_gb`` — and a checked-in exemplar searched under a
+    bigger budget would pass its own record only to OOM the shrunken
+    devices the fresh-search path correctly sizes for."""
+    from galvatron_tpu.analysis import plan_check
+    from galvatron_tpu.analysis.diagnostics import errors
+
+    def match(path, d):
+        if not plan_provenance_matches(d, model_name, world, global_bsz):
+            return False
+        diags = plan_check.check_plan(
+            d, model_config=model_config, world_size=world,
+            global_bsz=global_bsz or None,
+            memory_budget_mb=memory_budget_mb, source=path,
+        )
+        if errors(diags):
+            if verbose:
+                print(f"replan cache: {path} matches but fails check_plan; skipping")
+            return False
+        return True
+
+    return scan_plan_cache(cache_dirs, match)
+
+
+def replan_for_world(
+    model_config,
+    world: int,
+    global_bsz: int,
+    out_path: str,
+    model_name: str = "",
+    search_space: str = "full",
+    memory_gb: float = 16.0,
+    max_tp: int = 8,
+    max_chunks: int = 16,
+    mixed_precision: str = "bf16",
+    verbose: bool = True,
+) -> str:
+    """Search a fresh plan for ``world`` devices at the run's global batch
+    and save it (self-checked + self-describing) to ``out_path``. Raises
+    :class:`ReplanInfeasibleError` when nothing is feasible under
+    ``memory_gb`` — the elastic child exits with its own code and the
+    supervisor gives up, not a crash loop that re-runs the doomed search."""
+    from galvatron_tpu.search.cost_model import ProfiledHardware
+    from galvatron_tpu.search.search_engine import (
+        SearchEngine,
+        SearchSpace,
+        apply_search_space,
+    )
+    from galvatron_tpu.search.theoretical import analytic_model_costs
+
+    costs = analytic_model_costs(model_config, mixed_precision=mixed_precision)
+    space = apply_search_space(
+        SearchSpace(
+            world_size=world,
+            max_tp=max_tp,
+            moe_experts=getattr(model_config, "moe_experts", 0),
+        ),
+        search_space,
+    )
+    eng = SearchEngine(
+        costs,
+        ProfiledHardware(),
+        num_layers=model_config.total_layers,
+        space=space,
+        memory_budget_mb=memory_gb * 1024.0,
+        mixed_precision=mixed_precision,
+        section_pipeline=bool(getattr(model_config, "swin_depths", ())),
+        model_config=model_config,
+        model_name=model_name,
+    )
+    res = eng.search([global_bsz], max_chunks=max_chunks, verbose=verbose)
+    if res is None:
+        raise ReplanInfeasibleError(
+            f"re-plan failed: no feasible strategy for {world} devices at "
+            f"global batch {global_bsz} under {memory_gb} GB/device "
+            "(--replan_memory_gb raises the budget)"
+        )
+    d = os.path.dirname(os.path.abspath(out_path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    eng.save_result(res, out_path)
+    return out_path
+
+
+def resolve_plan_for_topology(
+    model_config,
+    world: int,
+    global_bsz: int,
+    cache_dirs: List[str],
+    out_dir: str,
+    model_name: str = "",
+    search_space: str = "full",
+    memory_gb: float = 16.0,
+    max_tp: int = 8,
+    mixed_precision: str = "bf16",
+    verbose: bool = True,
+) -> Tuple[str, str]:
+    """The supervisor-facing entry: ``(plan_path, source)`` where source is
+    ``"cache"`` or ``"search"``. A fresh search lands in ``out_dir`` under a
+    provenance-keyed name, which makes it the cache hit of the *next*
+    restart at this topology."""
+    cached = find_cached_plan(
+        cache_dirs, model_config, model_name, world, global_bsz,
+        memory_budget_mb=memory_gb * 1024.0, verbose=verbose,
+    )
+    if cached is not None:
+        if verbose:
+            print(f"re-plan: cached plan for {world} devices → {cached}")
+        return cached, "cache"
+    out_path = os.path.join(
+        out_dir,
+        f"replan_{model_name or 'model'}_{world}dev_bsz{global_bsz}.json",
+    )
+    if verbose:
+        print(
+            f"re-plan: searching a strategy for {world} devices "
+            f"(bsz {global_bsz}, space {search_space!r}, analytic costs)"
+        )
+    replan_for_world(
+        model_config, world, global_bsz, out_path,
+        model_name=model_name, search_space=search_space,
+        memory_gb=memory_gb, max_tp=max_tp,
+        mixed_precision=mixed_precision, verbose=verbose,
+    )
+    return out_path, "search"

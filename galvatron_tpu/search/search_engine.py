@@ -79,6 +79,32 @@ class SearchSpace:
     vocab_size: int = 0
 
 
+def apply_search_space(space: SearchSpace, name: str) -> SearchSpace:
+    """Restrict ``space`` in place per the ``--search_space`` presets
+    (reference: the check_cost_model search-space modes). One rule shared by
+    the CLI and the elastic re-plan entry point, so a supervised restart
+    searches exactly the subspace the operator originally asked for."""
+    if name == "dp":
+        space.max_tp, space.pp_choices = 1, [1]
+    elif name == "tp":
+        space.pp_choices = [1]
+    elif name == "pp":
+        space.max_tp = 1
+    elif name == "dp+tp":
+        space.pp_choices = [1]
+    elif name == "dp+pp":
+        space.max_tp = 1
+    elif name == "sdp":
+        space.max_tp, space.pp_choices = 1, [1]
+    elif name == "3d":
+        # pure pp x tp x dp grid: no ZeRO/ckpt/layout/SP variants
+        space.allow_zero2 = space.allow_zero3 = False
+        space.allow_ckpt = space.allow_sp = space.allow_strided = False
+    elif name != "full":
+        raise ValueError(f"unknown search_space preset {name!r}")
+    return space
+
+
 def _pow2s(n: int) -> List[int]:
     out, v = [], 1
     while v <= n:

@@ -129,6 +129,31 @@ def test_retry_does_not_retry_non_io_errors():
     assert len(calls) == 1
 
 
+def test_backoff_full_jitter_bounds():
+    """Full jitter (AWS sense): each delay is uniform in [0, capped
+    exponential]. A deterministic schedule synchronizes every host of a pod
+    retrying shared storage — a thundering herd on NFS/GCS — so jitter is
+    the DEFAULT; bounds are pinned here so the distribution cannot silently
+    regress to a constant."""
+    import random
+
+    p = RetryPolicy(attempts=6, base_delay_s=0.2, max_delay_s=1.0, backoff=2.0)
+    assert p.jitter == "full"  # the default IS the jittered schedule
+    rng = random.Random(1234)
+    for attempt, cap in [(0, 0.2), (1, 0.4), (2, 0.8), (3, 1.0), (4, 1.0)]:
+        assert p.max_delay(attempt) == pytest.approx(cap)
+        draws = [p.delay(attempt, rng=rng) for _ in range(200)]
+        assert all(0.0 <= d <= cap for d in draws)
+        # uniform over [0, cap], not constant: spread covers the range
+        assert max(draws) - min(draws) > 0.5 * cap
+        assert min(draws) < 0.25 * cap < max(draws)
+    # deterministic mode restores the old schedule exactly
+    pd = RetryPolicy(base_delay_s=0.2, max_delay_s=1.0, backoff=2.0, jitter="none")
+    assert [pd.delay(a) for a in range(4)] == pytest.approx([0.2, 0.4, 0.8, 1.0])
+    with pytest.raises(ValueError):
+        RetryPolicy(jitter="sometimes")
+
+
 def test_fault_env_parsing():
     faults.init_from_env("kill_mid_save=1, fail_io=3,nan_at_step=5,nan_count")
     assert faults.active() == {
