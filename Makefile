@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test test-all bench dryrun lint check-plan chaos clean
+.PHONY: all native test test-all bench dryrun lint check-plan chaos data-smoke clean
 
 all: native
 
@@ -42,6 +42,13 @@ chaos:
 	  --train_iters 4 --save /tmp/galvatron_chaos --save_interval 2 \
 	  --max_restarts 3 --step_timeout_s 5 --replan_search_space dp+tp
 	$(PY) -c "from galvatron_tpu.core.checkpoint import latest_step; s = latest_step('/tmp/galvatron_chaos'); assert s == 4, s; print('chaos shrink ok: committed step', s)"
+
+# data-pipeline smoke (docs/DESIGN.md § Data pipeline): tokenize two tiny
+# corpora → 0.7/0.3 mixture → pack → 4 traced train iters; asserts
+# packing_efficiency >= 0.9, mixture ratios within the ±1-sample bound, and
+# checkpointed per-source cursor exactness
+data-smoke:
+	env JAX_PLATFORMS=cpu $(PY) experiments/data_smoke.py
 
 # headline metric on the real chip — prints one JSON line
 bench:

@@ -153,8 +153,30 @@ def _add_training_args(p: argparse.ArgumentParser):
     g.add_argument("--attn_impl", type=str, default="auto", choices=["auto", "flash", "xla"])
     # checkpoint/resume (capability the reference only gestures at; SURVEY §5)
     g.add_argument("--data_path", type=str, default=None,
-                   help="indexed-corpus prefix (<prefix>.bin/.idx.json, see "
-                   "galvatron_tpu.core.data); default = synthetic tokens")
+                   help="corpus prefix: a sharded manifest "
+                   "(<prefix>.shards.json, galvatron_tpu.data) or a legacy "
+                   "single-file <prefix>.bin/.idx.json pair; default = "
+                   "synthetic tokens")
+    # production data pipeline (galvatron_tpu/data/; DESIGN.md § Data pipeline)
+    g.add_argument("--data_mixture", type=str, default=None,
+                   help="deterministic weighted multi-corpus mixture: a JSON "
+                   "file ({'sources': [{'name','prefix','weight'}, ...]}, see "
+                   "configs/data/) or inline 'prefix=weight,prefix=weight'. "
+                   "Position-addressable — per-source consumption is exact "
+                   "across preempt/resume and batch-size changes")
+    g.add_argument("--pack_sequences", type=int, default=0,
+                   help="1 = greedy first-fit packing of documents into "
+                   "fixed-seq_len rows with segment ids: cross-document "
+                   "attention blocked, per-segment position reset, loss "
+                   "masked at boundaries; true-token MFU + "
+                   "packing_efficiency reported. Needs --data_path or "
+                   "--data_mixture and the xla attention path")
+    g.add_argument("--prefetch_depth", type=int, default=0,
+                   help="async input prefetch: a background host thread "
+                   "assembles + device-transfers batch k+1 while step k "
+                   "runs (bounded at this many in-flight batches; 2 = "
+                   "double buffering). 0 = synchronous fetch. Needs "
+                   "--data_path or --data_mixture")
     g.add_argument("--metrics_path", type=str, default=None,
                    help="JSONL structured metrics sink (per-iter loss/time)")
     g.add_argument("--save", type=str, default=None, help="checkpoint directory")
@@ -440,6 +462,10 @@ def resolve_attn_impl(cfg, ns: argparse.Namespace):
     impl = getattr(ns, "attn_impl", "auto")
     if impl != "auto":
         return cfg.replace(attn_impl=impl)
+    if getattr(cfg, "pack_sequences", False):
+        # packed sequences need the segment-masked einsum path; 'auto' must
+        # not pick the flash kernels (build_runtime would refuse them loudly)
+        return cfg.replace(attn_impl="xla")
     if jax.default_backend() != "cpu":
         return cfg.replace(attn_impl="flash")
     return cfg

@@ -151,11 +151,14 @@ class GPTWindowDataset:
                 f"global_batch_size {global_batch_size} exceeds the "
                 f"{self.num_samples} available windows"
             )
-        from galvatron_tpu.core.data_native import shuffle_index
+        from galvatron_tpu.core.data_native import mix_seed, shuffle_index
 
         epoch, skip = divmod(start_batch, per_epoch)
         while epochs is None or epoch < epochs:
-            order = shuffle_index(self.num_samples, self.seed + epoch)
+            # mixed (seed, epoch) derivation, not seed + epoch: the additive
+            # form aliases adjacent streams (seed s epoch 1 == seed s+1
+            # epoch 0), silently replaying another run's order
+            order = shuffle_index(self.num_samples, mix_seed(self.seed, epoch))
             for b in range(skip, per_epoch):
                 idx = order[b * global_batch_size : (b + 1) * global_batch_size]
                 yield np.stack([self.sample(int(i)) for i in idx])

@@ -67,6 +67,19 @@ def _splitmix64_np(x: np.ndarray) -> np.ndarray:
     return z ^ (z >> np.uint64(31))
 
 
+def mix_seed(*vals: int) -> int:
+    """Collision-resistant combine of integer seed components via a splitmix64
+    chain. The additive ``seed + epoch`` scheme the loaders used aliases
+    adjacent streams — ``(seed=s, epoch=1)`` replayed ``(seed=s+1, epoch=0)``
+    exactly — so every (seed, epoch) / (seed, source, epoch) derivation goes
+    through this instead."""
+    h = np.uint64(0x9E3779B97F4A7C15)
+    with np.errstate(over="ignore"):
+        for v in vals:
+            h = _splitmix64_np(h ^ np.uint64(int(v) % (1 << 64)))
+    return int(h)
+
+
 def shuffle_index(n: int, seed: int) -> np.ndarray:
     """Deterministic permutation of [0, n): stable argsort of
     splitmix64(seed ^ i). Native when available, numpy otherwise — identical

@@ -20,8 +20,21 @@ class _RandomStreamDataset:
 
     ``start_batch`` resumes mid-stream without materializing the skipped
     batches: batch contents depend only on (seed, epoch, position), so the
-    offset is pure index arithmetic. Subclasses implement ``_sample(rng, B)``
-    → one (B, sample_len+1) int32 batch."""
+    offset is pure index arithmetic. Subclasses implement ``_sample_rows(ids)``
+    → one (len(ids), sample_len+1) int32 batch keyed by SAMPLE identity.
+
+    Two determinism fixes over the original implementation:
+
+    - the per-epoch permutation is seeded from the MIXED ``(seed, epoch)``
+      pair (``data_native.mix_seed``), not ``seed + epoch`` — the additive
+      scheme aliased adjacent streams (``(seed=s, epoch=1)`` replayed
+      ``(seed=s+1, epoch=0)``'s order exactly);
+    - row contents are keyed by each row's sample index, not by the FIRST
+      index of its batch — the old scheme generated the whole batch from
+      ``idx[0]``, so the epoch permutation never actually permuted samples
+      (every epoch trained epoch-0's multiset in a thin disguise) and the
+      sample-domain cursor had no per-sample identity to be exact over.
+      Epochs now reshuffle real per-sample rows."""
 
     def __init__(self, size: int = 1024, seed: int = 1234):
         self.size = size
@@ -33,12 +46,27 @@ class _RandomStreamDataset:
     def batches_per_epoch(self, global_batch_size: int) -> int:
         return max(0, (self.size - global_batch_size) // global_batch_size + 1)
 
-    def _sample(self, rng: np.random.RandomState, global_batch_size: int) -> np.ndarray:
+    def _sample_rows(self, ids: np.ndarray) -> np.ndarray:
         raise NotImplementedError
+
+    def _row_hash(self, ids: np.ndarray, n_cols: int) -> np.ndarray:
+        """(len(ids), n_cols) uint64 lattice of splitmix64(seed ⊕ cell id) —
+        the vectorized per-sample content generator."""
+        from galvatron_tpu.core.data_native import _splitmix64_np, mix_seed
+
+        base = np.uint64(mix_seed(self.seed, 0xDA7A))
+        with np.errstate(over="ignore"):
+            cell = (
+                np.asarray(ids, np.uint64)[:, None] * np.uint64(n_cols)
+                + np.arange(n_cols, dtype=np.uint64)[None]
+            )
+            return _splitmix64_np(base ^ cell)
 
     def batch_iterator(
         self, global_batch_size: int, epochs: Optional[int] = None, start_batch: int = 0
     ) -> Iterator[np.ndarray]:
+        from galvatron_tpu.core.data_native import mix_seed, shuffle_index
+
         per_epoch = self.batches_per_epoch(global_batch_size)
         if per_epoch == 0:
             raise ValueError(
@@ -47,14 +75,11 @@ class _RandomStreamDataset:
             )
         epoch, skip = divmod(start_batch, per_epoch)
         while epochs is None or epoch < epochs:
-            rng = np.random.RandomState(self.seed + epoch)
-            order = rng.permutation(self.size)
+            order = shuffle_index(self.size, mix_seed(self.seed, epoch))
             start_i = skip * global_batch_size
             skip = 0
             for i in range(start_i, self.size - global_batch_size + 1, global_batch_size):
-                idx = order[i : i + global_batch_size]
-                batch_rng = np.random.RandomState(self.seed * 1000003 + int(idx[0]))
-                yield self._sample(batch_rng, global_batch_size)
+                yield self._sample_rows(order[i : i + global_batch_size])
             epoch += 1
 
 
@@ -66,10 +91,9 @@ class RandomTokenDataset(_RandomStreamDataset):
         self.vocab_size = vocab_size
         self.seq_len = seq_len
 
-    def _sample(self, rng, global_batch_size):
-        return rng.randint(
-            0, self.vocab_size, (global_batch_size, self.seq_len + 1), np.int32
-        )
+    def _sample_rows(self, ids):
+        h = self._row_hash(ids, self.seq_len + 1)
+        return (h % np.uint64(self.vocab_size)).astype(np.int32)
 
 
 class RandomImageDataset(_RandomStreamDataset):
@@ -83,9 +107,10 @@ class RandomImageDataset(_RandomStreamDataset):
         self.n_pixels = n_pixels
         self.num_classes = num_classes
 
-    def _sample(self, rng, global_batch_size):
-        pixels = rng.randint(0, 256, (global_batch_size, self.n_pixels), np.int32)
-        labels = rng.randint(0, self.num_classes, (global_batch_size, 1), np.int32)
+    def _sample_rows(self, ids):
+        h = self._row_hash(ids, self.n_pixels + 1)
+        pixels = (h[:, : self.n_pixels] % np.uint64(256)).astype(np.int32)
+        labels = (h[:, self.n_pixels :] % np.uint64(self.num_classes)).astype(np.int32)
         return np.concatenate([pixels, labels], axis=1)
 
 

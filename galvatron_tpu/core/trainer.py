@@ -137,6 +137,29 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
         cfg = model_config_from_args(ns)
     from galvatron_tpu.core.arguments import resolve_attn_impl
 
+    # data-pipeline flags (galvatron_tpu/data/): packing rides the model
+    # config (split_batch / attention masking / position reset key off it),
+    # and must be set BEFORE attn resolution so 'auto' lands on the
+    # segment-maskable xla path instead of flash
+    if getattr(ns, "pack_sequences", 0):
+        cfg = cfg.replace(pack_sequences=True)
+    use_data_pipe = bool(
+        getattr(ns, "data_mixture", None)
+        or cfg.pack_sequences
+        or getattr(ns, "prefetch_depth", 0)
+    )
+    if use_data_pipe:
+        if not (getattr(ns, "data_mixture", None) or getattr(ns, "data_path", None)):
+            raise ValueError(
+                "--pack_sequences/--prefetch_depth/--data_mixture need a real "
+                "corpus: pass --data_path or --data_mixture"
+            )
+        if getattr(ns, "rampup_batch_size", None):
+            raise ValueError(
+                "--rampup_batch_size is incompatible with the data pipeline "
+                "(mixture/packing/prefetch): the sample-domain cursor is "
+                "defined at one global batch size"
+            )
     cfg = resolve_attn_impl(cfg, ns)
     world = len(jax.devices())
     from galvatron_tpu.analysis import plan_check
@@ -235,6 +258,7 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
     }
     start_step = 0
     batch_offset = 0
+    saved_data_state = None  # checkpoint's data-pipeline cursor (if any)
     if ns.load and latest_step(ns.load) is not None:
         state = restore_checkpoint_portable(ns.load, rt, metrics=metrics)
         start_step = int(np.asarray(state["step"]))
@@ -247,6 +271,8 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
         meta = m.get("meta") if m and isinstance(m.get("meta"), dict) else {}
         if meta:
             batch_offset = int(meta.get("batches_consumed", start_step))
+        if isinstance(meta.get("data_state"), dict):
+            saved_data_state = meta["data_state"]
         saved_fp = meta.get("fingerprint")
         if isinstance(saved_fp, dict):
             from galvatron_tpu.analysis.plan_check import (
@@ -345,10 +371,46 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
     # start_batch fast-forwards by index arithmetic so resume sees the batches
     # an uninterrupted run would (reference has no resume at all); the offset
     # is batches CONSUMED, not optimizer steps — they diverge after skips
-    loader = build_dataloader(
-        cfg, ns.global_train_batch_size, seq, seed=ns.seed, start_batch=batch_offset,
-        data_path=getattr(ns, "data_path", None),
-    )
+    data_pipe = None
+    if saved_data_state is not None and not use_data_pipe:
+        # the checkpoint was trained through the data pipeline; resuming
+        # without its flags would silently continue a real-corpus run on
+        # synthetic tokens (or unpacked windows), bypassing the per-source
+        # verification the subsystem promises
+        raise ValueError(
+            f"--load {ns.load}: the checkpoint records a data-pipeline cursor "
+            f"(sources {sorted(saved_data_state.get('per_source_consumed', {}))}"
+            f"{', packed' if saved_data_state.get('packed') else ''}) but this "
+            "run passes none of --data_mixture/--pack_sequences/"
+            "--prefetch_depth. Resume with the original data flags, or point "
+            "--load elsewhere."
+        )
+    if use_data_pipe:
+        # production input path (galvatron_tpu/data/): sharded corpora,
+        # deterministic mixture, sequence packing, async device prefetch.
+        # The pipeline applies rt.shard_batch itself (on the prefetch thread
+        # when armed), so the loop's data span is a dequeue. A restored
+        # checkpoint's per-source cursor is verified against the rebuilt
+        # schedule — a changed mixture fails loudly instead of silently
+        # replaying or skipping samples.
+        from galvatron_tpu.data import build_data_pipeline
+
+        data_pipe = build_data_pipeline(
+            cfg, ns.global_train_batch_size, seq, seed=ns.seed,
+            start_batch=batch_offset,
+            data_path=getattr(ns, "data_path", None),
+            mixture=getattr(ns, "data_mixture", None),
+            pack=cfg.pack_sequences,
+            prefetch_depth=getattr(ns, "prefetch_depth", 0),
+            put_fn=rt.shard_batch,
+            resume_state=saved_data_state,
+        )
+        loader = iter(data_pipe)
+    else:
+        loader = build_dataloader(
+            cfg, ns.global_train_batch_size, seq, seed=ns.seed, start_batch=batch_offset,
+            data_path=getattr(ns, "data_path", None),
+        )
     from galvatron_tpu.core.signals import GracefulExitHandler
 
     # per-iter host syncs (float(loss) every step) serialize dispatch with
@@ -469,15 +531,25 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
     prior_skips = batch_offset - start_step
     iters_run = 0
 
-    def _save_meta():
+    def _save_meta(batches=None, samples=None):
         # one schema for every save path (interval, exit, watchdog): the
-        # stream cursor in BOTH domains plus the topology fingerprint
-        return {
-            "batches_consumed": batch_offset + iters_run,
-            "samples_consumed": samples_done,
+        # stream cursor in BOTH domains plus the topology fingerprint. The
+        # watchdog passes its snapshot's cursors; everyone else defaults to
+        # the live ones.
+        batches = batch_offset + iters_run if batches is None else batches
+        samples = samples_done if samples is None else samples
+        meta = {
+            "batches_consumed": batches,
+            "samples_consumed": samples,
             "global_bsz": int(ns.global_train_batch_size),
             "fingerprint": fingerprint,
         }
+        if data_pipe is not None:
+            # per-source mixture cursor: derived from the sample position, so
+            # a resumed run can VERIFY it replays/skips nothing per source
+            # (state() is pure in the position — watchdog-thread safe)
+            meta["data_state"] = data_pipe.state(samples)
+        return meta
 
     # hang watchdog (--step_timeout_s; core/watchdog.py): armed around each
     # step, fires on a stalled collective — stacks + flight dump + a
@@ -534,12 +606,9 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                     save_checkpoint_portable(
                         ns.save, snap_h["state"], snap_h["step"], rt,
                         keep_last_n=keep_n,
-                        meta={
-                            "batches_consumed": snap_h["batches"],
-                            "samples_consumed": snap_h["samples"],
-                            "global_bsz": int(ns.global_train_batch_size),
-                            "fingerprint": fingerprint,
-                        },
+                        meta=_save_meta(
+                            batches=snap_h["batches"], samples=snap_h["samples"]
+                        ),
                     )
                     print(
                         f"watchdog emergency checkpoint step {snap_h['step']} "
@@ -619,7 +688,15 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                     else:
                         consumed += cur_bs
                     with tracer.span("data", step=it):
-                        batch = rt.shard_batch(next(loader))
+                        # the data pipeline already device-put the batch (on
+                        # its prefetch thread when armed) — the span measures
+                        # a dequeue, which is the point of the prefetcher
+                        batch = (
+                            next(loader)
+                            if data_pipe is not None
+                            else rt.shard_batch(next(loader))
+                        )
+                    pipe_meta = data_pipe.last_meta if data_pipe is not None else {}
                     # counted only once the batch is actually consumed: iters_run
                     # feeds the batches_consumed manifest record, and a crash in
                     # the fetch itself must not make resume skip a real batch
@@ -709,7 +786,10 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                             print(f"iter {it}: loss {loss_val:.4f}")
                     iter_ms = prof.iter_times_ms[-1] if prof.iter_times_ms else None
                     stat = (
-                        stepstats.per_iter(iter_ms, cur_bs)
+                        stepstats.per_iter(
+                            iter_ms, cur_bs,
+                            nonpad_tokens=pipe_meta.get("nonpad_tokens"),
+                        )
                         if metrics.path or train_obs is not None
                         else {}
                     )
@@ -738,6 +818,9 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                             train_obs.tflops_per_device = stat.get("tflops_per_device")
                             train_obs.mfu = stat.get("mfu")
                             train_obs.hfu = stat.get("hfu")
+                            train_obs.packing_efficiency = stat.get(
+                                "packing_efficiency"
+                            )
                     if next_save_at is not None and (it + 1) >= next_save_at:
                         # dir name = the state's actual optimizer step: skipped
                         # iterations (this run's AND pre-crash ones) advanced
@@ -772,6 +855,16 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
         # firing mid-commit would turn a clean exit into a hang-coded kill
         if wd is not None:
             wd.close()
+        # the prefetch thread stands down SECOND, on every exit path — a
+        # producer blocked on its bounded queue must not sit on buffers (or
+        # keep touching the corpus) while the exit checkpoint commits; the
+        # end-of-run mixture/packing summary lands in the JSONL first
+        if data_pipe is not None:
+            try:
+                metrics.log("data_pipeline", **data_pipe.summary(samples_done))
+            except Exception:
+                pass  # observability must not block the shutdown chain
+            data_pipe.close()
         # always close the trace — an exception mid-loop must not lose the
         # captured data or wedge the process-wide profiler state. Guarded:
         # a stop_trace failure (e.g. flushing to broken storage) must not

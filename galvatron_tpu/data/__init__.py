@@ -1,0 +1,55 @@
+"""Production data subsystem: sharded corpora, deterministic mixtures,
+sequence packing, and async device prefetch (docs/DESIGN.md § Data pipeline).
+
+The input path the trainer had before this package was a single-file indexed
+corpus sampled in fixed windows, handed to the device synchronously — every
+short document padded to ``seq_len`` (padded tokens burn real FLOPs and MFU
+silently counted them as useful work), one corpus only, and data time
+serialized against the step. This package is the production replacement:
+
+- ``shards``   — mmap-backed multi-file shard format (fsynced manifest,
+                 ``core/retry.py`` on reads) subsuming the legacy
+                 ``IndexedTokenDataset`` single-file layout;
+- ``mixture``  — deterministic weighted mixture over N corpora, seeded and
+                 position-addressable so the sample-domain resume cursor
+                 (PR 7) converts exactly across batch-size/topology changes;
+- ``packing``  — greedy first-fit packing of documents into fixed-``seq_len``
+                 rows with segment ids (cross-document attention provably
+                 blocked by the model's intra-segment mask);
+- ``prefetch`` — background host thread assembling + device-transferring
+                 batch k+1 while step k runs (double-buffered, clean
+                 shutdown on every trainer exit path);
+- ``pipeline`` — the facade the trainer drives: ``build_data_pipeline``.
+"""
+
+from galvatron_tpu.data.mixture import (
+    MixtureDataset,
+    MixtureSchedule,
+    MixtureSource,
+    parse_mixture,
+)
+from galvatron_tpu.data.packing import PackedDataset, pack_documents
+from galvatron_tpu.data.pipeline import DataPipeline, build_data_pipeline
+from galvatron_tpu.data.prefetch import AsyncPrefetcher
+from galvatron_tpu.data.shards import (
+    ShardedTokenDataset,
+    open_token_dataset,
+    tokenize_text_files,
+    write_sharded_dataset,
+)
+
+__all__ = [
+    "AsyncPrefetcher",
+    "DataPipeline",
+    "MixtureDataset",
+    "MixtureSchedule",
+    "MixtureSource",
+    "PackedDataset",
+    "ShardedTokenDataset",
+    "build_data_pipeline",
+    "open_token_dataset",
+    "pack_documents",
+    "parse_mixture",
+    "tokenize_text_files",
+    "write_sharded_dataset",
+]

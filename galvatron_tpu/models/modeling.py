@@ -158,6 +158,15 @@ class ModelConfig:
     #     fp32 widenings untouched).
     #   'off': the pre-policy behaviour (double gate save + widened saves).
     mlp_recompute: str = "policy"
+    # Packed-sequence input rows (--pack_sequences; galvatron_tpu.data):
+    # a sample row is [tokens (S+1) ‖ segment ids (S+1)] — documents
+    # bin-packed into one fixed-S row. The model then (a) blocks attention
+    # across segment boundaries (intra-segment causal mask — cross-document
+    # attention is provably impossible), (b) resets rope/learned positions
+    # per segment (positions_from_segments), and (c) masks loss at segment
+    # boundaries and on padding (split_batch). CLM decoder-only; requires
+    # the 'xla' attention path (the Pallas kernels carry no segment mask).
+    pack_sequences: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -642,6 +651,39 @@ def apply_rope(x, cos, sin):
     return out.astype(dt)
 
 
+def positions_from_segments(seg):
+    """Per-segment position ids from a ``(B, S)`` packed segment-id array:
+    position i's index within its own segment. Relies on the packer's layout
+    contract — segment ids are monotonically non-decreasing along the row
+    (documents are laid out contiguously), so a segment's start is the last
+    index where the id changed."""
+    idx = jnp.arange(seg.shape[1], dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones_like(seg[:, :1], bool), seg[:, 1:] != seg[:, :-1]], axis=1
+    )
+    seg_start = jax.lax.cummax(jnp.where(is_start, idx[None], 0), axis=1)
+    return idx[None] - seg_start
+
+
+def split_packed_inputs(inputs):
+    """Packed model-input rows ``(B, 2·S)`` = tokens ‖ segment ids →
+    (tokens (B, S), segment ids (B, S), per-segment position ids (B, S))."""
+    s = inputs.shape[1] // 2
+    tokens = inputs[:, :s]
+    seg = inputs[:, s:]
+    return tokens, seg, positions_from_segments(seg)
+
+
+def packed_rope_tables(cfg: ModelConfig, pos_ids):
+    """Per-row rope tables for packed sequences: the shared ``(S, hd/2)``
+    tables gathered by per-segment positions → ``(B, S, hd/2)`` (the same
+    per-row form the serving engine's slot-wise decode uses). For a row that
+    is one whole segment this gathers ``arange(S)`` — bit-identical values to
+    the unpacked broadcast path."""
+    cos, sin = rope_tables(cfg, pos_ids.shape[1])
+    return cos[pos_ids], sin[pos_ids]
+
+
 def alibi_slopes(n_heads: int) -> np.ndarray:
     # standard ALiBi slope schedule (press et al.); baichuan-13B path
     def pow2slopes(n):
@@ -663,7 +705,7 @@ def _repeat_kv(x, n_rep: int):
     )
 
 
-def attention_xla(q, k, v, cfg: ModelConfig, bias=None, q_offset=0):
+def attention_xla(q, k, v, cfg: ModelConfig, bias=None, q_offset=0, seg_ids=None):
     """Reference einsum attention (the 'CoreAttention' path, reference:
     galvatron/core/tensor_parallel/transformer.py:298-435).
 
@@ -672,7 +714,14 @@ def attention_xla(q, k, v, cfg: ModelConfig, bias=None, q_offset=0):
     ``q_offset`` may be a traced scalar, or a traced ``(B,)`` vector giving
     each batch row its own absolute position — the slot-wise entry point used
     by the continuous-batching serving engine, where every row of the batch
-    is a different request at a different depth into its sequence."""
+    is a different request at a different depth into its sequence.
+
+    ``seg_ids`` ((B, S), packed sequences): the causal predicate tightens to
+    intra-segment — query i attends to key j only when ``seg[i] == seg[j]``,
+    so cross-document attention is structurally impossible. The combine is a
+    logical AND on the SAME where/-1e30 pattern the plain causal mask uses:
+    a row holding a single segment produces a bit-identical mask, which is
+    what makes the packed-vs-padded gradient-parity test exact."""
     b, s, nh, hd = q.shape
     k = _repeat_kv(k, nh // k.shape[2])
     v = _repeat_kv(v, nh // v.shape[2])
@@ -684,17 +733,21 @@ def attention_xla(q, k, v, cfg: ModelConfig, bias=None, q_offset=0):
         # yields a per-row mask (scores are (b, n, q, k))
         q_pos = jnp.reshape(jnp.asarray(q_offset), (-1, 1)) + jnp.arange(s)[None]
         k_pos = jnp.arange(k.shape[1])
-        causal = k_pos[None, None, :] <= q_pos[:, :, None]
-        scores = jnp.where(causal[:, None], scores, -1e30)
+        allowed = k_pos[None, None, :] <= q_pos[:, :, None]
+        if seg_ids is not None:
+            allowed = allowed & (seg_ids[:, :, None] == seg_ids[:, None, :])
+        scores = jnp.where(allowed[:, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bnqk,bknh->bqnh", probs, v)
 
 
-def attention(q, k, v, cfg: ModelConfig, bias=None, rope=None):
+def attention(q, k, v, cfg: ModelConfig, bias=None, rope=None, seg_ids=None):
     """``rope``: optional (cos, sin) tables. On the flash path they are fused
     into the Pallas kernels (no HBM round-trip of roped q/k); otherwise
-    apply_rope runs here before the einsum path."""
-    if cfg.attn_impl == "flash" and bias is None:
+    apply_rope runs here before the einsum path. ``seg_ids`` (packed
+    sequences) forces the einsum path — the Pallas kernels carry no segment
+    mask (build_runtime rejects pack_sequences with attn_impl='flash')."""
+    if cfg.attn_impl == "flash" and bias is None and seg_ids is None:
         from galvatron_tpu.ops.flash_attention import flash_attention
 
         nh = q.shape[2]
@@ -721,7 +774,7 @@ def attention(q, k, v, cfg: ModelConfig, bias=None, rope=None):
     if rope is not None:
         q = apply_rope(q, *rope)
         k = apply_rope(k, *rope)
-    return attention_xla(q, k, v, cfg, bias=bias)
+    return attention_xla(q, k, v, cfg, bias=bias, seg_ids=seg_ids)
 
 
 def _repeat_kv_hm(x, n_rep: int):
@@ -908,13 +961,21 @@ def _attn_block_headmajor(x, p, cfg: ModelConfig, rope, remat_attn: bool):
     return y
 
 
-def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: bool = False):
+def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: bool = False,
+               seg_ids=None):
     """``remat_attn`` rematerializes only the attention core (scores/softmax/
     context) in the backward pass — Megatron's "selective" recompute
-    (reference: galvatron/core/tensor_parallel/transformer.py:597,615-636)."""
+    (reference: galvatron/core/tensor_parallel/transformer.py:597,615-636).
+
+    ``seg_ids`` (packed sequences) routes through the einsum path with the
+    intra-segment mask; the head-major flash fast path is skipped (the Pallas
+    kernels carry no segment mask)."""
     b, s, h = x.shape
     hd = cfg.head_dim
-    if cfg.attn_impl == "flash" and cfg.pos_embed != "alibi" and cfg.flash_headmajor:
+    if (
+        cfg.attn_impl == "flash" and cfg.pos_embed != "alibi"
+        and cfg.flash_headmajor and seg_ids is None
+    ):
         from galvatron_tpu.ops.flash_attention import flash_tileable
 
         if flash_tileable(s) and ("wqkv_b" not in p or cfg.qkv_blocked):
@@ -930,12 +991,12 @@ def attn_block(x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: boo
         rel = pos[None, :] - pos[:, None]  # (q, k) negative below diag
         bias = (alibi[:, None, None] * rel[None]).astype(jnp.float32)[None]  # (1,n,q,k)
 
-    def core(q_, k_, v_, bias_):
-        return attention(q_, k_, v_, cfg, bias=bias_, rope=rope)
+    def core(q_, k_, v_, bias_, seg_):
+        return attention(q_, k_, v_, cfg, bias=bias_, rope=rope, seg_ids=seg_)
 
     if remat_attn:
         core = jax.checkpoint(core)
-    o = _constrain_attn_out(core(q, k, v, bias), cfg)
+    o = _constrain_attn_out(core(q, k, v, bias, seg_ids), cfg)
     return attn_output(o, p, cfg, x.dtype)
 
 
@@ -1040,21 +1101,38 @@ def encoder_layer(x, p, cfg: ModelConfig, cos_sin=None, remat_attn: bool = False
 
 
 def decoder_layer(
-    x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: bool = False, enc_out=None
+    x, p, cfg: ModelConfig, cos_sin=None, alibi=None, remat_attn: bool = False,
+    enc_out=None, seg_ids=None
 ):
     x = x + attn_block(
-        norm(x, p["attn_norm"], cfg), p["attn"], cfg, cos_sin, alibi, remat_attn=remat_attn
+        norm(x, p["attn_norm"], cfg), p["attn"], cfg, cos_sin, alibi,
+        remat_attn=remat_attn, seg_ids=seg_ids,
     )
     if enc_out is not None and "cross" in p:
         x = x + cross_attn_block(norm(x, p["cross_norm"], cfg), enc_out, p["cross"], cfg)
     return mlp_residual(x, p, cfg)
 
 
-def embed(tokens, params, cfg: ModelConfig):
+def embed(tokens, params, cfg: ModelConfig, pos_ids=None):
+    """``pos_ids`` ((B, S), packed sequences): learned positions gathered by
+    per-segment position ids instead of the ``arange(S)`` slice — each packed
+    document restarts at position 0 (rope gets the same treatment via
+    packed_rope_tables)."""
     x = params["embed"]["tok"].astype(cfg.dtype)[tokens]
     if cfg.pos_embed == "learned":
         s = tokens.shape[1]
-        x = x + params["embed"]["pos"].astype(cfg.dtype)[:s][None]
+        table = params["embed"]["pos"].astype(cfg.dtype)[:s]
+        if pos_ids is not None:
+            # broadcast-then-gather (not a direct table gather): the backward
+            # is then a per-row placement scatter followed by the SAME
+            # over-batch reduction the unpacked broadcast-add produces, so a
+            # trivially-packed row (positions == arange) yields bit-identical
+            # position-table gradients — the packed-vs-padded parity contract
+            b = pos_ids.shape[0]
+            tbl = jnp.broadcast_to(table[None], (b,) + table.shape)
+            x = x + jnp.take_along_axis(tbl, pos_ids[:, :, None], axis=1)
+        else:
+            x = x + table[None]
     return x
 
 
@@ -1070,15 +1148,32 @@ def forward(params, tokens, cfg: ModelConfig, layer_hook=None):
     """Full forward → logits. ``layer_hook(i, x)`` lets the hybrid-parallel
     runtime insert per-layer sharding constraints and remat (the
     Module_with_relocation + checkpoint_wrapper equivalent, reference:
-    galvatron/core/parallel.py:109-172)."""
-    cos_sin = rope_tables(cfg, tokens.shape[1]) if cfg.pos_embed == "rope" else None
+    galvatron/core/parallel.py:109-172).
+
+    Packed sequences (cfg.pack_sequences): ``tokens`` is the (B, 2·S) packed
+    input row (tokens ‖ segment ids, from split_batch); the segment ids drive
+    the intra-segment attention mask and per-segment position reset, and are
+    handed to the hook as keyword args only in packed mode so non-packing
+    hooks keep their signature."""
+    seg = pos_ids = None
+    if cfg.pack_sequences:
+        tokens, seg, pos_ids = split_packed_inputs(tokens)
+    if cfg.pos_embed == "rope":
+        cos_sin = (
+            packed_rope_tables(cfg, pos_ids)
+            if pos_ids is not None
+            else rope_tables(cfg, tokens.shape[1])
+        )
+    else:
+        cos_sin = None
     alibi = jnp.asarray(alibi_slopes(cfg.num_heads)) if cfg.pos_embed == "alibi" else None
-    x = embed(tokens, params, cfg)
+    hook_kw = {"seg_ids": seg} if seg is not None else {}
+    x = embed(tokens, params, cfg, pos_ids=pos_ids)
     for i, lp in enumerate(params["layers"]):
         if layer_hook is not None:
-            x = layer_hook(i, x, lp)
+            x = layer_hook(i, x, lp, **hook_kw)
         else:
-            x = decoder_layer(x, lp, cfg, cos_sin, alibi)
+            x = decoder_layer(x, lp, cfg, cos_sin, alibi, seg_ids=seg)
     x = norm(x, params["final_norm"], cfg)
     return lm_head(x, params, cfg)
 
@@ -1324,6 +1419,17 @@ def split_batch(batch, cfg: ModelConfig):
         tokens = batch[:, :-1]
         mask = mlm_positions(tokens, cfg)
         return jnp.where(mask, cfg.vocab_size - 1, tokens), jnp.where(mask, tokens, -100)
+    if cfg.pack_sequences:
+        # packed row (B, 2·(S+1)) = tokens ‖ segment ids. Inputs keep both
+        # halves (the model needs the segment ids at every layer); labels are
+        # next-token WITHIN a segment only — a position whose successor
+        # belongs to a different segment (document boundary) or to padding
+        # (segment 0) carries no loss.
+        s1 = batch.shape[1] // 2
+        tokens, seg = batch[:, :s1], batch[:, s1:]
+        inputs = jnp.concatenate([tokens[:, :-1], seg[:, :-1]], axis=1)
+        same = (seg[:, 1:] == seg[:, :-1]) & (seg[:, 1:] > 0)
+        return inputs, jnp.where(same, tokens[:, 1:], -100)
     return batch[:, :-1], batch[:, 1:]
 
 
@@ -1376,8 +1482,9 @@ def lm_loss_sum(params, batch, cfg: ModelConfig, layer_hook=None):
         dec = batch[:, cfg.enc_seq :]
         logits = forward_encdec(params, enc_tokens, dec[:, :-1], cfg, layer_hook=layer_hook)
         return cross_entropy_sum(logits, dec[:, 1:], remat=ce_remat(cfg))
-    tokens = batch[:, :-1]
-    labels = batch[:, 1:]
+    # split_batch, not ad-hoc slicing: packed rows carry segment ids the
+    # boundary-masked labels must be derived from
+    tokens, labels = split_batch(batch, cfg)
     logits = forward(params, tokens, cfg, layer_hook=layer_hook)
     return cross_entropy_sum(logits, labels, remat=ce_remat(cfg))
 

@@ -155,6 +155,7 @@ class TrainStats:
         self.hfu: Optional[float] = None
         self.anomaly_skips = 0
         self.checkpoints_saved = 0
+        self.packing_efficiency: Optional[float] = None
 
     def render(self) -> str:
         out = PromText()
@@ -173,6 +174,9 @@ class TrainStats:
                 help_="achieved model TFLOP/s per device")
         out.add("train_mfu", self.mfu, help_="model FLOPs utilization (PaLM convention)")
         out.add("train_hfu", self.hfu, help_="hardware FLOPs utilization (incl. remat)")
+        out.add("train_packing_efficiency", self.packing_efficiency,
+                help_="non-pad fraction of packed input rows (None-skipped "
+                "when sequence packing is off)")
         render_hbm(out)
         return out.render()
 

@@ -159,29 +159,55 @@ class StepStats:
         return self._peak
 
     def per_iter(
-        self, iter_ms: Optional[float], global_bsz: Optional[float] = None
+        self,
+        iter_ms: Optional[float],
+        global_bsz: Optional[float] = None,
+        nonpad_tokens: Optional[float] = None,
     ) -> Dict[str, Optional[float]]:
         """tokens/s, achieved model TFLOP/s (per device), MFU and HFU for one
         measured iteration. ``global_bsz`` rescales the precomputed step
         FLOPs/tokens linearly (batch-size rampup runs at smaller sizes).
         MFU/HFU are None when the device peak is unknown (CPU sim) — a
-        made-up denominator would be worse than no number."""
+        made-up denominator would be worse than no number.
+
+        ``nonpad_tokens`` (packed sequences): the batch's real-token count.
+        ``tokens_per_s`` and MFU/HFU then count NON-PAD tokens only — padded
+        positions burn FLOPs but are not useful work, and counting them made
+        MFU silently overstate utilization exactly when packing was off. The
+        raw (pad-inclusive) rate stays available as ``tokens_per_s_raw`` so
+        pre-packing dashboards keep their meaning, and the ratio is exposed
+        as ``packing_efficiency``."""
         if not iter_ms or iter_ms <= 0:
-            return {"tokens_per_s": None, "tflops_per_device": None,
-                    "mfu": None, "hfu": None}
+            out: Dict[str, Optional[float]] = {
+                "tokens_per_s": None, "tflops_per_device": None,
+                "mfu": None, "hfu": None,
+            }
+            if nonpad_tokens is not None:
+                out["tokens_per_s_raw"] = None
+                out["packing_efficiency"] = None
+            return out
         scale = (global_bsz / self.global_bsz) if global_bsz else 1.0
         s = iter_ms / 1000.0
-        flops_rate = scale * self.model_flops_per_step / s
-        out: Dict[str, Optional[float]] = {
-            "tokens_per_s": round(scale * self.tokens_per_step / s, 3),
+        raw_tokens = scale * self.tokens_per_step
+        useful_frac = 1.0
+        if nonpad_tokens is not None and raw_tokens > 0:
+            useful_frac = min(1.0, float(nonpad_tokens) / raw_tokens)
+        flops_rate = useful_frac * scale * self.model_flops_per_step / s
+        out = {
+            "tokens_per_s": round(useful_frac * raw_tokens / s, 3),
             "tflops_per_device": round(flops_rate / self.num_devices / 1e12, 4),
             "mfu": None,
             "hfu": None,
         }
+        if nonpad_tokens is not None:
+            out["tokens_per_s_raw"] = round(raw_tokens / s, 3)
+            out["packing_efficiency"] = round(useful_frac, 6)
         if self._peak:
             denom = self._peak * self.num_devices
             out["mfu"] = round(flops_rate / denom, 6)
-            out["hfu"] = round(scale * self.hardware_flops_per_step / s / denom, 6)
+            out["hfu"] = round(
+                useful_frac * scale * self.hardware_flops_per_step / s / denom, 6
+            )
         return out
 
 

@@ -175,7 +175,7 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
     """Per-layer execution hook: sharding-constraint boundary (redistribution)
     + optional remat (checkpoint_wrapper) + ring-attention dispatch."""
 
-    def hook(i: int, x, lp, enc_out=None):
+    def hook(i: int, x, lp, enc_out=None, seg_ids=None):
         s = hp.layer_strategies[i]
         x = constrain(x, mesh, activation_spec(axes, s))
         layer_cfg = cfg
@@ -213,9 +213,17 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
         # Mosaic kernels cannot be auto-partitioned by GSPMD — see
         # sharding.with_flash_shard_ctx / modeling._flash_shard_map
         layer_cfg = with_flash_shard_ctx(layer_cfg, s, mesh, axes)
-        cos_sin = (
-            modeling.rope_tables(layer_cfg, x.shape[1]) if layer_cfg.pos_embed == "rope" else None
-        )
+        if layer_cfg.pos_embed == "rope":
+            # packed rows: per-segment position reset → per-row gathered tables
+            cos_sin = (
+                modeling.packed_rope_tables(
+                    layer_cfg, modeling.positions_from_segments(seg_ids)
+                )
+                if seg_ids is not None
+                else modeling.rope_tables(layer_cfg, x.shape[1])
+            )
+        else:
+            cos_sin = None
         alibi = (
             jnp.asarray(modeling.alibi_slopes(layer_cfg.num_heads))
             if layer_cfg.pos_embed == "alibi"
@@ -249,6 +257,7 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
             return modeling.decoder_layer(
                 x_, lp_, layer_cfg, cos_sin, alibi,
                 remat_attn=(s.ckpt == "selective"), enc_out=enc_out,
+                seg_ids=seg_ids,
             )
 
         if s.ckpt == "full":
@@ -291,6 +300,32 @@ def build_runtime(
     if cfg.enc_layers > 0:
         if any(s.cp > 1 for s in hp.layer_strategies):
             raise ValueError("context parallelism is not supported for enc-dec models")
+    if cfg.pack_sequences:
+        # packed sequences (galvatron_tpu.data): supported on the GSPMD path
+        # and the gpipe/1F1B stage-stacked pipelines. Everything the segment
+        # mask cannot reach is refused loudly rather than silently attending
+        # across documents.
+        if cfg.objective != "clm" or cfg.enc_layers or cfg.image_size:
+            raise ValueError(
+                "pack_sequences requires a decoder-only CLM model "
+                "(enc-dec / vision / mlm rows carry no segment layout)"
+            )
+        if cfg.attn_impl != "xla":
+            raise ValueError(
+                "pack_sequences requires attn_impl='xla': the flash/ring "
+                "Pallas kernels carry no segment mask, and running them would "
+                "silently attend across packed documents"
+            )
+        if any(s.cp > 1 for s in hp.layer_strategies):
+            raise ValueError(
+                "pack_sequences is incompatible with context parallelism "
+                "(ring/Ulysses assume a plain causal mask)"
+            )
+        if hp.pp > 1 and hp.vpp > 1:
+            raise ValueError(
+                "pack_sequences is not threaded through the interleaved "
+                "(vpp>1) schedule; use vpp=1 pipelines"
+            )
     seq_len = seq_len or cfg.sample_len
 
     # the strategy's activation-recompute mode rides the model config so

@@ -342,14 +342,27 @@ def make_block_fn(
     ``active_counts`` (uneven stage division): per-stage real-layer counts;
     position j acts as identity on stages where ``j >= active_counts[stage]``
     (padding slots of the stacked params). The masked select also zeroes the
-    padding slots' gradients. Requires the 'pp' axis (shard_map manual)."""
+    padding slots' gradients. Requires the 'pp' axis (shard_map manual).
+
+    ``seg`` (packed sequences, cfg.pack_sequences): the (mb, S) segment ids of
+    the micro-batch this stage is computing — rides beside the activations
+    through the schedule (the clock index arithmetic selects it; see
+    gpipe_pipeline / the 1F1B body) and drives the intra-segment attention
+    mask + per-segment rope positions in every layer."""
 
     def act_spec(s: LayerStrategy) -> P:
         bs = batch_spec(axes, s)
         return P(bs[0], bs[1], None)
 
-    def stage_fn(stage_params: List[Any], x):
-        cos_sin = modeling.rope_tables(cfg, x.shape[1]) if cfg.pos_embed == "rope" else None
+    def stage_fn(stage_params: List[Any], x, seg=None):
+        if cfg.pos_embed == "rope":
+            cos_sin = (
+                modeling.packed_rope_tables(cfg, modeling.positions_from_segments(seg))
+                if seg is not None
+                else modeling.rope_tables(cfg, x.shape[1])
+            )
+        else:
+            cos_sin = None
         alibi = (
             jnp.asarray(modeling.alibi_slopes(cfg.num_heads))
             if cfg.pos_embed == "alibi"
@@ -402,7 +415,7 @@ def make_block_fn(
                     )
                 return modeling.decoder_layer(
                     x_, lp_, layer_cfg, cos_sin, alibi,
-                    remat_attn=(s.ckpt == "selective"),
+                    remat_attn=(s.ckpt == "selective"), seg_ids=seg,
                 )
 
             if s.ckpt == "full":
@@ -431,15 +444,20 @@ def make_stage_fn(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axes: 
 # ---------------------------------------------------------------------------
 
 
-def gpipe_pipeline(stage_fn, pp: int, chunks: int, mesh: Mesh):
-    """Returns f(stage_params_local, x_mbs) -> ys, to run under a manual-'pp'
-    shard_map. Clock tick t: stage s computes micro-batch (t - s); forward
-    sends ride ppermute s→s+1 (reference: gpipe_forward,
-    galvatron/core/pipeline/pipeline.py:497-629)."""
+def gpipe_pipeline(stage_fn, pp: int, chunks: int, mesh: Mesh, packed: bool = False):
+    """Returns f(stage_params_local, x_mbs[, seg_mbs]) -> ys, to run under a
+    manual-'pp' shard_map. Clock tick t: stage s computes micro-batch (t - s);
+    forward sends ride ppermute s→s+1 (reference: gpipe_forward,
+    galvatron/core/pipeline/pipeline.py:497-629).
+
+    ``packed``: the run also takes ``seg_mbs`` (chunks, mb, S) segment ids,
+    replicated over pp. Segment ids never ride the ppermute ring — the clock
+    arithmetic says exactly which micro-batch stage s computes at tick t
+    (``t - s``), so each stage indexes the replicated array directly."""
 
     fwd_perm = [(i, i + 1) for i in range(pp - 1)]
 
-    def run(stage_params, x_mbs):
+    def run(stage_params, x_mbs, seg_mbs=None):
         # x_mbs: (chunks, mb, S, H) replicated over pp.
         # P('pp')-sharded params keep a size-1 leading dim in the local view;
         # strip it so stage compute sees clean per-layer shapes.
@@ -455,7 +473,15 @@ def gpipe_pipeline(stage_fn, pp: int, chunks: int, mesh: Mesh):
             mb_idx = jnp.clip(t, 0, chunks - 1)
             first_in = jax.lax.dynamic_index_in_dim(x_mbs, mb_idx, keepdims=False)
             x_in = jnp.where(stage == 0, first_in, prev)
-            out = stage_fn(stage_params, x_in)
+            if seg_mbs is not None:
+                # micro-batch THIS stage computes at tick t (invalid ticks
+                # compute on garbage that never reaches a valid consumer,
+                # exactly like the activations themselves)
+                cur = jnp.clip(t - stage, 0, chunks - 1)
+                seg = jax.lax.dynamic_index_in_dim(seg_mbs, cur, keepdims=False)
+                out = stage_fn(stage_params, x_in, seg)
+            else:
+                out = stage_fn(stage_params, x_in)
             slot = jnp.clip(t - (pp - 1), 0, chunks - 1)
             ys = jax.lax.dynamic_update_index_in_dim(ys, out, slot, 0)
             return (out, ys), None
@@ -465,6 +491,8 @@ def gpipe_pipeline(stage_fn, pp: int, chunks: int, mesh: Mesh):
         # globally; only the pp=-1 slice holds real outputs
         return ys[None]
 
+    if not packed:
+        return lambda stage_params, x_mbs: run(stage_params, x_mbs)
     return run
 
 
@@ -547,17 +575,19 @@ def build_pipeline_runtime(
                 cfg, hp, mesh, axes, adam, global_batch_size, seq_len, stage_fn
             )
 
-        pipe = gpipe_pipeline(stage_fn, pp, chunks, mesh)
+        pipe = gpipe_pipeline(stage_fn, pp, chunks, mesh, packed=cfg.pack_sequences)
         init_params_fn = lambda key: init_pipeline_params(key, cfg, hp)
         param_specs_fn = pipeline_param_specs
         out_stage = pp - 1  # last stage holds GPipe outputs
+    packed = cfg.pack_sequences and not interleaved  # vpp>1 rejected upstream
     # full-batch spec for embedding/head compute: batch over pp + all data axes
     full_spec = P(("pp",) + axes.data_axes, None, None)
 
     pipe_sm = compat.shard_map(
         pipe,
         mesh=mesh,
-        in_specs=(P("pp"), P()),  # stage params: pp-stacked; x_mbs replicated
+        # stage params: pp-stacked; x_mbs (and packed seg_mbs) replicated
+        in_specs=(P("pp"), P(), P()) if packed else (P("pp"), P()),
         out_specs=P("pp"),
         axis_names={"pp"},
         # vma tracking rejects with_sharding_constraint over auto axes inside
@@ -569,10 +599,16 @@ def build_pipeline_runtime(
 
     def loss_fn(params, batch):
         inputs, labels = modeling.split_batch(batch, cfg)
-        x = modeling.embed_any(inputs, params, cfg)
+        if packed:
+            tokens, seg, pos_ids = modeling.split_packed_inputs(inputs)
+            x = modeling.embed(tokens, params, cfg, pos_ids=pos_ids)
+        else:
+            seg = None
+            x = modeling.embed_any(inputs, params, cfg)
         x = constrain(x, mesh, full_spec)
         x_mbs = x.reshape(chunks, mb, *x.shape[1:])
-        ys = pipe_sm(params[layer_params_key], x_mbs)  # (pp, chunks, mb, S, H)
+        extra = (seg.reshape(chunks, mb, seg.shape[1]),) if packed else ()
+        ys = pipe_sm(params[layer_params_key], x_mbs, *extra)  # (pp, chunks, mb, S, H)
         y = ys[out_stage].reshape(global_batch_size, *x.shape[1:])
         y = constrain(y, mesh, full_spec)
         y = modeling.norm(y, params["final_norm"], cfg)

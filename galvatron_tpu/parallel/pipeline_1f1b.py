@@ -121,11 +121,19 @@ def make_1f1b_train_step(
     head_keys = ("final_norm", "embed") if cfg.tie_word_embeddings else ("final_norm", "head")
     full_spec = P(("pp",) + axes.data_axes, None, None)
 
-    def pipeline_body(stage_params, head_sub, x_mbs, labels_mbs, scale):
+    packed = cfg.pack_sequences
+
+    def pipeline_body(stage_params, head_sub, x_mbs, labels_mbs, scale, seg_mbs=None):
         """Runs under shard_map(manual={'pp'}). Returns per-stage-stacked
         (loss_sum, tok_count, d_stages, d_head, dx_embed). ``scale`` seeds the
         backward cotangent (fp16 loss scaling; 1.0 otherwise) so in-flight
-        fp16 cotangents stay in range — all weight grads come back scaled."""
+        fp16 cotangents stay in range — all weight grads come back scaled.
+
+        ``seg_mbs`` ((chunks, mb, S), packed sequences): segment ids per
+        micro-batch, replicated over pp — the schedule's index arithmetic
+        names the micro-batch each stage computes (fwd ``t − s``, bwd
+        ``t − 2(pp−1) + s``), so forward AND the recompute-backward index the
+        replicated array directly; no seg stash ring is needed."""
         # strip the size-1 local stage dim from the pp-stacked params
         stage_params = jax.tree.map(lambda a: jnp.squeeze(a, 0), stage_params)
         stage = jax.lax.axis_index("pp")
@@ -167,7 +175,11 @@ def make_1f1b_train_step(
 
             # forward (unconditional; invalid ticks compute on garbage which
             # never reaches a valid consumer — see schedule proof in module doc)
-            out = stage_fn(stage_params, x_in)
+            if seg_mbs is not None:
+                seg_f = jax.lax.dynamic_index_in_dim(seg_mbs, mf_c, keepdims=False)
+                out = stage_fn(stage_params, x_in, seg_f)
+            else:
+                out = stage_fn(stage_params, x_in)
             fwd_slot = jnp.where(fwd_valid, jnp.mod(mf_c, n_stash), n_stash)
             stash = jax.lax.dynamic_update_index_in_dim(carry["stash"], x_in, fwd_slot, 0)
 
@@ -191,7 +203,16 @@ def make_1f1b_train_step(
             )
             dy_in = jnp.where(is_last, dy_head, prev_dn)
             dy_in = jnp.where(bwd_valid, dy_in, jnp.zeros_like(dy_in))
-            _, f_vjp = jax.vjp(stage_fn, stage_params, x_saved)
+            if seg_mbs is not None:
+                # the backward recompute must see the BACKWARD micro-batch's
+                # segment ids (m_b ≠ m_f on interior ticks); closed over so
+                # the vjp differentiates (params, x) only
+                seg_b = jax.lax.dynamic_index_in_dim(seg_mbs, mb_c, keepdims=False)
+                _, f_vjp = jax.vjp(
+                    lambda p_, x_: stage_fn(p_, x_, seg_b), stage_params, x_saved
+                )
+            else:
+                _, f_vjp = jax.vjp(stage_fn, stage_params, x_saved)
             dw_mb, dx = f_vjp(dy_in.astype(x_mbs.dtype))
 
             emb_slot = jnp.where(bwd_valid & is_first, mb_c, chunks)
@@ -228,13 +249,14 @@ def make_1f1b_train_step(
     body_sm = compat.shard_map(
         pipeline_body,
         mesh=mesh,
-        in_specs=(P("pp"), P(), P(), P(), P()),
+        in_specs=(P("pp"), P(), P(), P(), P(), P()) if packed
+        else (P("pp"), P(), P(), P(), P()),
         out_specs=(P("pp"), P("pp"), P("pp"), P("pp"), P("pp")),
         axis_names={"pp"},
         check_vma=False,
     )
 
-    def eval_body(stage_params, head_sub, x_mbs, labels_mbs):
+    def eval_body(stage_params, head_sub, x_mbs, labels_mbs, seg_mbs=None):
         """Forward-only clocked schedule (chunks + pp - 1 ticks): no vjp, no
         stash ring, no gradient accumulators — eval at ~1/3 of train cost."""
         stage_params = jax.tree.map(lambda a: jnp.squeeze(a, 0), stage_params)
@@ -256,7 +278,11 @@ def make_1f1b_train_step(
             x_in = jnp.where(
                 is_first, jax.lax.dynamic_index_in_dim(x_mbs, mf_c, keepdims=False), prev_up
             )
-            out = stage_fn(stage_params, x_in)
+            if seg_mbs is not None:
+                seg_f = jax.lax.dynamic_index_in_dim(seg_mbs, mf_c, keepdims=False)
+                out = stage_fn(stage_params, x_in, seg_f)
+            else:
+                out = stage_fn(stage_params, x_in)
             labels = jax.lax.dynamic_index_in_dim(labels_mbs, mf_c, keepdims=False)
             nll, cnt = _head_loss(head_sub, out, labels, cfg)
             head_mask = (is_last & fwd_valid).astype(jnp.float32)
@@ -272,7 +298,7 @@ def make_1f1b_train_step(
     eval_sm = compat.shard_map(
         eval_body,
         mesh=mesh,
-        in_specs=(P("pp"), P(), P(), P()),
+        in_specs=(P("pp"), P(), P(), P(), P()) if packed else (P("pp"), P(), P(), P()),
         out_specs=(P("pp"), P("pp")),
         axis_names={"pp"},
         check_vma=False,
@@ -286,18 +312,26 @@ def make_1f1b_train_step(
         scale = state["scaler"]["scale"] if fp16 else jnp.ones((), jnp.float32)
         inputs, labels = modeling.split_batch(batch, cfg)
         head_sub = {k: params[k] for k in head_keys}
+        if packed:
+            tokens, seg, pos_ids = modeling.split_packed_inputs(inputs)
+        else:
+            tokens, seg, pos_ids = inputs, None, None
 
         # embedding forward (outside the pipelined section), with vjp capture
         def embed_fn(embed_params):
-            x = modeling.embed_any(inputs, {"embed": embed_params}, cfg)
+            if packed:
+                x = modeling.embed(tokens, {"embed": embed_params}, cfg, pos_ids=pos_ids)
+            else:
+                x = modeling.embed_any(tokens, {"embed": embed_params}, cfg)
             return constrain(x, mesh, full_spec)
 
         x, embed_vjp = jax.vjp(embed_fn, params["embed"])
         x_mbs = x.reshape(chunks, mb, *x.shape[1:])
         labels_mbs = labels.reshape(chunks, mb, *labels.shape[1:])
+        extra = (seg.reshape(chunks, mb, seg.shape[1]),) if packed else ()
 
         loss_s, tok_s, d_stages, d_head_s, dx_embed_s = body_sm(
-            params["stages"], head_sub, x_mbs, labels_mbs, scale
+            params["stages"], head_sub, x_mbs, labels_mbs, scale, *extra
         )
         loss_sum = loss_s[-1]
         tok = jnp.maximum(tok_s[-1], 1.0)
@@ -327,12 +361,20 @@ def make_1f1b_train_step(
         params = state["params"]
         inputs, labels = modeling.split_batch(batch, cfg)
         head_sub = {k: params[k] for k in head_keys}
-        x = constrain(modeling.embed_any(inputs, params, cfg), mesh, full_spec)
+        if packed:
+            tokens, seg, pos_ids = modeling.split_packed_inputs(inputs)
+            x = modeling.embed(tokens, params, cfg, pos_ids=pos_ids)
+            extra = (seg.reshape(chunks, mb, seg.shape[1]),)
+        else:
+            x = modeling.embed_any(inputs, params, cfg)
+            extra = ()
+        x = constrain(x, mesh, full_spec)
         loss_s, tok_s = eval_sm(
             params["stages"],
             head_sub,
             x.reshape(chunks, mb, *x.shape[1:]),
             labels.reshape(chunks, mb, *labels.shape[1:]),
+            *extra,
         )
         return loss_s[-1] / jnp.maximum(tok_s[-1], 1.0)
 

@@ -107,6 +107,58 @@ def test_train_on_indexed_corpus_cli(tmp_path, capsys):
     assert "iter 2: loss" in out
 
 
+def test_epoch_reshuffles_and_no_seed_aliasing(tmp_path):
+    """Epoch boundaries re-seed the permutation from the MIXED (seed, epoch)
+    pair: each epoch covers the same windows in a different order, and
+    (seed=s, epoch=1) must not replay (seed=s+1, epoch=0) — the additive
+    seed+epoch scheme aliased adjacent streams exactly that way."""
+    prefix = make_corpus(
+        tmp_path, [list(np.random.RandomState(0).randint(0, 256, 417))]
+    )
+    per_epoch = 52 // 4  # 52 windows at seq 8 divide evenly into batch 4
+    n = per_epoch * 2
+
+    def stream(seed, start=0, count=n):
+        ds = GPTWindowDataset(IndexedTokenDataset(prefix), seq_len=8, seed=seed)
+        return [b.copy() for _, b in zip(range(count), ds.batch_iterator(4, start_batch=start))]
+
+    s7 = stream(7)
+    e0, e1 = s7[:per_epoch], s7[per_epoch:]
+    rows = lambda bs: [r.tobytes() for b in bs for r in b]
+    assert sorted(rows(e0)) == sorted(rows(e1)), "an epoch must cover the same windows"
+    assert rows(e0) != rows(e1), "epoch 1 must re-shuffle, not replay epoch 0's order"
+    s8_e0 = stream(8, count=per_epoch)
+    assert [b.tobytes() for b in e1] != [b.tobytes() for b in s8_e0], (
+        "(seed, epoch+1) must not alias (seed+1, epoch 0)"
+    )
+    # mid-epoch resume ACROSS the epoch boundary is pure index arithmetic
+    resumed = stream(7, start=per_epoch - 2, count=4)
+    for a, b in zip(s7[per_epoch - 2 :], resumed):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_random_stream_per_sample_identity_and_epochs():
+    """The synthetic streams carry real per-sample identity: batch rows are a
+    function of each row's SAMPLE index (not the batch's first index, which
+    made the epoch permutation cosmetic), so epochs reshuffle genuinely and
+    the sample-domain cursor has per-sample meaning."""
+    from galvatron_tpu.core.dataloader import RandomTokenDataset
+
+    ds = RandomTokenDataset(vocab_size=97, seq_len=6, size=24, seed=11)
+    per_epoch = ds.batches_per_epoch(4)
+    rows = lambda batches: [r.tobytes() for b in batches for r in b]
+    it = ds.batch_iterator(4)
+    e0 = [next(it).copy() for _ in range(per_epoch)]
+    e1 = [next(it).copy() for _ in range(per_epoch)]
+    assert sorted(rows(e0)) == sorted(rows(e1)), "epochs must cover the same rows"
+    assert rows(e0) != rows(e1), "epoch 1 must permute the rows"
+    assert len(set(rows(e0))) == 24, "every sample id must yield a distinct row"
+    # mid-epoch resume determinism across the boundary
+    resumed = ds.batch_iterator(4, start_batch=per_epoch - 1)
+    np.testing.assert_array_equal(e0[-1], next(resumed))
+    np.testing.assert_array_equal(e1[0], next(resumed))
+
+
 def test_native_shuffle_matches_numpy_fallback():
     """The C++ helper and the numpy fallback must produce bit-identical
     permutations (resume determinism is independent of the build env)."""
