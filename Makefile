@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test test-all bench dryrun lint check-plan chaos data-smoke clean
+.PHONY: all native test test-all bench dryrun lint check-plan chaos data-smoke warmup clean
 
 all: native
 
@@ -49,6 +49,16 @@ chaos:
 # checkpointed per-source cursor exactness
 data-smoke:
 	env JAX_PLATFORMS=cpu $(PY) experiments/data_smoke.py
+
+# AOT-warm the checked-in exemplar strategy into the repo's .jax_cache —
+# the SAME cache tier-1 rides (docs/DESIGN.md § AOT compile subsystem):
+# every registered program (train step, eval, init, serving prefill/decode,
+# generate) compiles from abstract shapes into the persistent cache, with
+# per-program compile_ms + memory_analysis stats in warmup_report.jsonl
+warmup:
+	env JAX_PLATFORMS=cpu $(PY) -m galvatron_tpu.cli warmup \
+	  configs/strategies/llama-0.3b_8dev_16gb.json --force_world 8 \
+	  --compile_cache_dir .jax_cache --report warmup_report.jsonl
 
 # headline metric on the real chip — prints one JSON line
 bench:

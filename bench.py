@@ -255,6 +255,85 @@ def loader_metrics(smoke: bool):
     )
 
 
+def compile_metrics(smoke: bool):
+    """Cold-start trajectory (galvatron_tpu/aot): cold vs warm compile_ms
+    for the default train_step and the serving decode step, measured through
+    the real AOT warmup path against a fresh persistent compile cache. The
+    cold number is what a trainer start / serving cold-start pays today; the
+    warm number is what the same start pays after `cli warmup` (or any prior
+    run) populated the cache — the delta is the win BENCH_r09 starts
+    tracking. Tiny shapes: compile time scales with program structure, and
+    the cold/warm RATIO is the signal, not absolute ms."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from galvatron_tpu.aot import warmup as aot_warmup
+    from galvatron_tpu.aot.cache import ArtifactStore, enable_persistent_cache
+    from galvatron_tpu.core.strategy import HybridParallelConfig
+    from galvatron_tpu.models.modeling import ModelConfig
+
+    # the section needs a throwaway cache dir for a true cold measurement;
+    # hand the process-wide cache back exactly as found afterwards (an
+    # operator's JAX_COMPILATION_CACHE_DIR must serve the later sections)
+    prev_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
+    prev_entry = getattr(jax.config, "jax_persistent_cache_min_entry_size_bytes", None)
+    prev_time = getattr(jax.config, "jax_persistent_cache_min_compile_time_secs", None)
+    d = tempfile.mkdtemp(prefix="galvatron_bench_aot_")
+    try:
+        store = ArtifactStore(enable_persistent_cache(d, override=True))
+        cfg = ModelConfig(
+            vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+            ffn_dim=512, max_seq_len=64 if smoke else 128, dtype=jnp.bfloat16,
+            attn_impl="xla",  # compile-time metric: kernel-impl independent
+        )
+        hp = HybridParallelConfig.uniform(cfg.num_layers)
+        include = ("train_step", "serving_decode")
+
+        def sweep():
+            return {
+                r["program"]: r
+                for r in aot_warmup.warmup_plan(
+                    cfg, hp, global_bsz=4, store=store, include=include,
+                    verbose=False,
+                )
+            }
+
+        cold, warm = sweep(), sweep()
+    finally:
+        try:
+            jax.config.update("jax_compilation_cache_dir", prev_dir)
+            if prev_entry is not None:
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", int(prev_entry)
+                )
+            if prev_time is not None:
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", float(prev_time)
+                )
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+        shutil.rmtree(d, ignore_errors=True)
+    for prog in include:
+        c, w = cold.get(prog), warm.get(prog)
+        if not c or c["status"] != "compiled":
+            emit(f"compile_time_{prog}_ms", 0, "ms",
+                 skipped=(c or {}).get("error", "not built"))
+            continue
+        extra = {}
+        if w and w["status"] == "compiled":
+            extra = {
+                "warm_ms": w["compile_ms"],
+                "warm_speedup": round(c["compile_ms"] / max(w["compile_ms"], 1e-3), 2),
+                "warm_cache_hit": bool(w.get("cache_hit")),
+            }
+        emit(f"compile_time_{prog}_ms", c["compile_ms"], "ms", **extra)
+
+
 def main():
     from galvatron_tpu.models.modeling import ModelConfig
 
@@ -274,7 +353,16 @@ def main():
     l1, l2 = 2, 6
     rounds = 2 if smoke else 5
 
-    # loader-only input-path throughput FIRST (failure-isolated like every
+    # cold-vs-warm compile FIRST (failure-isolated like every non-headline
+    # section): BENCH_r09 starts the cold-start trajectory, and running it
+    # before any other section means its cold numbers see a truly cold cache
+    try:
+        compile_metrics(smoke)
+    except Exception as e:
+        emit("compile_time_train_step_ms", 0, "ms",
+             skipped=f"{type(e).__name__}: {e}"[:200])
+
+    # loader-only input-path throughput (failure-isolated like every
     # non-headline section): BENCH_r08 starts the input-path trajectory
     try:
         loader_metrics(smoke)

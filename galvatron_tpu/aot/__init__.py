@@ -1,0 +1,21 @@
+"""AOT compile subsystem: plan-keyed warmup + persistent compile artifacts.
+
+The plan is known before the run — so the programs the run needs are
+enumerable (`registry.py`), their compiled artifacts are keyable and
+persistable (`cache.py`), and cold-start/restart downtime becomes a cache
+lookup (`warmup.py`, ``cli warmup``, the trainer's startup consult, the
+elastic child's re-plan prewarm, the serving engine's warm start).
+"""
+
+from galvatron_tpu.aot.cache import (  # noqa: F401
+    ArtifactStore,
+    enable_persistent_cache,
+    program_key,
+    resolve_compile_cache_dir,
+)
+from galvatron_tpu.aot.registry import (  # noqa: F401
+    ProgramContext,
+    ProgramSpec,
+    enumerate_programs,
+    register_program,
+)

@@ -1,0 +1,164 @@
+"""Program registry: every jitted entry point a run needs, from shapes alone.
+
+Engines register their jitted entry points together with *abstract-input
+builders*, so the full set of programs a (plan × ModelConfig × mesh) run
+will compile is enumerable with no data and no device work:
+
+- ``trainer`` (parallel/hybrid.py): ``train_step`` / ``eval_loss`` /
+  ``init_state`` — one family covering the GSPMD hybrid engine AND the
+  gpipe / 1F1B / interleaved / enc-dec / swin stage programs, because every
+  pipeline runtime compiles through the same jitted ``train_step`` entry
+  (`build_runtime` dispatches; the registry does not care which engine won).
+- ``serving`` (serving/engine.py): ``serving_prefill`` / ``serving_decode``
+  — the engine's exactly-two pinned programs at its static shapes.
+- ``generate`` (registered here, lazily importing models/generation):
+  the batch eval/generate program at its default length bucket.
+
+A builder takes a :class:`ProgramContext` and returns a list of
+:class:`ProgramSpec` — the jitted callable plus the abstract
+(``jax.ShapeDtypeStruct``/``eval_shape``) arguments to ``lower`` it with.
+Builders may decline (return ``[]``) when the context does not apply (a
+non-causal model has no serving programs).  `aot/warmup.py` turns specs
+into compiled artifacts; `aot/cache.py` turns them into keys.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+Builder = Callable[["ProgramContext"], List["ProgramSpec"]]
+
+
+@dataclass
+class ProgramContext:
+    """Everything a builder may need, shapes only — no arrays, no devices."""
+
+    cfg: Any  # models.modeling.ModelConfig (effective/executed config)
+    hp: Any = None  # core.strategy.HybridParallelConfig; None = plan-free only
+    global_bsz: int = 8
+    seq_len: Optional[int] = None  # None = cfg.sample_len
+    mesh: Any = None  # pre-built Mesh/axes (trainer); None = build from hp
+    axes: Any = None
+    runtime: Any = None  # an already-built HybridParallelRuntime to reuse
+    adam: Any = None  # core.optim.AdamConfig; None = build_runtime's default
+    # serving shapes (Engine ctor defaults)
+    num_slots: int = 4
+    prefill_chunk: int = 32
+    max_seq_len: Optional[int] = None
+    # generate shapes
+    max_new_tokens: int = 32
+    length_bucket: int = 64
+
+
+@dataclass
+class ProgramSpec:
+    """One AOT-lowerable program: ``fn.lower(*args, **kwargs)`` must be
+    legal with every leaf of ``args``/``kwargs`` abstract (static jit args
+    ride along concrete).  ``meta`` carries the key terms the avals cannot
+    express (donation, family, engine notes)."""
+
+    name: str
+    fn: Any
+    args: Tuple[Any, ...] = ()
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+_BUILDERS: Dict[str, Tuple[Builder, bool, Tuple[str, ...]]] = {}
+
+
+def register_program(
+    name: str,
+    builder: Builder,
+    *,
+    needs_plan: bool = False,
+    programs: Sequence[str] = (),
+) -> None:
+    """Register (or replace — re-imports are idempotent) a program family.
+    ``needs_plan=True`` families are skipped when the context has no
+    hybrid-parallel plan (plan-free warmups: serving cold-start).
+    ``programs`` names the specs the builder can emit, so an ``include``
+    filter can skip a family without paying its builder."""
+    _BUILDERS[name] = (builder, bool(needs_plan), tuple(programs))
+
+
+def registered_families() -> List[str]:
+    _ensure_engines_imported()
+    return sorted(_BUILDERS)
+
+
+def _ensure_engines_imported() -> None:
+    """Importing an engine module registers its family (decentralized
+    registration keeps the jitted entry points and their abstract-input
+    builders in the file that owns the shapes)."""
+    import galvatron_tpu.parallel.hybrid  # noqa: F401 — registers 'trainer'
+    import galvatron_tpu.serving.engine  # noqa: F401 — registers 'serving'
+
+
+def enumerate_programs(
+    ctx: ProgramContext, include: Optional[Sequence[str]] = None
+) -> List[ProgramSpec]:
+    """All ProgramSpecs the registered engines would compile for ``ctx``.
+
+    ``include`` filters by family OR program name (``["serving"]`` and
+    ``["serving_decode"]`` both work).  Enumeration never compiles: specs
+    hold jitted callables + abstract inputs only."""
+    _ensure_engines_imported()
+    want = set(include) if include else None
+    specs: List[ProgramSpec] = []
+    for family in sorted(_BUILDERS):
+        builder, needs_plan, names = _BUILDERS[family]
+        if needs_plan and ctx.hp is None:
+            continue
+        if want is not None and family not in want and names and not (set(names) & want):
+            continue  # the filter cannot match anything this family emits
+        built = builder(ctx)
+        for s in built:
+            s.meta.setdefault("family", family)
+        specs.extend(
+            built if want is None
+            else (s for s in built if family in want or s.name in want)
+        )
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# the plan-free 'generate' family (models/generation.py owns no registry
+# import of its own — generation is a leaf module the serving engine also
+# imports, so its family is declared here against the lazy import)
+# ---------------------------------------------------------------------------
+
+
+def _generate_builder(ctx: ProgramContext) -> List[ProgramSpec]:
+    import jax
+    import jax.numpy as jnp
+
+    cfg = ctx.cfg
+    if not getattr(cfg, "causal", True) or getattr(cfg, "objective", "clm") != "clm" \
+            or getattr(cfg, "enc_layers", 0) > 0:
+        return []  # generation requires a decoder-only causal LM
+    from galvatron_tpu.models import generation, modeling
+
+    params_abs = jax.eval_shape(
+        lambda k: modeling.init_model_params(k, cfg), jax.random.key(0)
+    )
+    p_len = min(ctx.length_bucket, cfg.max_seq_len)
+    prompt = jax.ShapeDtypeStruct((1, p_len), jnp.int32)
+    lengths = jax.ShapeDtypeStruct((1,), jnp.int32)
+    key = jax.eval_shape(lambda: jax.random.key(0))
+    max_new = min(ctx.max_new_tokens, max(1, cfg.max_seq_len - p_len))
+    return [
+        ProgramSpec(
+            "generate",
+            generation.generate,
+            (params_abs, prompt, lengths, cfg, key),
+            {"max_new_tokens": max_new, "min_prompt_len": 1},
+            meta={"family": "generate", "engine": "generation.generate"},
+        )
+    ]
+
+
+register_program(
+    "generate", _generate_builder, needs_plan=False, programs=("generate",)
+)

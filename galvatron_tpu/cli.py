@@ -18,6 +18,13 @@ models/<name>/{train_dist,search_dist,profiler}.py + profile_hardware):
                     bad strategy JSON in milliseconds with stable GTA…
                     diagnostics — no device, no XLA compile; CI runs it over
                     configs/
+  warmup            AOT-compile every registered program of the given plan
+                    JSON(s) from abstract shapes into the persistent
+                    compile-artifact cache (galvatron_tpu/aot): a later
+                    trainer start / elastic restart / serving cold-start on
+                    the same plan pays a cache lookup instead of XLA
+                    compiles; per-program compile_ms + memory_analysis
+                    peak-buffer stats land in a JSONL report
   trace-export      convert a crash flight-recorder dump (flight_<ts>.json)
                     or raw span records into Chrome trace-event JSON loadable
                     in Perfetto / chrome://tracing (obs/tracing.py)
@@ -308,6 +315,10 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         ns = initialize_galvatron("check_plan", rest, model_default)
         return _check_plan_mode(ns)
 
+    if mode == "warmup":
+        ns = initialize_galvatron("warmup", rest, model_default)
+        return _warmup_mode(ns)
+
     if mode == "trace-export":
         ns = initialize_galvatron("trace_export", rest, model_default)
         return _trace_export_mode(ns)
@@ -340,6 +351,13 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             if tok.vocab_size > cfg.vocab_size:
                 cfg = cfg.replace(vocab_size=tok.vocab_size)
             params = _load_or_init_params(ns, cfg)
+        # an EXPLICIT --attn_impl reaches the executed config ('auto' keeps
+        # the model's own default — serving was designed on the xla path and
+        # must not silently switch kernels by backend); the plan-free
+        # `cli warmup` serving sweep applies the identical rule so the warmed
+        # program keys are the keys this engine consults
+        if getattr(ns, "attn_impl", "auto") != "auto":
+            cfg = cfg.replace(attn_impl=ns.attn_impl)
         if mode == "generate":
             from galvatron_tpu.models import generation
 
@@ -371,6 +389,28 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
                 pad_id=tok.pad_id if tok.pad_id is not None else 0,
                 seed=ns.seed,
             )
+        if engine is not None and getattr(ns, "compile_cache_dir", None):
+            # warm-start the engine's two pinned programs BEFORE accepting
+            # traffic: a restarted server's first request pays a persistent-
+            # cache deserialize instead of two XLA compiles. Resolved like
+            # the trainer flag: '0'/'off'/'none' disables.
+            from galvatron_tpu.aot import warmup as aot_warmup
+            from galvatron_tpu.aot.cache import (
+                ArtifactStore,
+                enable_persistent_cache,
+                resolve_compile_cache_dir,
+            )
+
+            serve_cache_dir = resolve_compile_cache_dir(ns)
+            if serve_cache_dir:
+                eff = enable_persistent_cache(serve_cache_dir, override=True)
+                reports = engine.warm_start(ArtifactStore(eff))
+                s = aot_warmup.summarize(reports)
+                print(
+                    f"serving warm-start: {s['compiled']}/{s['programs']} "
+                    f"programs ({s['hits']} cache hits, "
+                    f"{s['total_compile_ms']:.0f} ms)"
+                )
         run_server(
             GenerationService(params, cfg, tok, ns.max_new_tokens, ns.seed,
                               engine=engine),
@@ -380,10 +420,174 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
 
     print(
         f"unknown mode {mode!r}; expected "
-        "train|run-elastic|search|profile|profile-hardware|check-plan|"
+        "train|run-elastic|search|profile|profile-hardware|check-plan|warmup|"
         "trace-export|generate|serve|export-hf"
     )
     return 2
+
+
+def _warmup_mode(ns) -> int:
+    """AOT-warm every registered program for the given plan JSON(s).
+
+    Per-plan and per-program failure isolation: a plan that fails static
+    validation is skipped with its diagnostics, a program that fails to
+    compile (this container's protobuf pipeline-compile class) degrades to
+    a warning — the sweep itself never aborts.  rc 0 when at least one
+    program compiled (or reported a hit), else 1."""
+    from galvatron_tpu.aot import warmup as aot_warmup
+
+    if ns.force_world:
+        aot_warmup.force_cpu_world(ns.force_world)
+    import jax
+
+    from galvatron_tpu.analysis import plan_check
+    from galvatron_tpu.analysis.diagnostics import errors, format_report
+    from galvatron_tpu.aot.cache import (
+        ArtifactStore,
+        enable_persistent_cache,
+        resolve_compile_cache_dir,
+    )
+    from galvatron_tpu.core.arguments import model_config_from_args
+    from galvatron_tpu.core.strategy import HybridParallelConfig
+
+    # same sentinel rules as train/serve: '0'/'off'/'none' disables the
+    # persistent layer — the sweep still compiles (a compile-only run is a
+    # legitimate memory-feasibility check) but persists and accounts nothing
+    wdir = resolve_compile_cache_dir(ns)
+    if wdir is None and not ns.compile_cache_dir:
+        # nothing wired anywhere (no flag, no JAX_COMPILATION_CACHE_DIR, no
+        # configured jax cache): default to ./.jax_cache. A default that
+        # lived on the argparse flag instead would SHADOW the operator's
+        # env wiring — warming a cache no later run consults. An explicit
+        # 0/off/none sentinel keeps the sweep compile-only.
+        wdir = os.path.abspath(".jax_cache")
+    store = None
+    if wdir:
+        eff = enable_persistent_cache(wdir, override=True)
+        store = ArtifactStore(eff)
+        print(f"compile cache: {eff}")
+    else:
+        print("compile cache: disabled")
+    include = [s.strip() for s in (ns.include or "").split(",") if s.strip()] or None
+    world = jax.device_count()
+    paths = list(ns.config_paths or []) + list(ns.galvatron_config_path or [])
+    all_reports = []
+    if not paths:
+        # plan-free warmup: serving/generate families from the model flags
+        from galvatron_tpu.aot import registry as aot_registry
+        from galvatron_tpu.models.modeling import PRESETS
+
+        base = PRESETS.get(ns.model_size or "llama-0.3b")
+        if base is None:
+            print(f"error: unknown --model_size {ns.model_size!r}")
+            return 2
+        # mirror `cli serve`/`generate` EXACTLY, not the trainer: those
+        # surfaces run the model's own attn/dtype defaults and apply only an
+        # explicit --attn_impl, so resolving 'auto' here (flash on
+        # accelerators) would warm keys the serving engine never consults
+        cfg = model_config_from_args(ns, base=base)
+        if getattr(ns, "attn_impl", "auto") != "auto":
+            cfg = cfg.replace(attn_impl=ns.attn_impl)
+        ctx = aot_registry.ProgramContext(
+            cfg=cfg, num_slots=ns.num_slots, prefill_chunk=ns.prefill_chunk,
+        )
+        specs = aot_registry.enumerate_programs(ctx, include=include)
+        all_reports += aot_warmup.warmup_programs(
+            specs, store, model_cfg=cfg, serialize=bool(ns.serialize),
+        )
+    for path in paths:
+        print(f"== {path}")
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warmup: cannot read {path}: {e}; skipping")
+            continue
+        plan_world = int(d.get("num_devices") or 0)
+        if plan_world and plan_world != world:
+            print(
+                f"warmup: {path} was searched for {plan_world} devices but "
+                f"this backend has {world}; skipping (re-run under "
+                f"--force_world {plan_world} on CPU, or on the right mesh)"
+            )
+            continue
+        # resolve the plan's self-describing model shape (same rules as
+        # check-plan: explicit --model_size wins, else the embedded
+        # model_config, else the model_size provenance key)
+        cfg = _warmup_model_config(ns, d, path)
+        if cfg is None:
+            continue
+        bsz = ns.global_train_batch_size or int(d.get("global_bsz") or 8)
+        diags = plan_check.check_plan(
+            d, source=path, model_config=cfg, world_size=world, global_bsz=bsz,
+        )
+        if errors(diags):
+            print(format_report(diags))
+            print(f"warmup: {path} fails static validation; skipping")
+            continue
+        hp = HybridParallelConfig.from_json_dict(d)
+        # exact optimizer mirror (core/elastic.py prewarm does the same):
+        # the adam constants are burned into the compiled step, so a sweep
+        # warmed with different hyperparameters would never hit for the run
+        from galvatron_tpu.core.arguments import adam_config_from_args
+
+        all_reports += aot_warmup.warmup_plan(
+            cfg, hp, global_bsz=bsz, store=store, include=include,
+            num_slots=ns.num_slots, prefill_chunk=ns.prefill_chunk,
+            adam=adam_config_from_args(ns),
+            serialize=bool(ns.serialize),
+        )
+    summary = aot_warmup.summarize(all_reports)
+    manifest_note = (
+        f"manifest: {store.stats()['entries']} entries" if store is not None
+        else "manifest: disabled"
+    )
+    print(
+        f"warmup: {summary['programs']} programs — {summary['hits']} hits, "
+        f"{summary['misses']} misses, {summary['failed']} failed, "
+        f"{summary['total_compile_ms']:.0f} ms total compile ({manifest_note})"
+    )
+    if ns.report:
+        aot_warmup.write_report(ns.report, all_reports)
+        print(f"report → {ns.report}")
+    return 0 if summary["compiled"] > 0 else 1
+
+
+def _warmup_model_config(ns, d: dict, path: str):
+    """check-plan's model-resolution rules, shared shape: explicit
+    --model_size > embedded model_config > the JSON's model_size key.
+
+    Keep the precedence in lockstep with _check_plan_mode's resolution
+    block (the failure handling legitimately differs: check-plan degrades
+    to structural-only diagnostics, a warmup sweep skips the plan) — a
+    drift here warms keys computed from a different effective model than
+    the one check-plan/trainer validate against."""
+    from galvatron_tpu.core.arguments import model_config_from_args
+    from galvatron_tpu.models.modeling import PRESETS, ModelConfig
+
+    model_size = ns.model_size or d.get("model_size")
+    shape = d.get("model_config")
+    shape = shape if isinstance(shape, dict) else None
+    base = PRESETS.get(model_size) if model_size else None
+    if ns.model_size and base is None:
+        print(f"error: unknown --model_size {ns.model_size!r}")
+        return None
+    if not ns.model_size and shape is not None:
+        from galvatron_tpu.analysis.plan_check import apply_model_shape
+
+        base = apply_model_shape(base if base is not None else ModelConfig(), shape)
+    if base is None:
+        print(f"warmup: {path} names no resolvable model "
+              f"(model_size {model_size!r}, no embedded model_config); skipping")
+        return None
+    from galvatron_tpu.core.arguments import resolve_execution_config
+
+    cfg = model_config_from_args(ns, base=base)
+    # mirror the trainer's own resolution (pack_sequences rides the model
+    # config BEFORE attention resolution, core/elastic.py prewarm idem)
+    if getattr(ns, "pack_sequences", 0):
+        cfg = cfg.replace(pack_sequences=True)
+    return resolve_execution_config(cfg, ns)
 
 
 def _trace_export_mode(ns) -> int:

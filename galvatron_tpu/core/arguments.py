@@ -46,25 +46,56 @@ def _add_model_args(p: argparse.ArgumentParser):
     g.add_argument("--moe_capacity_factor", type=float, default=None)
 
 
-def _add_training_args(p: argparse.ArgumentParser):
-    """(reference: galvatron_training_args, core/arguments.py:44-137)"""
-    g = p.add_argument_group("training")
-    g.add_argument("--global_train_batch_size", type=int, default=8)
-    g.add_argument("--train_iters", type=int, default=10)
+def _add_step_program_args(p: argparse.ArgumentParser):
+    """Flags burned into the compiled step program — ONE group shared by the
+    train modes and `cli warmup`, because every one of them is a
+    `aot/cache.program_key` term (optimizer constants, compute dtype,
+    attention kernel, recompute policy, packing): a warmup sweep that could
+    not express them would warm keys no real run ever asks for."""
+    g = p.add_argument_group("step program")
     g.add_argument("--lr", type=float, default=1e-4)
     g.add_argument("--min_lr", type=float, default=0.0)
     g.add_argument("--lr_warmup_iters", type=int, default=0)
     g.add_argument("--lr_decay_iters", type=int, default=0, help="0 = no decay")
     g.add_argument("--lr_decay_style", type=str, default="cosine",
                    choices=["constant", "linear", "cosine"])
+    g.add_argument("--weight_decay", type=float, default=0.01)
+    g.add_argument("--grad_clip", type=float, default=1.0)
+    g.add_argument("--mixed_precision", type=str, default="bf16",
+                   choices=["fp32", "bf16", "fp16"],
+                   help="fp16 adds dynamic loss scaling (skip-on-overflow); "
+                   "bf16 is the TPU-native choice")
+    g.add_argument("--attn_impl", type=str, default="auto", choices=["auto", "flash", "xla"])
+    g.add_argument(
+        "--mlp_recompute", type=str, default="policy",
+        choices=["off", "gate", "policy"],
+        help="activation-memory recompute over the MLP/norm/loss regions "
+        "(DESIGN.md 'Activation memory accounting'): 'policy' saves the "
+        "swiglu/gelu gate exactly once per layer and rematerializes the "
+        "fp32-widened norm/cross-entropy buffers; 'gate' remats only the "
+        "activation product; 'off' restores the pre-policy behaviour",
+    )
+    g.add_argument("--pack_sequences", type=int, default=0,
+                   help="1 = greedy first-fit packing of documents into "
+                   "fixed-seq_len rows with segment ids: cross-document "
+                   "attention blocked, per-segment position reset, loss "
+                   "masked at boundaries; true-token MFU + "
+                   "packing_efficiency reported. Needs --data_path or "
+                   "--data_mixture and the xla attention path")
+
+
+def _add_training_args(p: argparse.ArgumentParser):
+    """(reference: galvatron_training_args, core/arguments.py:44-137)"""
+    _add_step_program_args(p)
+    g = p.add_argument_group("training")
+    g.add_argument("--global_train_batch_size", type=int, default=8)
+    g.add_argument("--train_iters", type=int, default=10)
     g.add_argument(
         "--rampup_batch_size", type=int, nargs=3, default=None,
         metavar=("START", "INCREMENT", "SAMPLES"),
         help="global-batch-size ramp-up (reference: megatron microbatches.py); "
         "pp=1 only — each size change recompiles the step",
     )
-    g.add_argument("--weight_decay", type=float, default=0.01)
-    g.add_argument("--grad_clip", type=float, default=1.0)
     g.add_argument("--seed", type=int, default=1234)
     g.add_argument("--num_slices", type=int, default=0,
                    help="TPU multislice: order the mesh slice-major so pp "
@@ -73,10 +104,6 @@ def _add_training_args(p: argparse.ArgumentParser):
     g.add_argument("--multihost", type=int, default=0,
                    help="1 = jax.distributed.initialize() (TPU pod slices; "
                    "every host runs the same command)")
-    g.add_argument("--mixed_precision", type=str, default="bf16",
-                   choices=["fp32", "bf16", "fp16"],
-                   help="fp16 adds dynamic loss scaling (skip-on-overflow); "
-                   "bf16 is the TPU-native choice")
     g.add_argument("--check_loss", type=int, default=0)
     g.add_argument("--profile", type=int, default=0, help="print per-iter time/memory")
     g.add_argument("--trace_dir", type=str, default=None,
@@ -130,15 +157,6 @@ def _add_training_args(p: argparse.ArgumentParser):
         help="0 = off, 1 = full-layer remat, 2 = selective (attention-core-only "
         "recompute; reference: Megatron --recompute-granularity selective)",
     )
-    g.add_argument(
-        "--mlp_recompute", type=str, default="policy",
-        choices=["off", "gate", "policy"],
-        help="activation-memory recompute over the MLP/norm/loss regions "
-        "(DESIGN.md 'Activation memory accounting'): 'policy' saves the "
-        "swiglu/gelu gate exactly once per layer and rematerializes the "
-        "fp32-widened norm/cross-entropy buffers; 'gate' remats only the "
-        "activation product; 'off' restores the pre-policy behaviour",
-    )
     g.add_argument("--sequence_parallel", type=int, default=0)
     g.add_argument("--context_parallel_deg", type=int, default=1)
     g.add_argument("--context_parallel_impl", type=str, default="ring",
@@ -150,7 +168,17 @@ def _add_training_args(p: argparse.ArgumentParser):
     g.add_argument("--vocab_tp", type=int, default=1)
     g.add_argument("--embed_sdp", type=int, default=0)
     g.add_argument("--galvatron_config_path", type=str, default=None)
-    g.add_argument("--attn_impl", type=str, default="auto", choices=["auto", "flash", "xla"])
+    # AOT compile subsystem (galvatron_tpu/aot; DESIGN.md § AOT compile
+    # subsystem): the ONE shared persistent-compile-cache wiring
+    g.add_argument("--compile_cache_dir", type=str, default=None,
+                   help="persistent compile-artifact cache directory "
+                   "(aot/cache.py): startup AOT-compiles every registered "
+                   "program, accounts plan-keyed hit/miss in the manifest, "
+                   "and a warm start shrinks the watchdog's first-step "
+                   "compile grace. Default: an already-configured jax cache "
+                   "(JAX_COMPILATION_CACHE_DIR / conftest) or the .jax_cache "
+                   "sibling of --save, consulted only when this flag is "
+                   "passed explicitly; '0'/'off'/'none' disables")
     # checkpoint/resume (capability the reference only gestures at; SURVEY §5)
     g.add_argument("--data_path", type=str, default=None,
                    help="corpus prefix: a sharded manifest "
@@ -164,13 +192,6 @@ def _add_training_args(p: argparse.ArgumentParser):
                    "configs/data/) or inline 'prefix=weight,prefix=weight'. "
                    "Position-addressable — per-source consumption is exact "
                    "across preempt/resume and batch-size changes")
-    g.add_argument("--pack_sequences", type=int, default=0,
-                   help="1 = greedy first-fit packing of documents into "
-                   "fixed-seq_len rows with segment ids: cross-document "
-                   "attention blocked, per-segment position reset, loss "
-                   "masked at boundaries; true-token MFU + "
-                   "packing_efficiency reported. Needs --data_path or "
-                   "--data_mixture and the xla attention path")
     g.add_argument("--prefetch_depth", type=int, default=0,
                    help="async input prefetch: a background host thread "
                    "assembles + device-transfers batch k+1 while step k "
@@ -312,6 +333,11 @@ def _add_generate_args(p: argparse.ArgumentParser):
     g.add_argument("--top_k", type=int, default=0)
     g.add_argument("--top_p", type=float, default=0.0)
     g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--attn_impl", type=str, default="auto",
+                   choices=["auto", "flash", "xla"],
+                   help="attention kernel override; 'auto' keeps the model's "
+                   "own default (serving never switches kernels by backend). "
+                   "A program-key term: pass the same value to `cli warmup`")
     g.add_argument("--port", type=int, default=5000)
     g.add_argument("--host", type=str, default="127.0.0.1")
     # serve: continuous-batching engine (serving.Engine); 0 slots = legacy
@@ -330,6 +356,11 @@ def _add_generate_args(p: argparse.ArgumentParser):
                    "fast with 503 (engine path's max_pending equivalent)")
     g.add_argument("--max_pending", type=int, default=8,
                    help="legacy path: bound on queued /api requests")
+    g.add_argument("--compile_cache_dir", type=str, default=None,
+                   help="serve: persistent compile cache (aot/cache.py); the "
+                   "engine warm-starts its two pinned programs before "
+                   "accepting traffic, so a restarted server's first request "
+                   "pays a cache deserialize, not two XLA compiles")
     g.add_argument("--output_dir", type=str, default=None,
                    help="export-hf: directory for the HF-format checkpoint")
 
@@ -355,6 +386,45 @@ def _add_check_plan_args(p: argparse.ArgumentParser):
                    "also fail the check")
     g.add_argument("--no_abstract_pass", type=int, default=0,
                    help="1 = skip the eval_shape/AbstractMesh sharding pass")
+
+
+def _add_warmup_args(p: argparse.ArgumentParser):
+    """AOT warmup sweep (aot/warmup.py): plan JSONs → compiled artifacts."""
+    g = p.add_argument_group("warmup")
+    g.add_argument("config_paths", nargs="*",
+                   help="strategy JSON files whose programs to AOT-compile "
+                   "(self-describing search-emitted configs resolve their "
+                   "own model/bsz/world); none = plan-free families only "
+                   "(serving, generate)")
+    g.add_argument("--galvatron_config_path", type=str, action="append",
+                   default=None, help="additional strategy JSON (repeatable)")
+    g.add_argument("--global_train_batch_size", type=int, default=0,
+                   help="0 = each plan's own global_bsz provenance key")
+    g.add_argument("--compile_cache_dir", type=str, default=None,
+                   help="persistent compile-artifact cache directory (the "
+                   "manifest with hit/miss accounting lives beside jax's "
+                   "cache entries); unset = JAX_COMPILATION_CACHE_DIR / an "
+                   "already-configured jax cache, else ./.jax_cache "
+                   "('0'/'off'/'none' disables persistence)")
+    g.add_argument("--report", type=str, default=None,
+                   help="write the per-program JSONL report (compile_ms, "
+                   "cache_hit, memory_analysis peak buffers, GTA015 "
+                   "predicted-vs-compiled memory) to this path")
+    g.add_argument("--include", type=str, default="",
+                   help="comma list of families/programs to warm (e.g. "
+                   "'trainer' or 'train_step,serving_decode'); default all")
+    g.add_argument("--force_world", type=int, default=0,
+                   help="simulate an N-device CPU platform before the first "
+                   "backend touch (same bootstrap as the elastic sim world) "
+                   "so plans for an N-device mesh warm on any host; 0 = the "
+                   "live backend")
+    g.add_argument("--serialize", type=int, default=0,
+                   help="1 = also persist serialized AOT executables beside "
+                   "the manifest where the backend supports it")
+    g.add_argument("--num_slots", type=int, default=4,
+                   help="serving-family shapes: KV-cache slots")
+    g.add_argument("--prefill_chunk", type=int, default=32,
+                   help="serving-family shapes: prefill chunk length")
 
 
 def _add_trace_export_args(p: argparse.ArgumentParser):
@@ -397,6 +467,14 @@ def build_parser(mode: str, model_default: Optional[str] = None) -> argparse.Arg
         # model flags come from the shared model group; None (not the preset
         # default) so the JSON's own model_size key can win when no flag is
         # given — unless a per-family entry pinned its default above
+        if not model_default:
+            p.set_defaults(model_size=None)
+    elif mode == "warmup":
+        _add_warmup_args(p)
+        # every step-program flag is a program_key term: the warmup surface
+        # must be able to express the exact run it is warming for
+        _add_step_program_args(p)
+        # same self-describing-plan default as check-plan
         if not model_default:
             p.set_defaults(model_size=None)
     elif mode == "trace_export":
@@ -496,6 +574,28 @@ def _int_list(text: str):
     if not out:
         raise argparse.ArgumentTypeError(f"expected comma-separated ints, got {text!r}")
     return out
+
+
+def adam_config_from_args(ns: argparse.Namespace):
+    """Optimizer config from the training flags — ONE construction shared by
+    the trainer and the AOT prewarm (core/elastic.py): the lr/decay terms are
+    burned into the compiled train_step as constants, so a prewarm built
+    from different optimizer hyperparameters would warm a program the run
+    never asks for."""
+    from galvatron_tpu.core.optim import AdamConfig
+
+    lr_schedule = None
+    if getattr(ns, "lr_warmup_iters", 0) or getattr(ns, "lr_decay_iters", 0):
+        from galvatron_tpu.core.schedules import LRSchedule
+
+        lr_schedule = LRSchedule(
+            lr=ns.lr, min_lr=ns.min_lr, warmup_iters=ns.lr_warmup_iters,
+            decay_iters=ns.lr_decay_iters, decay_style=ns.lr_decay_style,
+        )
+    return AdamConfig(
+        lr=ns.lr, weight_decay=ns.weight_decay, grad_clip=ns.grad_clip,
+        lr_schedule=lr_schedule,
+    )
 
 
 def hybrid_config_from_args(ns: argparse.Namespace, num_layers: int, world: int):

@@ -95,17 +95,16 @@ def classify_exit(returncode: int) -> str:
 
 def _bootstrap_sim_world() -> None:
     """Apply the supervisor's simulated-topology override BEFORE the first
-    backend touch. Env ``XLA_FLAGS`` alone is not enough in environments
-    whose sitecustomize pre-imports jax — the platform must also be pinned
-    programmatically (same recipe as the repo's ``__graft_entry__``)."""
+    backend touch. Delegates to ``aot/warmup.force_cpu_world`` — the one
+    copy of the XLA_FLAGS + platform-pin recipe (the program key hashes the
+    resulting XLA_FLAGS tokens, so the warmup and elastic recipes must
+    never drift apart)."""
     n = os.environ.get(SIM_WORLD_ENV)
     if not n:
         return
-    import jax
+    from galvatron_tpu.aot.warmup import force_cpu_world
 
-    flag = f"--xla_force_host_platform_device_count={int(n)}"
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
-    jax.config.update("jax_platforms", "cpu")
+    force_cpu_world(int(n))
 
 
 def prepare_topology(ns, verbose: bool = True) -> Optional[Dict[str, Any]]:
@@ -189,12 +188,72 @@ def prepare_topology(ns, verbose: bool = True) -> Optional[Dict[str, Any]]:
         "source": source,
         "old_plan_hash": fp.get("plan_hash"),
     }
+    # prewarm the NEW plan's programs as part of the re-plan, BEFORE
+    # training starts (galvatron_tpu/aot): restart downtime under a fresh
+    # strategy becomes a cache lookup, and the trainer's startup consult
+    # then proves the programs warm — shrinking the watchdog's first-step
+    # compile grace to the normal deadline
+    info["prewarm"] = _prewarm_plan(ns, plan_path, verbose=verbose)
     if verbose:
         print(
             f"topology change: {fp.get('world_size')} → {world} devices; "
             f"resuming under {plan_path} ({source})"
         )
     return info
+
+
+def _prewarm_plan(ns, plan_path: str, verbose: bool = True) -> Optional[Dict[str, Any]]:
+    """AOT-compile the plan's trainer programs into the compile-artifact
+    cache (aot/warmup.py).  Best-effort by contract: a prewarm failure costs
+    only warmth — the child trains exactly as it would have cold."""
+    from galvatron_tpu.aot.cache import resolve_compile_cache_dir
+
+    cache_dir = resolve_compile_cache_dir(ns)
+    if not cache_dir:
+        return None
+    try:
+        from galvatron_tpu.aot import warmup as aot_warmup
+        from galvatron_tpu.aot.cache import ArtifactStore, enable_persistent_cache
+        from galvatron_tpu.core.arguments import (
+            adam_config_from_args,
+            model_config_from_args,
+            resolve_execution_config,
+        )
+        from galvatron_tpu.core.strategy import HybridParallelConfig
+        from galvatron_tpu.obs.tracing import tracer
+
+        # mirror the trainer's own config resolution (pack_sequences rides
+        # the model config BEFORE attention resolution) so the prewarmed
+        # programs are the programs the run will ask for
+        cfg = model_config_from_args(ns)
+        if getattr(ns, "pack_sequences", 0):
+            cfg = cfg.replace(pack_sequences=True)
+        cfg = resolve_execution_config(cfg, ns)
+        store = ArtifactStore(enable_persistent_cache(cache_dir, override=True))
+        # train_step only: a re-planned child RESUMES (restore, never init),
+        # and eval_loss belongs to `cli warmup` — the step program is the
+        # whole first-step compile the restart would otherwise pay
+        reports = aot_warmup.warmup_plan(
+            cfg, HybridParallelConfig.load(plan_path),
+            global_bsz=int(ns.global_train_batch_size),
+            store=store, include=("train_step",),
+            adam=adam_config_from_args(ns), verbose=verbose,
+        )
+        # hand the SAME store to the trainer: its startup consult now
+        # reports hits and arms the reduced first-step watchdog grace
+        ns.compile_cache_dir = store.dir
+        summ = aot_warmup.summarize(reports)
+        tracer.instant("replan_prewarm", **summ)
+        if verbose:
+            print(
+                f"re-plan prewarm: {summ['compiled']}/{summ['programs']} "
+                f"programs warm ({summ['total_compile_ms']:.0f} ms compile)"
+            )
+        return summ
+    except Exception as e:  # noqa: BLE001 — warmth is optional, training is not
+        print(f"re-plan prewarm failed (continuing cold): "
+              f"{type(e).__name__}: {str(e)[:200]}")
+        return None
 
 
 def adopt_recorded_plan(ns, fp: Dict[str, Any], world: int,
@@ -246,6 +305,20 @@ def child_main(argv: List[str], model_default: Optional[str] = None) -> int:
     from galvatron_tpu.core.resilience import AnomalyAbort
 
     ns = initialize_galvatron("train", argv, model_default)
+    # a supervised child running under the hang watchdog consults the
+    # compile-artifact cache automatically: the warm hint exists to shrink
+    # the watchdog's blind first-step compile grace, and the restart
+    # lifecycle is exactly where a warm program cache pays. Without a
+    # watchdog the consult stays opt-in (--compile_cache_dir) — the first
+    # step then compiles lazily exactly as before, still served by any
+    # configured persistent cache. The re-plan path prewarms + arms the
+    # consult regardless (prepare_topology).
+    if not getattr(ns, "compile_cache_dir", None) and getattr(ns, "step_timeout_s", 0):
+        from galvatron_tpu.aot.cache import resolve_compile_cache_dir
+
+        resolved = resolve_compile_cache_dir(ns)
+        if resolved:
+            ns.compile_cache_dir = resolved
     from galvatron_tpu.search.replan import ReplanInfeasibleError
 
     try:

@@ -31,7 +31,6 @@ from galvatron_tpu.core.checkpoint import (
     uncommitted_steps,
 )
 from galvatron_tpu.core.dataloader import build_dataloader
-from galvatron_tpu.core.optim import AdamConfig
 from galvatron_tpu.core.resilience import AnomalyAbort, AnomalySentinel
 from galvatron_tpu.parallel.hybrid import build_runtime
 from galvatron_tpu.profiling.runtime import RuntimeProfiler
@@ -184,18 +183,11 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
             context="refusing to start: invalid hybrid-parallel flags",
             verbose=verbose,
         )
-    lr_schedule = None
-    if getattr(ns, "lr_warmup_iters", 0) or getattr(ns, "lr_decay_iters", 0):
-        from galvatron_tpu.core.schedules import LRSchedule
+    from galvatron_tpu.core.arguments import adam_config_from_args
 
-        lr_schedule = LRSchedule(
-            lr=ns.lr, min_lr=ns.min_lr, warmup_iters=ns.lr_warmup_iters,
-            decay_iters=ns.lr_decay_iters, decay_style=ns.lr_decay_style,
-        )
-    adam = AdamConfig(
-        lr=ns.lr, weight_decay=ns.weight_decay, grad_clip=ns.grad_clip,
-        lr_schedule=lr_schedule,
-    )
+    # shared with the elastic prewarm: the optimizer terms are constants in
+    # the compiled train_step, so they are part of the program's identity
+    adam = adam_config_from_args(ns)
     rampup = None
     if getattr(ns, "rampup_batch_size", None):
         from galvatron_tpu.core.schedules import BatchSizeRampup
@@ -256,6 +248,88 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
         "plan_hash": plan_hash(hp),
         "global_bsz": int(ns.global_train_batch_size),
     }
+    # AOT compile subsystem (galvatron_tpu/aot; DESIGN.md § AOT compile
+    # subsystem): an explicit --compile_cache_dir arms the startup consult —
+    # enable the shared persistent cache, AOT-compile the programs THIS run
+    # will dispatch (always train_step; init_state only when a fresh init is
+    # coming — a resume never calls it, and eval_loss belongs to `cli
+    # warmup`, not a train run), and account plan-keyed hit/miss in the
+    # artifact manifest. Running BEFORE restore/init means the init compile
+    # below is already a cache deserialize, the loop's first step pays no
+    # XLA compile, and a proven-warm start shrinks the watchdog's
+    # first-step compile grace to the normal deadline. Without the flag the
+    # subsystem stays out of the way entirely (an already-configured jax
+    # cache keeps working; no manifest, no extra lowering).
+    aot_warm_hint = False
+    aot_summ = None
+    if getattr(ns, "compile_cache_dir", None):
+        from galvatron_tpu.aot.cache import (
+            ArtifactStore,
+            enable_persistent_cache,
+            resolve_compile_cache_dir,
+        )
+
+        aot_dir = resolve_compile_cache_dir(ns)
+        # best-effort by contract, like the elastic prewarm: a cache-
+        # infrastructure failure (read-only mount, torn store) costs only
+        # warmth — the run must still train cold
+        try:
+            if aot_dir:
+                from galvatron_tpu.aot import warmup as aot_warmup
+
+                will_restore = bool(ns.load and latest_step(ns.load) is not None)
+                include = ["train_step"]
+                if not will_restore and hf_params is None:
+                    include.append("init_state")
+                store = ArtifactStore(
+                    enable_persistent_cache(aot_dir, override=True)
+                )
+                t0_warm = time.perf_counter()
+                aot_reports = aot_warmup.warmup_runtime(
+                    rt, ns.global_train_batch_size, seq, store=store,
+                    plan=hp, model_cfg=cfg, include=include, verbose=verbose,
+                )
+                startup_ms = round((time.perf_counter() - t0_warm) * 1000.0, 1)
+                for r in aot_reports:
+                    metrics.log(
+                        "compile_cache", program=r["program"], key=r["key"],
+                        status=r["status"], hit=bool(r.get("cache_hit")),
+                        compile_ms=r["compile_ms"],
+                    )
+                aot_summ = aot_warmup.summarize(aot_reports)
+                aot_summ["startup_compile_ms"] = startup_ms
+                ts_rep = next(
+                    (r for r in aot_reports if r["program"] == "train_step"),
+                    None,
+                )
+                # warm ONLY when the step program itself was served from the
+                # manifest-known cache: hits on secondary programs must not
+                # shave the grace the first step's real compile still needs
+                aot_warm_hint = bool(
+                    ts_rep
+                    and ts_rep["status"] == "compiled"
+                    and ts_rep["cache_hit"]
+                )
+                metrics.log(
+                    "aot_warmup", warm_hint=aot_warm_hint, cache_dir=store.dir,
+                    **aot_summ,
+                )
+                if verbose:
+                    print(
+                        f"aot warmup: {aot_summ['hits']} hits / "
+                        f"{aot_summ['misses']} misses, {startup_ms:.0f} ms "
+                        f"startup compile "
+                        f"({'warm' if aot_warm_hint else 'cold'} start)"
+                    )
+        except Exception as e:  # noqa: BLE001 — warmth only, never the run
+            aot_warm_hint = False
+            aot_summ = None
+            metrics.log(
+                "aot_warmup", warm_hint=False, cache_dir=aot_dir,
+                error=f"{type(e).__name__}: {e}"[:200],
+            )
+            print(f"warning: aot warmup failed ({type(e).__name__}: {e}); "
+                  "starting cold")
     start_step = 0
     batch_offset = 0
     saved_data_state = None  # checkpoint's data-pipeline cursor (if any)
@@ -494,6 +568,10 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
         obs_server = ObsServer(train_obs.render, port=ns.obs_port)
         if verbose:
             print(f"obs sidecar: http://127.0.0.1:{obs_server.port}/metrics")
+    if train_obs is not None and aot_summ is not None:
+        train_obs.compile_cache_hits = aot_summ["hits"]
+        train_obs.compile_cache_misses = aot_summ["misses"]
+        train_obs.startup_compile_ms = aot_summ["startup_compile_ms"]
     losses = []
     # consumed-samples bookkeeping: under rampup, replay the schedule from
     # step 0 so a resumed run sees exactly the sizes (and per-size stream
@@ -619,7 +697,14 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                           file=_sys.stderr, flush=True)
             # HangWatchdog os._exits with EXIT_HANG when this returns
 
-        wd = wdmod.HangWatchdog(ns.step_timeout_s, _on_hang)
+        wd = wdmod.HangWatchdog(
+            ns.step_timeout_s, _on_hang,
+            # proven-warm compile cache (startup AOT warmup hit, e.g. after
+            # an elastic re-plan prewarm): the first step carries no XLA
+            # compile, so it gets the NORMAL deadline — a real first-step
+            # hang on a restarted child is detected in seconds, not 10x
+            first_step_scale=1.0 if aot_warm_hint else None,
+        )
 
         @contextlib.contextmanager
         def _watchdog_step(it):

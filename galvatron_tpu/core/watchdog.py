@@ -103,6 +103,7 @@ class HangWatchdog:
         on_hang: Callable[[int], None],
         exit_code: Optional[int] = EXIT_HANG,
         warmup_scale: float = 10.0,
+        first_step_scale: Optional[float] = None,
         poll_s: Optional[float] = None,
     ):
         if timeout_s <= 0:
@@ -111,6 +112,17 @@ class HangWatchdog:
         self.on_hang = on_hang
         self.exit_code = exit_code
         self.warmup_scale = max(1.0, float(warmup_scale))
+        # the first-step grace exists purely for XLA compilation; a warm
+        # compile cache (the trainer's AOT warmup reported a hit, or the
+        # elastic re-plan prewarmed the new plan's programs) means the first
+        # step pays a cache deserialize, not a compile — pass
+        # ``first_step_scale=1.0`` so a REAL first-step hang after a
+        # prewarmed restart is detected in seconds, not 10x step-timeout.
+        # None keeps the blind compile-length default.
+        self.first_step_scale = (
+            self.warmup_scale if first_step_scale is None
+            else max(1.0, float(first_step_scale))
+        )
         self.fired = False
         self._armed_before = False
         self._lock = threading.Lock()
@@ -129,7 +141,15 @@ class HangWatchdog:
         any step it knows will recompile (a rampup batch-size transition),
         not just the process's first step; a 1x deadline there would
         declare a healthy recompile a hang."""
-        scale = self.warmup_scale if (warmup or not self._armed_before) else 1.0
+        if warmup:
+            # a step the trainer KNOWS will recompile (rampup transition)
+            # always gets the compile-length deadline — the warm-cache hint
+            # only covers the programs the startup warmup proved warm
+            scale = self.warmup_scale
+        elif not self._armed_before:
+            scale = self.first_step_scale
+        else:
+            scale = 1.0
         self._armed_before = True
         with self._lock:
             self._step = int(step)

@@ -1408,6 +1408,19 @@ def mlm_loss_sum(params, batch, cfg: ModelConfig, layer_hook=None):
     return cross_entropy_sum(logits, labels, remat=ce_remat(cfg))
 
 
+def batch_row_width(cfg: ModelConfig, seq: int) -> int:
+    """Width of one loader batch row — the shape side of the ``split_batch``
+    contract, shared by every abstract-batch builder (aot warmup, fidelity
+    harness) so they lower the SAME program the run dispatches: vision rows
+    flatten to sample_len pixels + label; packed CLM rows are tokens ‖
+    segment ids, 2·(S+1) (data/packing.py); plain windows are S+1."""
+    if cfg.image_size:
+        return cfg.sample_len + 1
+    if cfg.pack_sequences:
+        return 2 * (seq + 1)
+    return seq + 1
+
+
 def split_batch(batch, cfg: ModelConfig):
     """One (B, sample_len+1) int32 batch row → (model inputs, loss labels) per
     objective. Centralized so the pipeline engines (which re-implement the

@@ -156,6 +156,10 @@ class TrainStats:
         self.anomaly_skips = 0
         self.checkpoints_saved = 0
         self.packing_efficiency: Optional[float] = None
+        # AOT compile subsystem (galvatron_tpu/aot): startup warmup accounting
+        self.compile_cache_hits: Optional[int] = None
+        self.compile_cache_misses: Optional[int] = None
+        self.startup_compile_ms: Optional[float] = None
 
     def render(self) -> str:
         out = PromText()
@@ -177,6 +181,16 @@ class TrainStats:
         out.add("train_packing_efficiency", self.packing_efficiency,
                 help_="non-pad fraction of packed input rows (None-skipped "
                 "when sequence packing is off)")
+        out.add("train_compile_cache_hits", self.compile_cache_hits,
+                mtype="counter",
+                help_="startup AOT warmup programs served warm from the "
+                "compile-artifact cache (galvatron_tpu/aot)")
+        out.add("train_compile_cache_misses", self.compile_cache_misses,
+                mtype="counter",
+                help_="startup AOT warmup programs that paid a real XLA compile")
+        out.add("train_startup_compile_ms", self.startup_compile_ms,
+                help_="wall ms the startup AOT warmup spent compiling "
+                "(deserialization only on a warm start)")
         render_hbm(out)
         return out.render()
 

@@ -477,3 +477,76 @@ def build_runtime(
         state_shardings=shardings, batch_sharding=batch_sharding,
         init_state_from=jit_state_from,
     )
+
+
+# --- AOT program registration (galvatron_tpu/aot): the trainer family -------
+# One family covers EVERY engine build_runtime can dispatch to (GSPMD hybrid,
+# gpipe/1F1B/interleaved shard_map pipelines, enc-dec, swin): they all expose
+# the same jitted (state, batch) train_step / eval_loss seam, so the set of
+# programs a plan needs is enumerable here with no data and no compile.
+
+
+def _trainer_programs(ctx):
+    import jax.numpy as _jnp
+
+    from galvatron_tpu.aot.registry import ProgramSpec
+    from galvatron_tpu.core.checkpoint import abstract_state_of
+
+    rt = ctx.runtime
+    if rt is None:
+        rt = build_runtime(
+            ctx.cfg, ctx.hp, mesh=ctx.mesh, axes=ctx.axes,
+            adam=ctx.adam if ctx.adam is not None else AdamConfig(),
+            global_batch_size=ctx.global_bsz, seq_len=ctx.seq_len,
+        )
+    state_abs = abstract_state_of(rt)
+    seq = ctx.seq_len or rt.cfg.sample_len
+    # the loader row contract lives in modeling.batch_row_width (packed rows
+    # are 2·(S+1), not S+1) — same aval the fidelity harness lowers against
+    # (search/memory_fidelity.measured_train_mb); a wrong width here would
+    # warm a program the run never dispatches and wrongly drop the
+    # watchdog's first-step compile grace
+    batch_abs = jax.ShapeDtypeStruct(
+        (ctx.global_bsz, modeling.batch_row_width(rt.cfg, seq)),
+        _jnp.int32,
+        sharding=rt.batch_sharding,
+    )
+    engine = "pipeline" if rt.hp.pp > 1 else "gspmd"
+    # optimizer hyperparameters are CONSTANTS inside the compiled step — a
+    # different lr/schedule is a different program, so they join the key;
+    # exec_cfg is the runtime's EXECUTED config (build_runtime rewrites
+    # dtype/mlp_recompute from the plan), the one both the trainer consult
+    # and the elastic prewarm must key on to agree
+    key_extra = {"adam": repr(rt.adam), "engine": engine}
+    specs = [
+        ProgramSpec(
+            "train_step", rt.train_step, (state_abs, batch_abs),
+            meta={"donate": (0,), "engine": engine, "key_extra": key_extra,
+                  "exec_cfg": rt.cfg},
+        ),
+        ProgramSpec(
+            "eval_loss", rt.eval_loss, (state_abs, batch_abs),
+            meta={"engine": engine, "key_extra": {"engine": engine},
+                  "exec_cfg": rt.cfg},
+        ),
+    ]
+    if hasattr(rt.init_state, "lower"):  # some pipeline engines init host-side
+        key_abs = jax.eval_shape(lambda: jax.random.key(0))
+        specs.append(
+            ProgramSpec("init_state", rt.init_state, (key_abs,),
+                        meta={"engine": engine, "exec_cfg": rt.cfg,
+                              "key_extra": {"engine": engine}})
+        )
+    return specs
+
+
+def _register_aot_programs():
+    from galvatron_tpu.aot.registry import register_program
+
+    register_program(
+        "trainer", _trainer_programs, needs_plan=True,
+        programs=("train_step", "eval_loss", "init_state"),
+    )
+
+
+_register_aot_programs()

@@ -23,6 +23,7 @@ exactly what the DP consumes.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import List, Optional
 
@@ -33,6 +34,48 @@ from galvatron_tpu.search.cost_model import (
     other_memory_cost,
     transient_overhead_mb,
 )
+
+
+# single-host topologies this module knows how to declare to libtpu:
+# topology_name → (TPU_ACCELERATOR_TYPE, TPU_CHIPS_PER_HOST_BOUNDS)
+_DECLARABLE_TOPOLOGIES = {
+    "v5e:2x4": ("v5litepod-8", "2,4,1"),
+}
+
+
+def declare_local_tpu_topology_env(topology: str = "v5e:2x4") -> None:
+    """Declare a single-host TPU topology to libtpu via the environment.
+
+    Off GCE, libtpu's topology init retries the GCP metadata server for
+    MINUTES (403s) before giving up and proceeding anyway — every
+    ``get_topology_desc`` caller pays it, which is most of what a
+    topology-AOT test costs.  Declaring the topology up front makes init
+    instant.  ``setdefault`` throughout: a real pod's own environment always
+    wins.  The MDS skip and the accelerator type must be set TOGETHER —
+    type alone SIGILLs libtpu.
+
+    Deliberately a no-op on hosts with local TPU devices (``/dev/accel*`` /
+    ``/dev/vfio``): there libtpu's own metadata/env path is authoritative,
+    and a declared shape that disagrees with the real machine would poison
+    every later backend init in this process (and in forked children).
+    Also a no-op for topologies outside ``_DECLARABLE_TOPOLOGIES`` — a
+    v5e-8 declaration under a ``v4:...`` request would be a lie libtpu
+    acts on."""
+    import glob
+
+    if glob.glob("/dev/accel*") or os.path.exists("/dev/vfio"):
+        return
+    spec = _DECLARABLE_TOPOLOGIES.get(topology)
+    if spec is None:
+        return
+    accelerator_type, chip_bounds = spec
+    if os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1") != "1":
+        return
+    os.environ.setdefault("TPU_ACCELERATOR_TYPE", accelerator_type)
+    os.environ.setdefault("TPU_CHIPS_PER_HOST_BOUNDS", chip_bounds)
+    os.environ.setdefault("TPU_HOST_BOUNDS", "1,1,1")
+    os.environ.setdefault("TPU_WORKER_ID", "0")
+    os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
 
 
 @dataclass
@@ -118,6 +161,7 @@ def measured_train_mb(
     try:
         from jax.experimental import topologies
 
+        declare_local_tpu_topology_env(topology)
         topo = topologies.get_topology_desc(platform="tpu", topology_name=topology)
     except Exception:
         return None
@@ -132,8 +176,10 @@ def measured_train_mb(
         cfg, hp, mesh=mesh, axes=axes, adam=AdamConfig(lr=1e-3),
         global_batch_size=global_bsz, seq_len=seq,
     )
+    from galvatron_tpu.models.modeling import batch_row_width
+
     batch = jax.ShapeDtypeStruct(
-        (global_bsz, cfg.sample_len + 1 if cfg.image_size else seq + 1),
+        (global_bsz, batch_row_width(cfg, seq)),
         jnp.int32, sharding=rt.batch_sharding,
     )
     ma = rt.train_step.lower(abstract_state_of(rt), batch).compile().memory_analysis()

@@ -462,3 +462,72 @@ class Engine:
                 req.future.set_exception(exc)
         self.slots.reset()
         self.scheduler.drain(exc)
+
+    def warm_start(self, store=None, verbose: bool = True) -> List[dict]:
+        """AOT-compile the engine's two pinned programs from abstract inputs
+        (galvatron_tpu/aot): with the persistent compile cache enabled, a
+        server restart's first request pays a cache deserialize instead of
+        two XLA compiles.  Call before serving traffic (the jit calls happen
+        on the caller's thread; the loop thread only ever sees warm
+        programs).  Returns the per-program warmup reports."""
+        from galvatron_tpu.aot import registry as aot_registry
+        from galvatron_tpu.aot import warmup as aot_warmup
+
+        ctx = aot_registry.ProgramContext(
+            cfg=self.cfg, num_slots=self.slots.num_slots,
+            prefill_chunk=self.prefill_chunk, max_seq_len=self.slots.max_seq_len,
+        )
+        specs = aot_registry.enumerate_programs(ctx, include=("serving",))
+        return aot_warmup.warmup_programs(
+            specs, store, plan=None, model_cfg=self.cfg, verbose=verbose
+        )
+
+
+# --- AOT program registration (galvatron_tpu/aot): the serving family -------
+# The engine's whole design is "exactly two compiled programs for the
+# lifetime" — which makes them the cheapest possible warm-start: both are
+# enumerable from (ModelConfig, num_slots, prefill_chunk) with no weights.
+
+
+def _serving_programs(ctx):
+    cfg = ctx.cfg
+    if not cfg.causal or cfg.objective != "clm" or getattr(cfg, "enc_layers", 0) > 0:
+        return []  # same constraint as the Engine ctor
+    from galvatron_tpu.aot.registry import ProgramSpec
+    from galvatron_tpu.models import modeling
+
+    params_abs = jax.eval_shape(
+        lambda k: modeling.init_model_params(k, cfg), jax.random.key(0)
+    )
+    max_len = int(min(ctx.max_seq_len or cfg.max_seq_len, cfg.max_seq_len))
+    num_slots = max(1, int(ctx.num_slots))
+    chunk = min(max(1, int(ctx.prefill_chunk)), max_len)
+    cache_abs = jax.eval_shape(
+        lambda: generation.init_kv_cache(cfg, num_slots, max_len)
+    )
+    i32 = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.int32)  # noqa: E731
+    return [
+        ProgramSpec(
+            "serving_prefill", _prefill_chunk,
+            (params_abs, cfg, cache_abs, i32(1, chunk), i32(), i32()),
+            meta={"donate": ("cache",), "num_slots": num_slots,
+                  "prefill_chunk": chunk},
+        ),
+        ProgramSpec(
+            "serving_decode", _decode_step,
+            (params_abs, cfg, cache_abs, i32(num_slots), i32(num_slots)),
+            meta={"donate": ("cache",), "num_slots": num_slots},
+        ),
+    ]
+
+
+def _register_aot_programs():
+    from galvatron_tpu.aot.registry import register_program
+
+    register_program(
+        "serving", _serving_programs,
+        programs=("serving_prefill", "serving_decode"),
+    )
+
+
+_register_aot_programs()

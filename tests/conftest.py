@@ -23,13 +23,17 @@ import jax
 
 # Persistent compilation cache: the suite is compile-bound (every pipeline
 # test builds fresh shard_map programs); caching compiled executables across
-# test processes cuts re-run wall time drastically.
-jax.config.update(
-    "jax_compilation_cache_dir",
+# test processes cuts re-run wall time drastically. ONE shared wiring
+# (aot/cache.py) — the same helper the trainer's --compile_cache_dir, `cli
+# warmup`, and the CI jobs use; min_compile_time 0.5s keeps thousands of
+# trivial test programs from churning the cache dir.
+from galvatron_tpu.aot.cache import enable_persistent_cache
+
+enable_persistent_cache(
     os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+    min_compile_time_s=0.5,
+    override=True,
 )
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest
 
