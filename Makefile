@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test test-all bench dryrun lint check-plan chaos data-smoke warmup clean
+.PHONY: all native test test-all bench dryrun lint check-plan chaos serving-chaos data-smoke warmup clean
 
 all: native
 
@@ -42,6 +42,15 @@ chaos:
 	  --train_iters 4 --save /tmp/galvatron_chaos --save_interval 2 \
 	  --max_restarts 3 --step_timeout_s 5 --replan_search_space dp+tp
 	$(PY) -c "from galvatron_tpu.core.checkpoint import latest_step; s = latest_step('/tmp/galvatron_chaos'); assert s == 4, s; print('chaos shrink ok: committed step', s)"
+
+# serving chaos harness (docs/DESIGN.md § Serving resilience): a real
+# `cli serve` subprocess under injected faults — engine crash mid-decode,
+# dead-client stall, SIGTERM mid-load — each must end with zero leaked
+# slots, exit 0, and a flight-recorder dump (CI runs the same matrix)
+serving-chaos:
+	$(PY) experiments/serving_chaos.py crash
+	$(PY) experiments/serving_chaos.py stall
+	$(PY) experiments/serving_chaos.py sigterm
 
 # data-pipeline smoke (docs/DESIGN.md § Data pipeline): tokenize two tiny
 # corpora → 0.7/0.3 mixture → pack → 4 traced train iters; asserts

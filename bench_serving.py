@@ -106,6 +106,80 @@ def _pct(xs, q):
     return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))] if xs else None
 
 
+def run_overload(ns):
+    """Overload section (--overload): clients ≫ slots with short TTLs — the
+    interesting number is not throughput but *behavior*: how much work was
+    served vs shed/expired/rejected, and the p99 TTFT of the requests that
+    WERE served (load shedding exists so the served tail stays bounded).
+    Ends with a POST /drain so the shed path and the zero-leak audit are
+    exercised under real saturation."""
+    import urllib.error
+
+    clients = ns.overload_clients
+    params, cfg, tok, engine = _build(
+        ns.overload_slots, ns.prompt_len + 2 + ns.tokens
+    )
+    svc, port = _start(params, cfg, tok, engine)
+    outcomes = {"served": 0, "expired": 0, "queue_full": 0, "other_503": 0,
+                "error": 0}
+    try:
+        _drive(port, 1, 1, ns.tokens, ns.prompt_len)  # warmup compile
+        engine.reset_metrics()
+
+        def one(i):
+            pstr = "ab" * (ns.prompt_len // 2) + str(i % 10)
+            body = json.dumps({
+                "prompts": [pstr], "tokens_to_generate": ns.tokens,
+                "ttl_s": ns.overload_ttl_s,
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api", data=body,
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    json.loads(r.read())
+                return "served"
+            except urllib.error.HTTPError as e:
+                detail = json.loads(e.read() or b"{}").get("detail", "")
+                if detail == "expired":
+                    return "expired"
+                if detail == "queue_full":
+                    return "queue_full"
+                return "other_503" if e.code == 503 else "error"
+            except Exception:  # noqa: BLE001 — counted, not raised
+                return "error"
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=clients) as ex:
+            for kind in ex.map(one, range(clients * ns.requests_per_client)):
+                outcomes[kind] += 1
+        wall = time.perf_counter() - t0
+        ttft_p99 = engine.ttft.quantile(0.99)
+        st = engine.stats()
+        # drain under the tail of the load: shed accounting + leak audit
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/drain", data=b"", method="POST",
+        ), timeout=30)
+        svc._drained.wait(timeout=60)
+        audit = getattr(svc, "drain_audit", {})
+        return {
+            "metric": "serving_overload",
+            "clients": clients,
+            "num_slots": ns.overload_slots,
+            "requests": clients * ns.requests_per_client,
+            "ttl_s": ns.overload_ttl_s,
+            "wall_s": round(wall, 3),
+            **outcomes,
+            "engine_expired": st["expired"],
+            "engine_shed": st["shed"],
+            "ttft_p99_s_served": round(ttft_p99, 4) if ttft_p99 else None,
+            "post_drain_leaked_slots": audit.get("leaked"),
+        }
+    finally:
+        engine.close()
+
+
 def run_side(num_slots, clients, requests_per_client, tokens, prompt_len):
     # +2: ByteTokenizer bos + the one-digit client suffix
     params, cfg, tok, engine = _build(num_slots, prompt_len + 2 + tokens)
@@ -157,7 +231,23 @@ def main(argv=None):
     ap.add_argument("--require_speedup", type=float, default=0.0,
                     help="exit 1 unless engine/baseline tokens/s exceeds "
                     "this ratio (CI smoke uses 1.0)")
+    ap.add_argument("--overload", action="store_true",
+                    help="also run the overload section (clients >> slots, "
+                    "short TTLs): served/shed/expired split + p99 TTFT of "
+                    "served requests, printed before the headline")
+    ap.add_argument("--overload_clients", type=int, default=12)
+    ap.add_argument("--overload_slots", type=int, default=2)
+    ap.add_argument("--overload_ttl_s", type=float, default=2.0)
     ns = ap.parse_args(argv)
+
+    if ns.overload:
+        # failure-isolated BEFORE the headline: a broken overload probe must
+        # not cost the engine-vs-baseline regression signal
+        try:
+            print(json.dumps(run_overload(ns)))
+        except Exception as e:  # noqa: BLE001 — isolate, report, continue
+            print(json.dumps({"metric": "serving_overload", "skipped": True,
+                              "error": f"{type(e).__name__}: {e}"}))
 
     engine_side = run_side(ns.num_slots, ns.clients, ns.requests_per_client,
                            ns.tokens, ns.prompt_len)

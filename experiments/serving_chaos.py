@@ -1,0 +1,210 @@
+"""Serving chaos harness: drive `cli serve` through injected failures at the
+process surface and assert the resilience contract held.
+
+Mirrors the chaos-elastic pattern (Makefile `chaos` / CI `chaos-elastic`):
+each scenario runs a REAL `cli serve` subprocess on a tiny CPU model, arms
+`GALVATRON_FAULTS`, fires concurrent HTTP clients, and must end with
+
+- drained slots (the server's exit line reports ``leaked=False``),
+- process exit 0,
+- a flight-recorder dump present under ``--flight_dir``.
+
+Scenarios::
+
+    crash    engine_crash_at_iter mid-load: in-flight requests get
+             well-formed 503s (detail=engine_restarted), the engine
+             restarts in-process, later requests succeed, POST /drain
+             finishes the run cleanly.
+    stall    client_stall: a dead client's request is cancelled at the next
+             decode iteration (cancelled_disconnect counts it, the slot
+             frees), then a clean drain.
+    sigterm  SIGTERM mid-load: in-flight requests complete, the process
+             exits 0 inside --drain_timeout_s (zero-downtime shutdown).
+
+Usage: ``python experiments/serving_chaos.py crash|stall|sigterm [--out_dir D]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+SERVE_ARGS = [
+    "--port", "0", "--num_slots", "2", "--prefill_chunk", "8",
+    "--num_layers", "1", "--hidden_size", "32", "--num_heads", "2",
+    "--ffn_dim", "64", "--seq_length", "64",
+    "--request_ttl_s", "120", "--drain_timeout_s", "30",
+]
+
+
+def start_server(out_dir: str, faults: str):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", GALVATRON_FAULTS=faults)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "galvatron_tpu.cli", "serve",
+         *SERVE_ARGS, "--flight_dir", os.path.join(out_dir, "flight")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    port = None
+    for line in proc.stdout:
+        m = re.search(r"listening on http://[^:]+:(\d+)/api", line)
+        if m:
+            port = int(m.group(1))
+            break
+    if port is None:
+        proc.kill()
+        raise SystemExit("server never came up")
+    return proc, port
+
+
+def post(port, body, timeout=90):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def healthz(port):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=30
+    ) as r:
+        return json.loads(r.read())
+
+
+def drain(port):
+    urllib.request.urlopen(urllib.request.Request(
+        f"http://127.0.0.1:{port}/drain", data=b"", method="POST",
+    ), timeout=30)
+
+
+def fire_clients(port, n, tokens, results):
+    def one(i):
+        try:
+            results.append(("ok", post(
+                port, {"prompts": [f"chaos {i}"], "tokens_to_generate": tokens}
+            )))
+        except urllib.error.HTTPError as e:
+            results.append(("http", e.code, json.loads(e.read() or b"{}")))
+        except Exception as e:  # noqa: BLE001 — dropped conns are outcomes too
+            results.append(("err", repr(e)))
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def wait_exit(proc, timeout=60) -> tuple:
+    """(rc, remaining stdout) — the drained exit line lives in stdout."""
+    rest = proc.stdout.read()
+    rc = proc.wait(timeout=timeout)
+    return rc, rest
+
+
+def check_common(name, rc, out, out_dir):
+    assert rc == 0, f"{name}: expected exit 0, got {rc}\n{out[-2000:]}"
+    assert "server drained: leaked=False" in out, \
+        f"{name}: no clean drain audit in output\n{out[-2000:]}"
+    flight = os.path.join(out_dir, "flight")
+    dumps = [f for f in os.listdir(flight)] if os.path.isdir(flight) else []
+    assert any(f.startswith("flight_") for f in dumps), \
+        f"{name}: no flight dump under {flight}"
+    print(f"{name}: ok (exit 0, zero leaked slots, flight dump present)")
+
+
+def scenario_crash(out_dir):
+    proc, port = start_server(
+        out_dir, "engine_crash_at_iter=8,slow_decode_ms=10")
+    results = []
+    threads = fire_clients(port, 6, 16, results)
+    for t in threads:
+        t.join(timeout=120)
+    restarted = [r for r in results
+                 if r[0] == "http" and r[2].get("detail") == "engine_restarted"]
+    assert restarted, f"crash caught no in-flight request: {results}"
+    after = post(port, {"prompts": ["recovered"], "tokens_to_generate": 4})
+    assert after["text"], after
+    h = healthz(port)
+    assert h["serving"]["engine_restarts"] >= 1, h["serving"]
+    drain(port)
+    rc, out = wait_exit(proc)
+    check_common("crash", rc, out, out_dir)
+    print(f"  {len(restarted)} in-flight 503(engine_restarted), "
+          f"{sum(1 for r in results if r[0] == 'ok')} served, "
+          f"restarts={h['serving']['engine_restarts']}")
+
+
+def scenario_stall(out_dir):
+    proc, port = start_server(out_dir, "client_stall=1,slow_decode_ms=25")
+    results = []
+    threads = fire_clients(port, 3, 20, results)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if healthz(port)["serving"]["cancelled_disconnect"] >= 1:
+            break
+        time.sleep(0.1)
+    for t in threads:
+        t.join(timeout=120)
+    h = healthz(port)
+    assert h["serving"]["cancelled_disconnect"] >= 1, h["serving"]
+    assert h["serving"]["active_slots"] == 0, h["serving"]
+    drain(port)
+    rc, out = wait_exit(proc)
+    check_common("stall", rc, out, out_dir)
+    print(f"  cancelled_disconnect={h['serving']['cancelled_disconnect']}, "
+          f"slots freed")
+
+
+def scenario_sigterm(out_dir):
+    proc, port = start_server(out_dir, "slow_decode_ms=25")
+    results = []
+    threads = fire_clients(port, 3, 16, results)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if healthz(port)["serving"]["active_slots"] > 0:
+            break
+        time.sleep(0.05)
+    t0 = time.monotonic()
+    proc.send_signal(signal.SIGTERM)
+    rc, out = wait_exit(proc)
+    elapsed = time.monotonic() - t0
+    for t in threads:
+        t.join(timeout=120)
+    check_common("sigterm", rc, out, out_dir)
+    assert elapsed < 45.0, f"drain overran: {elapsed:.1f}s"
+    served = [r for r in results if r[0] == "ok"]
+    assert served, f"in-flight requests did not complete: {results}"
+    print(f"  {len(served)} in-flight completed through the drain, "
+          f"exit in {elapsed:.1f}s")
+
+
+SCENARIOS = {"crash": scenario_crash, "stall": scenario_stall,
+             "sigterm": scenario_sigterm}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("serving_chaos")
+    ap.add_argument("scenario", choices=sorted(SCENARIOS))
+    ap.add_argument("--out_dir", default=None)
+    ns = ap.parse_args(argv)
+    out_dir = ns.out_dir or f"/tmp/serving_chaos_{ns.scenario}"
+    shutil.rmtree(out_dir, ignore_errors=True)
+    os.makedirs(out_dir, exist_ok=True)
+    SCENARIOS[ns.scenario](out_dir)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -375,7 +375,20 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             return 0
         from galvatron_tpu.server import GenerationService, run_server
 
+        # chaos hooks (engine_crash_at_iter / prefill_fail_at /
+        # slow_decode_ms / client_stall): no-ops unless GALVATRON_FAULTS is
+        # set — same contract as the trainer
+        from galvatron_tpu.core import faults as _faults
+
+        _faults.init_from_env()
         engine = None
+        if getattr(ns, "flight_dir", None):
+            # --flight_dir alone arms span tracing (same contract as the
+            # trainer): a crash flight dump with an empty ring is a no-op
+            from galvatron_tpu.obs.tracing import tracer as _tracer
+
+            if not _tracer.enabled:
+                _tracer.enable()
         if ns.num_slots > 0:
             from galvatron_tpu.serving import Engine
 
@@ -388,6 +401,10 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
                 eos_id=tok.eos_id if tok.eos_id is not None else -1,
                 pad_id=tok.pad_id if tok.pad_id is not None else 0,
                 seed=ns.seed,
+                deadline_policy=ns.deadline_policy,
+                max_engine_restarts=ns.max_engine_restarts,
+                drain_timeout_s=ns.drain_timeout_s,
+                flight_dir=ns.flight_dir,
             )
         if engine is not None and getattr(ns, "compile_cache_dir", None):
             # warm-start the engine's two pinned programs BEFORE accepting
@@ -415,7 +432,10 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             GenerationService(params, cfg, tok, ns.max_new_tokens, ns.seed,
                               engine=engine),
             port=ns.port, host=ns.host, max_pending=ns.max_pending,
+            drain_timeout_s=ns.drain_timeout_s,
         )
+        # a drained SIGTERM/POST-/drain shutdown exits 0: zero-downtime
+        # rollouts treat this process as cleanly replaceable
         return 0
 
     print(

@@ -349,13 +349,34 @@ def _add_generate_args(p: argparse.ArgumentParser):
                    help="prompt tokens prefilled per jitted chunk when a "
                    "request joins its slot (one compiled program per size)")
     g.add_argument("--request_ttl_s", type=float, default=30.0,
-                   help="max seconds a request may wait in the admission "
-                   "queue before being rejected with 503 (<=0: no TTL)")
+                   help="end-to-end request deadline: a request that "
+                   "out-waits it in queue 503s, and one still decoding past "
+                   "it is stopped at the next iteration (--deadline_policy "
+                   "decides partial-vs-fail); <=0: no deadline")
+    g.add_argument("--deadline_policy", type=str, default="partial",
+                   choices=["partial", "fail"],
+                   help="over-deadline DECODING requests: 'partial' returns "
+                   "the text generated so far marked truncated=deadline; "
+                   "'fail' 503s them (either way the slot frees immediately)")
     g.add_argument("--max_queue", type=int, default=64,
                    help="admission queue depth; beyond it requests fail "
                    "fast with 503 (engine path's max_pending equivalent)")
     g.add_argument("--max_pending", type=int, default=8,
                    help="legacy path: bound on queued /api requests")
+    g.add_argument("--drain_timeout_s", type=float, default=30.0,
+                   help="graceful drain bound (SIGTERM or POST /drain): "
+                   "in-flight requests get this long to finish after "
+                   "admission closes; stragglers are failed and the "
+                   "process still exits 0 on time")
+    g.add_argument("--max_engine_restarts", type=int, default=3,
+                   help="serve: consecutive no-progress in-process engine "
+                   "restarts (crash supervision) before the engine gives "
+                   "up and /readyz goes permanently unready; a completed "
+                   "request between crashes resets the budget")
+    g.add_argument("--flight_dir", type=str, default=None,
+                   help="serve: write a flight-recorder dump (tracer ring) "
+                   "on every engine crash/restart; arms span tracing like "
+                   "the trainer flag of the same name")
     g.add_argument("--compile_cache_dir", type=str, default=None,
                    help="serve: persistent compile cache (aot/cache.py); the "
                    "engine warm-starts its two pinned programs before "

@@ -33,6 +33,21 @@ Supported faults:
   scenario ``core/signals.py`` documents), tripping ``--step_timeout_s``'s
   hang watchdog.
 
+Serving faults (the serving chaos harness — injected at the engine's
+iteration seam, so recovery exercises exactly the crash-supervision /
+cancellation machinery a real fault would):
+
+- ``engine_crash_at_iter=N`` — the engine's decode iteration N raises
+  (once); the in-process ``EngineSupervisor`` must fail in-flight requests
+  fast, reset the KV cache, warm-rebuild, and keep serving.
+- ``prefill_fail_at=N``      — prefill chunk N raises (once); only that
+  one request fails, its slot frees.
+- ``slow_decode_ms=K``       — every decode iteration sleeps K ms (the
+  degraded-chip scenario: TTL expiry and drain deadlines under load).
+- ``client_stall=N``         — the server's disconnect poll treats the next
+  N connections as vanished clients (the dead-client slot-leak scenario:
+  cancellation must free the slot mid-decode).
+
 The hooks are called from the real code paths (checkpoint save/commit, the
 retry wrapper, the trainer's loss observation and step loop), so an
 injected fault exercises exactly the machinery a real one would.
@@ -140,6 +155,36 @@ def maybe_hang(step: int) -> None:
     if k is not None and step == int(k):
         del _active["hang_at_step"]
         _time.sleep(_active.get("hang_s", 300))
+
+
+def engine_iteration(step: int) -> None:
+    """Serving-engine iteration seam. ``engine_crash_at_iter=N``: decode
+    iteration N raises :class:`FaultInjected` — once, so the supervised
+    restart proves recovery, not a crash loop. ``slow_decode_ms=K``: every
+    iteration sleeps K ms (degraded-chip simulation)."""
+    k = _active.get("engine_crash_at_iter")
+    if k is not None and step == int(k):
+        del _active["engine_crash_at_iter"]
+        raise FaultInjected(f"injected engine crash at decode iteration {step}")
+    ms = _active.get("slow_decode_ms", 0)
+    if ms:
+        _time.sleep(ms / 1000.0)
+
+
+def prefill_chunk(idx: int) -> None:
+    """Armed ``prefill_fail_at=N``: the engine's N-th prefill chunk raises
+    (once) — one request fails, the engine and its other slots live on."""
+    k = _active.get("prefill_fail_at")
+    if k is not None and idx == int(k):
+        del _active["prefill_fail_at"]
+        raise FaultInjected(f"injected prefill failure at chunk {idx}")
+
+
+def maybe_client_stall() -> bool:
+    """Armed ``client_stall=N``: the server's disconnect poll reports the
+    next N polled connections as dead clients (consumed per connection),
+    driving the cancellation path without a real socket reset."""
+    return _consume("client_stall")
 
 
 def world_schedule(env: Optional[str] = None) -> List[int]:

@@ -112,20 +112,29 @@ def server_metrics_text(service) -> str:
         out.add("server_gate_in_use", g["in_use"])
         out.add("server_gate_capacity", g["capacity"])
         out.add("server_gate_rejected_total", g["rejected"], mtype="counter")
+    out.add("server_ready", 1 if service.ready else 0,
+            help_="accepting new work (0 while draining or engine dead)")
+    out.add("server_draining", 1 if service.draining else 0)
     eng = service.engine
     if eng is not None:
         s = eng.stats()
         for name in ("steps", "prefill_chunks", "prefill_tokens",
                      "tokens_generated", "submitted", "admitted", "completed",
-                     "failed", "expired"):
+                     "failed", "expired", "expired_decode", "cancelled",
+                     "cancelled_disconnect", "shed"):
             out.add(f"serving_{name}_total", s[name], mtype="counter")
         out.add("serving_rejected_queue_full_total", s["rejected_queue_full"],
                 mtype="counter")
+        out.add("serving_engine_restarts_total", s["engine_restarts"],
+                mtype="counter",
+                help_="in-process engine crash-supervision restarts "
+                "(serving/resilience.py)")
         for name in ("queue_depth", "queue_capacity", "active_slots",
                      "num_slots", "occupancy", "tokens_per_s",
                      "tokens_per_s_last_step"):
             out.add(f"serving_{name}", s[name])
         out.add("serving_queue_saturated", s["queue_saturated"])
+        out.add("serving_draining", s["draining"])
         for q, key in (("0.5", "ttft_p50_s"), ("0.95", "ttft_p95_s")):
             out.add("serving_ttft_seconds", s[key], labels={"quantile": q},
                     help_="time-to-first-token over the recent-request window")

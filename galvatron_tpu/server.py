@@ -21,9 +21,17 @@ API (POST or PUT /api, JSON body):
   {"prompts": ["..."], "tokens_to_generate": 32, "temperature": 0.0,
    "top_k": 0, "top_p": 0.0}
 → {"text": ["...completions..."], "tokens": [[...ids...]]}
-GET /healthz → {"status": "ok", "uptime_s": ..., "requests": {succeeded/
-                failed/rejected}, "gate" | "serving": saturation + engine
-                stats, "model": {vocab/hidden/layers/heads/max_seq_len}}
+GET /healthz → {"status": "ok" | "draining", "uptime_s": ..., "requests":
+                {succeeded/failed/rejected/cancelled}, "gate" | "serving":
+                saturation + engine stats, "model": {vocab/hidden/layers/
+                heads/max_seq_len}}
+GET /readyz  → 200 {"ready": true} while accepting traffic; 503 the moment
+               a drain begins (or the engine gives up restarting) — a load
+               balancer stops routing BEFORE the last in-flight token lands
+POST /drain  → begin a graceful drain (same as SIGTERM): admission closes
+               (new /api requests 503 + Retry-After), queued requests are
+               shed, in-flight slots run to completion under
+               --drain_timeout_s, then the server stops and exits 0
 GET /metrics → the same stats in Prometheus text exposition (obs/prom.py):
                request counters, engine counters, TTFT quantiles, occupancy,
                HBM gauges — a scraper target next to the probe.
@@ -44,13 +52,18 @@ disconnecting client must not leave tracebacks or a half-written 500.
 from __future__ import annotations
 
 import json
+import select
+import signal
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import jax
 
+from galvatron_tpu.core import faults
+from galvatron_tpu.obs.tracing import tracer as _obs_tracer
 from galvatron_tpu.utils.metrics import Counters
 
 
@@ -91,7 +104,22 @@ class _Gate:
 
 
 class ServiceBusy(RuntimeError):
-    """Mapped to HTTP 503 by the handler (queue full / TTL expired)."""
+    """Mapped to HTTP 503 by the handler (queue full / TTL expired / drain /
+    engine restart). ``detail`` lands in the JSON body so clients and the
+    chaos harness can tell the causes apart; ``retry_after_s`` becomes a
+    ``Retry-After`` header (draining: come back after the drain window)."""
+
+    def __init__(self, msg: str, detail: Optional[str] = None,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.detail = detail
+        self.retry_after_s = retry_after_s
+
+
+class ClientDisconnected(RuntimeError):
+    """The handler's disconnect poll saw the client vanish mid-generation:
+    the requests were cancelled, nobody is listening — drop the connection
+    without writing a reply."""
 
 
 class GenerationService:
@@ -105,10 +133,61 @@ class GenerationService:
         self.engine = engine  # serving.Engine, or None for the legacy path
         self.lock = threading.Lock()
         self.started_at = time.time()
-        self.counters = Counters("succeeded", "failed", "rejected")
+        self.counters = Counters("succeeded", "failed", "rejected", "cancelled")
         self.gate: Optional[_Gate] = None  # set by run_server (legacy path)
         # one capture at a time: jax.profiler state is process-global
         self._profile_lock = threading.Lock()
+        # graceful drain state (begin_drain): admission closes, /readyz goes
+        # unready immediately, in-flight work completes under the deadline
+        self.draining = False
+        self.drain_timeout_s = 30.0
+        self._drain_lock = threading.Lock()
+        self._drained = threading.Event()
+
+    @property
+    def ready(self) -> bool:
+        """What ``/readyz`` keys on: accepting NEW work. Unready the moment
+        a drain begins (in-flight work may still be finishing — that is the
+        point: the load balancer stops routing before the last token lands)
+        and when the engine is dead (crash-restart budget exhausted)."""
+        if self.draining:
+            return False
+        if self.engine is not None and not self.engine.alive:
+            return False
+        return True
+
+    def begin_drain(self, reason: str = "drain") -> dict:
+        """Graceful drain, blocking until drained (or the deadline): shed
+        the queue, let in-flight slots finish, close the engine. Idempotent
+        — a second caller (SIGTERM after POST /drain) waits for the first
+        drain to finish. Returns the engine's post-drain audit."""
+        with self._drain_lock:
+            first = not self.draining
+            self.draining = True
+        if not first:
+            self._drained.wait(timeout=self.drain_timeout_s + 10.0)
+            return getattr(self, "drain_audit", {})
+        _obs_tracer.instant("serving_drain_begin", reason=reason)
+        if self.engine is not None:
+            # close admission at the ENGINE first so racing submissions
+            # refuse with EngineDraining even before handlers see the flag
+            self.engine.begin_drain()
+            audit = self.engine.drain(self.drain_timeout_s)
+        else:
+            # legacy path: the gate stops admitting (handler checks
+            # `draining`); wait for in-flight generations to release it
+            deadline = time.monotonic() + self.drain_timeout_s
+            while time.monotonic() < deadline:
+                if self.gate is None or self.gate.snapshot()["in_use"] == 0:
+                    break
+                time.sleep(0.02)
+            g = self.gate.snapshot() if self.gate is not None else {}
+            audit = {"leaked": bool(g.get("in_use")), **g}
+        self.drain_audit = audit
+        _obs_tracer.instant("serving_drain_done", reason=reason,
+                            leaked=audit.get("leaked"))
+        self._drained.set()
+        return audit
 
     @property
     def requests_served(self) -> int:
@@ -119,7 +198,8 @@ class GenerationService:
         c = self.cfg
         req = self.counters.snapshot()
         out = {
-            "status": "ok",
+            "status": "draining" if self.draining else "ok",
+            "ready": self.ready,
             "uptime_s": round(time.time() - self.started_at, 3),
             "requests_served": req["succeeded"],
             "requests": req,
@@ -150,41 +230,95 @@ class GenerationService:
             raise ValueError(f"tokens_to_generate out of range [0, {self.cfg.max_seq_len}]")
         return prompts, n_new
 
-    def generate(self, body: dict) -> dict:
+    def generate(self, body: dict,
+                 disconnect_check: Optional[Callable[[], bool]] = None) -> dict:
         prompts, n_new = self._validate(body)
         tok_prompts = [self.tok.encode(p) for p in prompts]
         if self.engine is not None:
-            outs = self._generate_engine(body, tok_prompts, n_new)
+            outs, truncated = self._generate_engine(
+                body, tok_prompts, n_new, disconnect_check
+            )
         else:
             outs = self._generate_serialized(body, tok_prompts, n_new)
+            truncated = [None] * len(outs)
         texts = [self.tok.decode(o[len(tp):]) for o, tp in zip(outs, tok_prompts)]
-        return {"text": texts, "tokens": outs}
+        resp = {"text": texts, "tokens": outs}
+        if any(truncated):
+            # deadline_policy=partial: the row stopped at its deadline —
+            # say so instead of passing truncation off as a completion
+            resp["truncated"] = truncated
+        return resp
 
-    def _generate_engine(self, body: dict, tok_prompts, n_new: int):
+    def _generate_engine(self, body: dict, tok_prompts, n_new: int,
+                         disconnect_check: Optional[Callable[[], bool]] = None):
         """Continuous-batching path: one engine request per prompt, futures
         resolved as slots retire. Prompts of one HTTP request overlap with
-        each other AND with every other in-flight connection."""
+        each other AND with every other in-flight connection. While the
+        futures are pending, ``disconnect_check`` polls the client socket —
+        a vanished client cancels its requests at the next decode iteration
+        (the slot frees) instead of burning chip time to completion."""
+        from concurrent.futures import FIRST_EXCEPTION
         from concurrent.futures import TimeoutError as FuturesTimeout
+        from concurrent.futures import wait as futures_wait
 
-        from galvatron_tpu.serving import QueueFull, RequestExpired
+        from galvatron_tpu.serving import (
+            DeadlineExceeded,
+            EngineClosed,
+            EngineDraining,
+            EngineRestarted,
+            QueueFull,
+            RequestExpired,
+            RequestShed,
+        )
 
         ttl = body.get("ttl_s")
-        futures = []
+        reqs = []
         try:
             for tp in tok_prompts:
-                futures.append(self.engine.submit(
+                reqs.append(self.engine.submit_request(
                     tp, n_new,
                     temperature=float(body.get("temperature", 0.0)),
                     top_k=int(body.get("top_k", 0)),
                     top_p=float(body.get("top_p", 0.0)),
                     ttl_s=float(ttl) if ttl is not None else None,
                 ))
-            return [f.result(timeout=self.engine.result_timeout_s)
-                    for f in futures]
+            deadline = time.monotonic() + self.engine.result_timeout_s
+            pending = {r.future for r in reqs}
+            while pending:
+                done, pending = futures_wait(
+                    pending, timeout=0.05, return_when=FIRST_EXCEPTION
+                )
+                if done and any(f.exception() is not None for f in done):
+                    break  # propagate via .result() below
+                if not pending:
+                    break
+                if disconnect_check is not None and disconnect_check():
+                    for r in reqs:
+                        r.cancel("disconnect")
+                    self.counters.inc("cancelled")
+                    raise ClientDisconnected(
+                        "client vanished mid-generation; requests cancelled"
+                    )
+                if time.monotonic() > deadline:
+                    raise FuturesTimeout()
+            outs = [r.future.result(timeout=self.engine.result_timeout_s)
+                    for r in reqs]
+            truncated = [r.finish_reason if r.finish_reason == "deadline"
+                         else None for r in reqs]
+            return outs, truncated
         except QueueFull as e:
-            raise ServiceBusy(str(e)) from e
-        except RequestExpired as e:
-            raise ServiceBusy(str(e)) from e
+            raise ServiceBusy(str(e), detail="queue_full") from e
+        except (RequestExpired, DeadlineExceeded) as e:
+            raise ServiceBusy(str(e), detail="expired") from e
+        except RequestShed as e:
+            raise ServiceBusy(str(e), detail="shed") from e
+        except EngineDraining as e:
+            raise ServiceBusy(str(e), detail="draining",
+                              retry_after_s=e.retry_after_s) from e
+        except EngineRestarted as e:
+            raise ServiceBusy(str(e), detail="engine_restarted") from e
+        except EngineClosed as e:
+            raise ServiceBusy(str(e), detail="engine_closed") from e
         except FuturesTimeout as e:
             # distinct from the socket-read TimeoutError the handler treats
             # as a dead client: this request must get a real 500 and count
@@ -195,9 +329,11 @@ class GenerationService:
             ) from e
         finally:
             # failed or abandoned siblings must not burn chip time: cancel
-            # whatever has not been admitted yet (done futures ignore it)
-            for f in futures:
-                f.cancel()
+            # whatever has not completed (done futures ignore it; admitted
+            # requests retire at the next decode iteration)
+            for r in reqs:
+                r.cancel("abandoned")
+                r.future.cancel()
 
     def profile_capture(self, steps: int, trace_dir: Optional[str] = None,
                         timeout_s: float = 30.0) -> dict:
@@ -263,10 +399,12 @@ def _make_handler(service: GenerationService, request_timeout_s: float):
         # of pinning its handler thread forever
         timeout = request_timeout_s
 
-        def _reply(self, code: int, payload: dict):
-            self._reply_raw(code, json.dumps(payload).encode(), "application/json")
+        def _reply(self, code: int, payload: dict, headers: Optional[dict] = None):
+            self._reply_raw(code, json.dumps(payload).encode(),
+                            "application/json", headers)
 
-        def _reply_raw(self, code: int, data: bytes, ctype: str):
+        def _reply_raw(self, code: int, data: bytes, ctype: str,
+                       headers: Optional[dict] = None):
             # a client that disconnected mid-generation must not blow a
             # traceback out of the handler (nor can the 500-path itself be
             # allowed to throw) — drop the dead connection like the
@@ -275,18 +413,60 @@ def _make_handler(service: GenerationService, request_timeout_s: float):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
             except (BrokenPipeError, ConnectionResetError, TimeoutError, OSError):
                 self.close_connection = True
 
+        def _client_disconnected(self) -> bool:
+            """Is the client still on the other end? The request body was
+            already read in full, so any readable-with-zero-bytes on the
+            socket is the client's FIN (a clean close); a reset raises.
+            ``client_stall`` (core/faults.py) simulates a vanished client
+            for the chaos harness without a real socket reset."""
+            if faults.maybe_client_stall():
+                return True
+            try:
+                r, _, _ = select.select([self.connection], [], [], 0)
+                if not r:
+                    return False
+                return self.connection.recv(1, socket.MSG_PEEK) == b""
+            except OSError:
+                return True
+
         def _handle(self):
             route, _, query = self.path.partition("?")
             route = route.rstrip("/")
+            if route == "/drain":
+                # admin endpoint, same lifecycle as SIGTERM: reply first
+                # (the drain outlives this connection), then drain + stop
+                # on a separate thread — serve_forever returns once the
+                # in-flight work has landed
+                threading.Thread(
+                    target=drain_and_stop, args=(service, "POST /drain"),
+                    daemon=True,
+                ).start()
+                return self._reply(200, {
+                    "status": "draining",
+                    "drain_timeout_s": service.drain_timeout_s,
+                })
             if route == "/profile":
                 return self._do_profile(query)
             if route != "/api":
-                return self._reply(404, {"error": "use /api"})
+                return self._reply(404, {"error": "use /api or /drain"})
+            if service.draining:
+                # admission gate is closed: fail fast with an honest 503 and
+                # a Retry-After so a well-behaved client backs off while the
+                # load balancer (watching /readyz) reroutes
+                service.counters.inc("rejected")
+                return self._reply(
+                    503,
+                    {"error": "server draining", "detail": "draining"},
+                    headers={"Retry-After":
+                             str(max(1, int(service.drain_timeout_s)))},
+                )
             # bounded pending work (legacy path only): the threading server
             # gives every connection a thread, and a thread parked on the
             # generation lock is NOT covered by the socket timeout — without
@@ -304,7 +484,9 @@ def _make_handler(service: GenerationService, request_timeout_s: float):
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
-                resp = service.generate(body)
+                resp = service.generate(
+                    body, disconnect_check=self._client_disconnected
+                )
                 service.counters.inc("succeeded")
                 return self._reply(200, resp)
             except TimeoutError:
@@ -312,9 +494,20 @@ def _make_handler(service: GenerationService, request_timeout_s: float):
                 # attempting to write a reply into the dead socket
                 self.close_connection = True
                 return
+            except ClientDisconnected:
+                # the disconnect poll cancelled the requests (already
+                # counted); nobody is listening for a reply
+                self.close_connection = True
+                return
             except ServiceBusy as e:
                 service.counters.inc("rejected")
-                return self._reply(503, {"error": str(e)})
+                payload = {"error": str(e)}
+                if e.detail:
+                    payload["detail"] = e.detail
+                headers = None
+                if e.retry_after_s is not None:
+                    headers = {"Retry-After": str(max(1, int(e.retry_after_s)))}
+                return self._reply(503, payload, headers)
             except ValueError as e:
                 service.counters.inc("failed")
                 return self._reply(400, {"error": str(e)})
@@ -359,7 +552,16 @@ def _make_handler(service: GenerationService, request_timeout_s: float):
         def do_GET(self):
             route = self.path.partition("?")[0].rstrip("/")
             if route == "/healthz":
+                # liveness: 200 even while draining — the process is healthy,
+                # it is READINESS that flipped (status says "draining")
                 return self._reply(200, service.health())
+            if route == "/readyz":
+                if service.ready:
+                    return self._reply(200, {"ready": True})
+                return self._reply(503, {
+                    "ready": False,
+                    "status": "draining" if service.draining else "engine_dead",
+                })
             if route == "/metrics":
                 from galvatron_tpu.obs.prom import CONTENT_TYPE, server_metrics_text
 
@@ -370,8 +572,8 @@ def _make_handler(service: GenerationService, request_timeout_s: float):
                 return self._reply_raw(200, text.encode(), CONTENT_TYPE)
             return self._reply(
                 404,
-                {"error": "use /api (POST/PUT), /healthz, /metrics (GET), "
-                          "or /profile (POST)"},
+                {"error": "use /api (POST/PUT), /healthz, /readyz, /metrics "
+                          "(GET), or /profile, /drain (POST)"},
             )
 
         def log_message(self, *a):  # quiet
@@ -380,9 +582,22 @@ def _make_handler(service: GenerationService, request_timeout_s: float):
     return Handler
 
 
+def drain_and_stop(service: GenerationService, reason: str) -> dict:
+    """The zero-downtime shutdown sequence (SIGTERM and ``POST /drain``):
+    ``begin_drain`` (admission closes, ``/readyz`` unready, queue shed,
+    in-flight completes under ``drain_timeout_s``, engine closes with a
+    zero-leak audit), then stop ``serve_forever`` so the process exits 0."""
+    audit = service.begin_drain(reason=reason)
+    httpd = getattr(service, "httpd", None)
+    if httpd is not None:
+        httpd.shutdown()
+    return audit
+
+
 def run_server(service: GenerationService, port: int = 5000, host: str = "127.0.0.1",
                ready_event: Optional[threading.Event] = None,
-               request_timeout_s: float = 120.0, max_pending: int = 8) -> None:
+               request_timeout_s: float = 120.0, max_pending: int = 8,
+               drain_timeout_s: float = 30.0) -> None:
     # threading server: /healthz must answer while a long generation is in
     # flight — a probe timing out against a busy single-threaded server
     # would get a healthy process restarted. On the legacy path max_pending
@@ -390,11 +605,33 @@ def run_server(service: GenerationService, port: int = 5000, host: str = "127.0.
     # scheduler's bounded queue is the admission control.
     if service.engine is None:
         service.gate = _Gate(max_pending)
+    service.drain_timeout_s = float(drain_timeout_s)
     httpd = ThreadingHTTPServer(
         (host, port), _make_handler(service, request_timeout_s)
     )
     service.httpd = httpd
+    # SIGTERM = graceful drain (zero-downtime shutdown), not an abort: the
+    # handler only installs from the main thread (tests run run_server on a
+    # worker thread and drive POST /drain instead). The drain runs on its
+    # own thread — a signal handler must not block for the drain window.
+    try:
+        signal.signal(
+            signal.SIGTERM,
+            lambda signum, frame: threading.Thread(
+                target=drain_and_stop, args=(service, f"signal {signum}"),
+                daemon=True,
+            ).start(),
+        )
+    except ValueError:
+        pass  # not the main thread
     if ready_event is not None:
         ready_event.set()
     print(f"generation server listening on http://{host}:{httpd.server_address[1]}/api")
-    httpd.serve_forever()
+    try:
+        httpd.serve_forever()
+    finally:
+        httpd.server_close()
+    if service.draining:
+        audit = getattr(service, "drain_audit", {})
+        print(f"server drained: leaked={audit.get('leaked')} "
+              f"audit={json.dumps(audit)}")

@@ -1,14 +1,23 @@
 """Continuous-batching serving subsystem.
 
-Three layers, one per module:
+Four layers, one per module:
 
 - [[kv_slots]] ``SlotKVCache`` — persistent fixed-shape device KV cache,
-  host-side slot allocator (per-slot offset/length, alloc/free/reset).
+  host-side slot allocator (per-slot offset/length, alloc/free/reset,
+  invariant ``audit``).
 - [[scheduler]] ``Scheduler`` — FIFO admission queue with per-request TTL,
-  bounded depth (``QueueFull``), expiry (``RequestExpired``), counters.
+  bounded depth (``QueueFull``), expiry (``RequestExpired``), shed-on-drain,
+  counters.
+- [[resilience]] — the request lifecycle state machine (QUEUED →
+  PREFILLING → DECODING → {COMPLETED, FAILED, EXPIRED, CANCELLED, SHED}),
+  the in-process ``EngineSupervisor`` crash-restart decision table, and the
+  exceptions the server maps to HTTP (``EngineDraining``/``EngineClosed``/
+  ``EngineRestarted``/``RequestShed``/``RequestCancelled``/
+  ``DeadlineExceeded``).
 - [[engine]] ``Engine`` — the loop: one jitted decode step over all slots
   per iteration, chunked prefill on admission, host-side per-request
-  sampling, retire-on-eos/budget.
+  sampling, retire-on-eos/budget/deadline/cancel, graceful ``drain`` with
+  a post-drain zero-leak ``audit``.
 
 ``server.GenerationService`` submits into the engine via futures; the
 legacy serialized ``generate_np`` path remains available when the engine is
@@ -17,6 +26,15 @@ disabled (``--num_slots 0``).
 
 from galvatron_tpu.serving.engine import Engine
 from galvatron_tpu.serving.kv_slots import SlotKVCache
+from galvatron_tpu.serving.resilience import (
+    DeadlineExceeded,
+    EngineClosed,
+    EngineDraining,
+    EngineRestarted,
+    EngineSupervisor,
+    RequestCancelled,
+    RequestShed,
+)
 from galvatron_tpu.serving.scheduler import (
     QueueFull,
     Request,
@@ -31,4 +49,11 @@ __all__ = [
     "Request",
     "QueueFull",
     "RequestExpired",
+    "RequestShed",
+    "RequestCancelled",
+    "DeadlineExceeded",
+    "EngineDraining",
+    "EngineClosed",
+    "EngineRestarted",
+    "EngineSupervisor",
 ]

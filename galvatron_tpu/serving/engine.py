@@ -37,10 +37,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from galvatron_tpu.core import faults
 from galvatron_tpu.models import generation
 from galvatron_tpu.models.generation import KVCache
 from galvatron_tpu.models.modeling import ModelConfig
 from galvatron_tpu.obs.tracing import tracer as _obs_tracer
+from galvatron_tpu.serving import resilience as rz
 from galvatron_tpu.serving.kv_slots import SlotKVCache
 from galvatron_tpu.serving.scheduler import Request, Scheduler
 from galvatron_tpu.utils.metrics import Counters, QuantileWindow
@@ -119,7 +121,17 @@ class Engine:
                  request_ttl_s: Optional[float] = 30.0,
                  max_seq_len: Optional[int] = None, eos_id: int = -1,
                  pad_id: int = 0, seed: int = 0,
-                 result_timeout_s: float = 600.0, start_loop: bool = True):
+                 result_timeout_s: float = 600.0, start_loop: bool = True,
+                 deadline_policy: str = "partial",
+                 max_engine_restarts: int = 3,
+                 restart_backoff_s: float = 0.05,
+                 drain_timeout_s: float = 30.0,
+                 flight_dir: Optional[str] = None):
+        if deadline_policy not in ("partial", "fail"):
+            raise ValueError(
+                f"deadline_policy must be 'partial' or 'fail', got "
+                f"{deadline_policy!r}"
+            )
         if not cfg.causal or cfg.objective != "clm" or cfg.enc_layers > 0:
             raise ValueError(
                 "serving engine requires a decoder-only causal LM (same "
@@ -137,10 +149,21 @@ class Engine:
         # a chunk longer than the slot would slice past the cache end
         self.prefill_chunk = min(int(prefill_chunk), self.slots.max_seq_len)
         self.scheduler = Scheduler(max_queue=max_queue, default_ttl_s=request_ttl_s)
+        self.deadline_policy = deadline_policy
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.supervisor = rz.EngineSupervisor(
+            max_restarts=max_engine_restarts, backoff_s=restart_backoff_s,
+            flight_dir=flight_dir,
+        )
         self.counters = Counters(
-            "steps", "prefill_chunks", "prefill_tokens", "tokens_generated"
+            "steps", "prefill_chunks", "prefill_tokens", "tokens_generated",
+            "engine_restarts",
         )
         self.ttft = QuantileWindow(512)
+        # AOT artifact store for crash warm-rebuilds (set by warm_start);
+        # summary of the most recent restart's warm-up, for tests/probes
+        self._store = None
+        self.last_restart_warm: Optional[dict] = None
         self._last_logits = np.zeros(
             (self.slots.num_slots, cfg.vocab_size), np.float32
         )
@@ -159,6 +182,9 @@ class Engine:
         self._guard_baseline = None
         self._cond = threading.Condition()
         self._stop = False
+        self._draining = False
+        self._closed = False
+        self._working = False  # loop thread inside one admit+step iteration
         self._thread = threading.Thread(
             target=self._loop, name="serving-engine", daemon=True
         )
@@ -174,6 +200,31 @@ class Engine:
         (prompt + completion, eos excluded — ``generate_np`` row semantics).
         Raises ``QueueFull`` on backpressure; the Future fails with
         ``RequestExpired`` if the request out-waits its TTL in queue."""
+        return self.submit_request(
+            tokens, max_new_tokens, temperature=temperature, top_k=top_k,
+            top_p=top_p, ttl_s=ttl_s,
+        ).future
+
+    def submit_request(self, tokens: Sequence[int], max_new_tokens: int,
+                       temperature: float = 0.0, top_k: int = 0,
+                       top_p: float = 0.0,
+                       ttl_s: Optional[float] = None) -> Request:
+        """Like :meth:`submit` but returns the :class:`Request`, which
+        carries the lifecycle state, ``finish_reason`` (deadline
+        truncation), and the ``cancel()`` handle the server's disconnect
+        poll uses. Refuses immediately — instead of parking a future that
+        can never resolve — when the engine is draining or closed."""
+        if self._closed:
+            raise rz.EngineClosed(
+                "engine is closed"
+                + (" (crash-restart budget exhausted)"
+                   if self.supervisor.gave_up else "")
+            )
+        if self._draining:
+            raise rz.EngineDraining(
+                "server is draining: not accepting new requests",
+                retry_after_s=self.drain_timeout_s,
+            )
         tokens = [int(t) for t in tokens]
         if not tokens:
             raise ValueError("empty prompt")
@@ -190,13 +241,28 @@ class Engine:
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p),
         )
+        _obs_tracer.instant("req_queued", rid=req.rid, tokens=len(tokens))
         if max_new_tokens == 0:
+            # counted as submitted too: terminal outcomes must partition the
+            # submitted total or /metrics shows completed > submitted
+            self.scheduler.counters.inc("submitted")
+            rz.advance(req, rz.COMPLETED, self.scheduler.counters,
+                       reason="zero_budget")
+            req.finish_reason = "length"
             req.future.set_result(list(tokens))
-            return req.future
+            return req
         self.scheduler.submit(req, ttl_s=ttl_s)
         with self._cond:
             self._cond.notify()
-        return req.future
+        if self._closed:
+            # close()/give-up raced the enqueue: the shutdown drain may have
+            # run before our submit landed, and nothing will ever pop the
+            # queue again — fail it here (idempotent if the drain got it)
+            # so no caller is left holding a future that cannot resolve
+            exc = rz.EngineClosed("engine shut down")
+            self.scheduler.drain(exc)
+            raise exc
+        return req
 
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
                  **kw) -> List[List[int]]:
@@ -232,18 +298,33 @@ class Engine:
             "failed": sc["failed"],
             "rejected_queue_full": sc["rejected_queue_full"],
             "expired": sc["expired"],
+            "expired_decode": sc["expired_decode"],
+            "cancelled": sc["cancelled"],
+            "cancelled_disconnect": sc["cancelled_disconnect"],
+            "shed": sc["shed"],
+            "engine_restarts": ec["engine_restarts"],
+            "draining": self._draining,
+            "alive": self.alive,
         }
+
+    @property
+    def alive(self) -> bool:
+        """False once the engine is closed, drained, or gave up restarting
+        — what ``/readyz`` keys on."""
+        return not self._closed and not self.supervisor.gave_up
 
     def reset_metrics(self) -> None:
         """Zero counters/TTFT/throughput accounting (bench: drop warmup
         compile time from the measured window). Call while idle."""
         self.counters = Counters(
-            "steps", "prefill_chunks", "prefill_tokens", "tokens_generated"
+            "steps", "prefill_chunks", "prefill_tokens", "tokens_generated",
+            "engine_restarts",
         )
-        self.scheduler.counters = Counters(
-            "submitted", "admitted", "completed", "failed",
-            "rejected_queue_full", "expired",
-        )
+        self.scheduler.counters = Scheduler.new_counters()
+        # the supervisor's progress detection reads the completed counter:
+        # its high-water mark must reset with it, or post-reset completions
+        # never register as progress and the restart budget burns early
+        self.supervisor.note_counter_reset()
         self.ttft = QuantileWindow(512)
         self._busy_s = 0.0
         self._last_step_tps = 0.0
@@ -255,13 +336,89 @@ class Engine:
         if self._by_slot:
             self._step()
 
-    def close(self) -> None:
+    def begin_drain(self) -> None:
+        """Flip into draining mode without blocking: admission closes
+        (``submit`` raises ``EngineDraining``), queued-but-unstarted
+        requests are shed fast with the distinct ``SHED`` status, in-flight
+        slots keep decoding. Idempotent; :meth:`drain` adds the bounded
+        wait + finalization."""
+        with self._cond:
+            if self._draining:
+                return
+            self._draining = True
+            self._cond.notify_all()
+        _obs_tracer.instant(
+            "engine_drain_begin", active=self.slots.active_count,
+            queued=self.scheduler.depth,
+        )
+        self.scheduler.shed_all(retry_after_s=self.drain_timeout_s)
+
+    def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Graceful shutdown: shed the queue, let in-flight slots run to
+        completion under a bounded deadline, then stop the loop and close.
+        Returns the post-drain invariant :meth:`audit` (zero leaked slots
+        on every exit path is the contract the chaos harness pins)."""
+        timeout_s = self.drain_timeout_s if timeout_s is None else float(timeout_s)
+        self.begin_drain()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            # the allocator, not _by_slot, is the in-flight authority: a
+            # request mid-PREFILL holds a slot before it reaches _by_slot,
+            # and _working covers the pop→alloc gap inside one iteration —
+            # closing under either would fail work the drain promised to
+            # finish
+            if (self.slots.active_count == 0 and self.scheduler.empty()
+                    and not self._working):
+                break
+            if not self._thread.is_alive():
+                break  # start_loop=False or a give-up: nothing will progress
+            time.sleep(0.01)
+        overran = [r.rid for r in self._by_slot.values()]
+        # exit time past the deadline is bounded by ONE loop iteration (the
+        # thread cannot be preempted mid-jit-dispatch, only asked to stop at
+        # the next iteration boundary) — budget the join accordingly rather
+        # than the blind 30 s shutdown default
+        self.close(join_timeout_s=max(2.0, timeout_s))
+        if overran:
+            _obs_tracer.instant("engine_drain_overrun", rids=str(overran))
+        audit = self.audit()
+        _obs_tracer.instant("engine_drain_done", **{
+            k: v for k, v in audit.items() if not isinstance(v, dict)})
+        if self.supervisor.flight_dir:
+            # every exit path leaves forensics, the graceful one included —
+            # the chaos harness asserts a dump exists for drain AND crash
+            from galvatron_tpu.obs.flight import dump_flight
+
+            dump_flight(self.supervisor.flight_dir, _obs_tracer,
+                        reason="graceful drain", extra=audit)
+        return audit
+
+    def audit(self) -> dict:
+        """Post-drain/post-traffic invariant check: every slot returned to
+        the free list, no request bookkeeping left behind, and (when the
+        jit programs exist) the two-program pin intact."""
+        slot_audit = self.slots.audit()
+        return {
+            "slots_ok": slot_audit["ok"],
+            "active_slots": slot_audit["active"],
+            "free_slots": slot_audit["free"],
+            "num_slots": slot_audit["num_slots"],
+            "tracked_requests": len(self._by_slot),
+            "queue_depth": self.scheduler.depth,
+            "leaked": (not slot_audit["ok"] or slot_audit["active"] != 0
+                       or slot_audit["free"] != slot_audit["num_slots"]
+                       or bool(self._by_slot)),
+            "engine_restarts": self.counters.get("engine_restarts"),
+        }
+
+    def close(self, join_timeout_s: float = 30.0) -> None:
+        self._closed = True
         with self._cond:
             self._stop = True
             self._cond.notify_all()
-        if self._thread.is_alive():
-            self._thread.join(timeout=30)
-        self._fail_all(RuntimeError("engine shut down"))
+        if self._thread.is_alive() and threading.current_thread() is not self._thread:
+            self._thread.join(timeout=join_timeout_s)
+        self._fail_all(rz.EngineClosed("engine shut down"))
 
     def __enter__(self):
         return self
@@ -281,11 +438,34 @@ class Engine:
                 if self._stop:
                     break
             try:
-                self._admit()
-                if self._by_slot:
-                    self._step()
+                self._working = True
+                try:
+                    self._admit()
+                    if self._by_slot:
+                        self._step()
+                finally:
+                    self._working = False
             except Exception as e:  # noqa: BLE001 — engine must not die silently
-                self._fail_all(e)
+                # in-process crash supervision (resilience.EngineSupervisor):
+                # fail the unreplayable in-flight work fast, keep queued
+                # requests with TTL budget, reset the KV cache, warm-rebuild,
+                # and keep looping — give-up closes the engine for good
+                try:
+                    recovered = self.supervisor.on_crash(self, e)
+                except Exception as e2:  # noqa: BLE001 — recovery failed
+                    # a crash INSIDE recovery must not strand the loop
+                    # thread with live futures: treat it as a give-up
+                    self.supervisor.gave_up = True
+                    recovered = False
+                    e = e2
+                if not recovered:
+                    self._closed = True
+                    self._fail_all(rz.EngineClosed(
+                        f"engine gave up after "
+                        f"{self.supervisor.restarts_total} restart(s): "
+                        f"{type(e).__name__}: {e}"
+                    ))
+                    break
 
     def _admit(self) -> None:
         """Admit queued requests into free slots (chunked prefill)."""
@@ -294,17 +474,33 @@ class Engine:
             req = self.scheduler.pop()
             if req is None:
                 return
-            if req.future.cancelled():  # abandoned while queued
+            if req.cancel_requested or req.future.cancelled():
+                # abandoned while queued: terminal before ever taking a slot
+                rz.advance(req, rz.CANCELLED, self.scheduler.counters,
+                           reason=req.cancel_reason or "abandoned")
+                if not req.future.done():
+                    req.future.set_exception(rz.RequestCancelled(
+                        f"request {req.rid} cancelled while queued "
+                        f"({req.cancel_reason or 'abandoned'})"
+                    ))
                 continue
             try:
                 self._prefill(req)
             except Exception as e:  # noqa: BLE001 — fail the one request
-                self.scheduler.counters.inc("failed")
                 if req.slot is not None:
                     self._by_slot.pop(req.slot, None)
                     self._rng.pop(req.slot, None)
                     self.slots.free(req.slot)
                     req.slot = None
+                # a deadline that ran out DURING prefill is an expiry, not a
+                # failure: no token was ever sampled, so both deadline
+                # policies fail it with the TTL's own 503
+                if isinstance(e, rz.DeadlineExceeded):
+                    rz.advance(req, rz.EXPIRED, self.scheduler.counters,
+                               where="prefill")
+                else:
+                    rz.advance(req, rz.FAILED, self.scheduler.counters,
+                               reason=type(e).__name__)
                 if not req.future.done():
                     req.future.set_exception(e)
 
@@ -319,6 +515,7 @@ class Engine:
         slot = self.slots.alloc()
         assert slot is not None
         req.slot = slot
+        rz.advance(req, rz.PREFILLING, slot=slot)
         toks = np.asarray(req.tokens, np.int32)
         c = self.prefill_chunk
         smax = self.slots.max_seq_len
@@ -333,6 +530,14 @@ class Engine:
             starts[-1] = smax - c
         last_row = None
         for start in starts:
+            # the deadline is end-to-end: a long prompt must not burn chip
+            # time prefilling past the moment its client stops waiting
+            if req.deadline is not None and time.time() > req.deadline:
+                raise rz.DeadlineExceeded(
+                    f"request {req.rid} deadline passed during prefill "
+                    f"({start}/{len(toks)} tokens in)"
+                )
+            faults.prefill_chunk(self.counters.get("prefill_chunks"))
             chunk = toks[start:start + c]
             n = len(chunk)
             # fresh buffer per chunk: on CPU, jnp.asarray may alias the host
@@ -353,36 +558,55 @@ class Engine:
         self.slots.lengths[slot] = len(toks)
         self._by_slot[slot] = req
         self._rng[slot] = np.random.default_rng((self.seed, req.rid))
+        rz.advance(req, rz.DECODING, slot=slot)
         self._busy_s += time.perf_counter() - t0
 
     def _step(self) -> None:
         """One decode iteration: sample for every active slot from its last
-        logits, retire eos/budget-exhausted rows, then run ONE shared forward
-        for the survivors."""
+        logits, retire eos/budget-exhausted/cancelled/over-deadline rows,
+        then run ONE shared forward for the survivors."""
         t0 = time.perf_counter()
+        # the chaos seam: engine_crash_at_iter raises here (the supervisor
+        # must recover), slow_decode_ms stretches the iteration
+        faults.engine_iteration(self.counters.get("steps"))
         tokens = np.zeros((self.slots.num_slots,), np.int32)
         offsets = np.zeros((self.slots.num_slots,), np.int32)
         sampled = 0
         appended = 0
         retired: List[int] = []
+        cancelled: List[int] = []
+        expired: List[int] = []
         with _obs_tracer.span("sample", active=self.slots.active_count):
             for slot in self.slots.active_slots():
                 req = self._by_slot[slot]
+                now = time.time()
+                if req.cancel_requested or req.future.cancelled():
+                    # a dead client must not keep burning its KV slot: the
+                    # disconnect poll set the flag, the slot frees HERE, at
+                    # decode-iteration granularity
+                    cancelled.append(slot)
+                    continue
+                if req.deadline is not None and now > req.deadline:
+                    # end-to-end deadline at decode-step granularity: one
+                    # 4096-token hog can no longer starve everything behind it
+                    expired.append(slot)
+                    continue
                 tok = _sample_host(
                     self._rng[slot], self._last_logits[slot],
                     req.temperature, req.top_k, req.top_p,
                 )
                 sampled += 1
-                now = time.time()
                 if req.first_token_at is None:
                     req.first_token_at = now
                     self.ttft.add(now - req.submitted_at)
                 if self.eos_id >= 0 and tok == self.eos_id:
+                    req.finish_reason = "eos"
                     retired.append(slot)
                     continue
                 req.generated.append(tok)
                 appended += 1
                 if len(req.generated) >= req.max_new_tokens:
+                    req.finish_reason = "length"
                     retired.append(slot)
                     continue
                 tokens[slot] = tok
@@ -390,6 +614,10 @@ class Engine:
                 self.slots.lengths[slot] += 1
         for slot in retired:
             self._retire(slot)
+        for slot in cancelled:
+            self._retire_cancelled(slot)
+        for slot in expired:
+            self._retire_deadline(slot)
         still = self.slots.active_slots()
         if still:
             with _obs_tracer.span("decode", active=len(still)):
@@ -445,23 +673,93 @@ class Engine:
                 "static argument or shape is varying per request"
             )
 
-    def _retire(self, slot: int) -> None:
+    def _release_slot(self, slot: int) -> Request:
         req = self._by_slot.pop(slot)
         self._rng.pop(slot, None)
         self.slots.free(slot)
-        self.scheduler.counters.inc("completed")
+        return req
+
+    def _retire(self, slot: int) -> None:
+        req = self._release_slot(slot)
+        rz.advance(req, rz.COMPLETED, self.scheduler.counters,
+                   reason=req.finish_reason)
         if not req.future.done():
             req.future.set_result(list(req.tokens) + req.generated)
 
+    def _retire_cancelled(self, slot: int) -> None:
+        req = self._release_slot(slot)
+        reason = req.cancel_reason or "cancelled"
+        rz.advance(req, rz.CANCELLED, self.scheduler.counters,
+                   reason=reason, generated=len(req.generated))
+        if not req.future.done():
+            req.future.set_exception(rz.RequestCancelled(
+                f"request {req.rid} cancelled mid-decode ({reason})"
+            ))
+
+    def _retire_deadline(self, slot: int) -> None:
+        """Over-deadline DECODING request: the slot frees either way; the
+        engine's ``deadline_policy`` decides whether the client gets the
+        partial text (``"truncated": "deadline"``) or a deadline failure."""
+        req = self._release_slot(slot)
+        req.finish_reason = "deadline"
+        rz.advance(req, rz.EXPIRED, self.scheduler.counters,
+                   where="decode", generated=len(req.generated),
+                   policy=self.deadline_policy)
+        if req.future.done():
+            return
+        if self.deadline_policy == "partial":
+            req.future.set_result(list(req.tokens) + req.generated)
+        else:
+            req.future.set_exception(rz.DeadlineExceeded(
+                f"request {req.rid} exceeded its deadline after "
+                f"{len(req.generated)}/{req.max_new_tokens} tokens"
+            ))
+
     def _fail_all(self, exc: Exception) -> None:
         for slot in list(self._by_slot):
-            req = self._by_slot.pop(slot)
-            self._rng.pop(slot, None)
-            self.scheduler.counters.inc("failed")
+            req = self._release_slot(slot)
+            rz.advance(req, rz.FAILED, self.scheduler.counters,
+                       reason=type(exc).__name__)
             if not req.future.done():
                 req.future.set_exception(exc)
         self.slots.reset()
         self.scheduler.drain(exc)
+
+    def _crash_cleanup(self, exc: BaseException) -> None:
+        """Crash recovery, step 1 (called by the supervisor): fail the
+        in-flight requests fast — continuous batching cannot replay
+        mid-decode KV state, and the failed dispatch may have invalidated
+        the donated cache buffers — and keep only the queued requests that
+        still have TTL budget."""
+        wrapped = rz.EngineRestarted(
+            f"engine restarted mid-request ({type(exc).__name__}: {exc}); "
+            "please resubmit"
+        )
+        for slot in list(self._by_slot):
+            req = self._release_slot(slot)
+            rz.advance(req, rz.FAILED, self.scheduler.counters,
+                       reason="engine_crash")
+            if not req.future.done():
+                req.future.set_exception(wrapped)
+        self.slots.reset()
+        self._last_logits[:] = 0.0
+        # queued requests were never admitted: they survive the restart —
+        # minus the ones whose TTL budget the crash already consumed
+        self.scheduler.expire()
+
+    def _warm_rebuild(self) -> None:
+        """Crash recovery, step 2: re-warm the two pinned programs from the
+        AOT artifact store (PR 9) so recovery costs cache-hit milliseconds,
+        not a recompile. Best-effort — warmth is optional, serving is not."""
+        if self._store is None:
+            return
+        try:
+            from galvatron_tpu.aot import warmup as aot_warmup
+
+            reports = self.warm_start(self._store, verbose=False)
+            self.last_restart_warm = aot_warmup.summarize(reports)
+        except Exception as e:  # noqa: BLE001 — recovery must not die warming
+            _obs_tracer.instant("engine_warm_rebuild_failed", error=repr(e))
 
     def warm_start(self, store=None, verbose: bool = True) -> List[dict]:
         """AOT-compile the engine's two pinned programs from abstract inputs
@@ -473,6 +771,10 @@ class Engine:
         from galvatron_tpu.aot import registry as aot_registry
         from galvatron_tpu.aot import warmup as aot_warmup
 
+        # keep the store: crash recovery re-warms from it (_warm_rebuild),
+        # so an engine restart is an artifact-store hit, not a recompile
+        if store is not None:
+            self._store = store
         ctx = aot_registry.ProgramContext(
             cfg=self.cfg, num_slots=self.slots.num_slots,
             prefill_chunk=self.prefill_chunk, max_seq_len=self.slots.max_seq_len,

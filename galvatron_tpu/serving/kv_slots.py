@@ -93,3 +93,20 @@ class SlotKVCache:
         """Whole lifetime of the request stays inside the slot: the last
         generated token sits at position prompt_len + max_new_tokens - 1."""
         return prompt_len >= 1 and prompt_len + max_new_tokens <= self.max_seq_len
+
+    def audit(self) -> dict:
+        """Allocator invariant check (the drain/chaos harness's zero-leak
+        proof): the free list and the active set partition the slot range
+        exactly — no double-frees, no leaks, no phantom slots."""
+        free_set = set(self._free)
+        ok = (
+            len(free_set) == len(self._free)          # no duplicate frees
+            and not (free_set & self._active)         # disjoint
+            and (free_set | self._active) == set(range(self.num_slots))
+        )
+        return {
+            "ok": ok,
+            "free": len(self._free),
+            "active": len(self._active),
+            "num_slots": self.num_slots,
+        }

@@ -23,6 +23,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Deque, List, Optional
 
+from galvatron_tpu.serving import resilience as rz
 from galvatron_tpu.utils.metrics import Counters
 
 
@@ -31,7 +32,8 @@ class QueueFull(RuntimeError):
 
 
 class RequestExpired(RuntimeError):
-    """Request spent longer than its TTL waiting in the admission queue."""
+    """Request out-lived its TTL: waiting in the admission queue, or (since
+    the deadline became end-to-end) mid-prefill before any token existed."""
 
 
 _rid = itertools.count()
@@ -39,14 +41,15 @@ _rid = itertools.count()
 
 @dataclass
 class Request:
-    """One generation request moving through queue → slot → retirement."""
+    """One generation request moving through the lifecycle state machine
+    (``resilience.STATES``): queue → slot → terminal state."""
 
     tokens: List[int]                 # prompt token ids
     max_new_tokens: int
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 0.0
-    deadline: Optional[float] = None  # absolute time() the queue wait may last
+    deadline: Optional[float] = None  # absolute time() the request may run to
     rid: int = field(default_factory=lambda: next(_rid))
     future: Future = field(default_factory=Future)
     submitted_at: float = field(default_factory=time.time)
@@ -54,10 +57,24 @@ class Request:
     slot: Optional[int] = None
     generated: List[int] = field(default_factory=list)
     first_token_at: Optional[float] = None
+    state: str = rz.QUEUED
+    cancel_requested: bool = False
+    cancel_reason: Optional[str] = None
+    # terminal detail: "eos" | "length" | "deadline" (partial-policy
+    # truncation — the server surfaces it as ``"truncated": "deadline"``)
+    finish_reason: Optional[str] = None
 
     @property
     def prompt_len(self) -> int:
         return len(self.tokens)
+
+    def cancel(self, reason: str = "cancelled") -> None:
+        """Ask the engine to stop this request at the next decode iteration
+        (or skip it at admission). Thread-safe: a bool write under the GIL;
+        the engine loop is the only reader that acts on it."""
+        self.cancel_requested = True
+        if self.cancel_reason is None:
+            self.cancel_reason = reason
 
 
 class Scheduler:
@@ -68,9 +85,16 @@ class Scheduler:
         self.default_ttl_s = default_ttl_s
         self._q: Deque[Request] = deque()
         self._lock = threading.Lock()
-        self.counters = Counters(
+        self.counters = self.new_counters()
+
+    @staticmethod
+    def new_counters() -> Counters:
+        """One counter per request outcome (``reset_metrics`` rebuilds the
+        same set, so the two sites cannot drift)."""
+        return Counters(
             "submitted", "admitted", "completed", "failed",
-            "rejected_queue_full", "expired",
+            "rejected_queue_full", "expired", "expired_decode",
+            "cancelled", "cancelled_disconnect", "shed",
         )
 
     def submit(self, req: Request, ttl_s: Optional[float] = None) -> Request:
@@ -104,7 +128,7 @@ class Scheduler:
                     keep.append(r)
             self._q = keep
         for r in dropped:
-            self.counters.inc("expired")
+            rz.advance(r, rz.EXPIRED, self.counters, where="queue")
             if not r.future.done():  # client may have cancelled already
                 r.future.set_exception(RequestExpired(
                     f"request {r.rid} expired after "
@@ -122,16 +146,35 @@ class Scheduler:
         self.counters.inc("admitted")
         return req
 
-    def drain(self, exc: Exception) -> List[Request]:
-        """Fail every queued request (engine shutdown)."""
+    def _drop_all(self, state: str, reason: str, exc_for) -> List[Request]:
+        """Pop every queued request and terminate it: advance to ``state``
+        and fail its future with ``exc_for(request)`` — the one copy of the
+        pop-and-fail exit both :meth:`drain` and :meth:`shed_all` share."""
         with self._lock:
             dropped = list(self._q)
             self._q.clear()
         for r in dropped:
-            self.counters.inc("failed")
+            if r.state not in rz.TERMINAL:  # double-drain race: already dropped
+                rz.advance(r, state, self.counters, reason=reason)
             if not r.future.done():
-                r.future.set_exception(exc)
+                r.future.set_exception(exc_for(r))
         return dropped
+
+    def drain(self, exc: Exception) -> List[Request]:
+        """Fail every queued request (engine shutdown/crash give-up)."""
+        return self._drop_all(rz.FAILED, "engine_shutdown", lambda r: exc)
+
+    def shed_all(self, retry_after_s: Optional[float] = None) -> List[Request]:
+        """Graceful drain: fail every queued-but-unstarted request fast with
+        the distinct ``SHED`` status (503 → a load balancer retries against
+        a peer) instead of making dead-on-arrival work wait out the drain."""
+        return self._drop_all(
+            rz.SHED, "draining",
+            lambda r: rz.RequestShed(
+                f"request {r.rid} shed: server draining "
+                "(queued, generation not started)"
+            ),
+        )
 
     @property
     def depth(self) -> int:

@@ -463,9 +463,13 @@ def test_http_queue_full_503_and_counter_split():
         engine.close()
 
 
-def test_dead_socket_reply_does_not_kill_handler():
-    """A client that disconnects before the reply: the handler swallows the
-    broken pipe (no traceback storm) and the server keeps serving."""
+def test_dead_socket_does_not_kill_handler():
+    """A client that disconnects mid-generation: no traceback storm, the
+    server keeps serving, and the request either completed before the
+    disconnect poll noticed (fast generation wins the race) or was
+    cancelled to free its slot — never a leaked slot or a wedged handler.
+    (tests/test_serving_resilience.py pins the deterministic cancellation
+    path with a slowed decode.)"""
     import socket
 
     svc, engine, port, params, tok = _start_engine_server(num_slots=2)
@@ -476,12 +480,14 @@ def test_dead_socket_reply_does_not_kill_handler():
                   + str(len(payload)).encode() + b"\r\n\r\n" + payload)
         s.close()  # gone before the engine finishes
         deadline = time.time() + 60
-        while time.time() < deadline and svc.counters.get("succeeded") < 1:
+        while time.time() < deadline and (
+            svc.counters.get("succeeded") + svc.counters.get("cancelled") < 1
+        ):
             time.sleep(0.01)
-        # generation completed server-side; the write failed silently
-        assert svc.counters.get("succeeded") == 1
+        assert svc.counters.get("succeeded") + svc.counters.get("cancelled") == 1
         body = _post(port, {"prompts": ["still here"], "tokens_to_generate": 2})
         assert body["text"] and _healthz(port)["status"] == "ok"
+        assert engine.slots.active_count == 0  # no slot leaked either way
     finally:
         svc.httpd.shutdown()
         engine.close()
