@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test test-all bench dryrun lint check-plan chaos serving-chaos data-smoke warmup clean
+.PHONY: all native test test-all bench dryrun lint check-plan chaos serving-chaos fleet-chaos data-smoke warmup clean
 
 all: native
 
@@ -51,6 +51,15 @@ serving-chaos:
 	$(PY) experiments/serving_chaos.py crash
 	$(PY) experiments/serving_chaos.py stall
 	$(PY) experiments/serving_chaos.py sigterm
+
+# fleet chaos harness (docs/DESIGN.md § Serving fleet): a real
+# `cli serve-fleet` router over 3 replica subprocesses — killing one
+# mid-decode loses zero requests (failover within deadline, warm restart),
+# and a rolling drain under load serves 100% of admitted requests with
+# every replica exiting 0 (CI job fleet-chaos runs the same matrix)
+fleet-chaos:
+	$(PY) experiments/serving_chaos.py fleet-kill
+	$(PY) experiments/serving_chaos.py fleet-rolling
 
 # data-pipeline smoke (docs/DESIGN.md § Data pipeline): tokenize two tiny
 # corpora → 0.7/0.3 mixture → pack → 4 traced train iters; asserts

@@ -21,7 +21,28 @@ Scenarios::
     sigterm  SIGTERM mid-load: in-flight requests complete, the process
              exits 0 inside --drain_timeout_s (zero-downtime shutdown).
 
-Usage: ``python experiments/serving_chaos.py crash|stall|sigterm [--out_dir D]``
+Fleet scenarios (``--fleet``, or the ``fleet-`` prefixed names) drive a
+real ``cli serve-fleet`` router over 3 replica subprocesses:
+
+    fleet-kill     kill one of three replicas mid-decode (the router-side
+                   ``kill_replica_at_dispatch`` chaos key): ZERO requests
+                   lost — in-flight work on the dead replica re-dispatches
+                   to a sibling and completes within its deadline with
+                   ``retried_from >= 1``, the replica restarts WARM
+                   (manifest hits from the shared compile-artifact store),
+                   and the final fleet drain audits exit 0 + zero leaked
+                   slots + a flight dump on every replica.
+    fleet-rolling  POST /drain?rolling=1 under sustained load: replicas
+                   drain one at a time while the rest keep serving — 100%
+                   of admitted requests served, every drained process
+                   exits 0, the fleet is back at full strength after the
+                   roll, then a full drain ends the run with exit 0 and
+                   the served/shed/expired/failed outcome partition
+                   summing to the request total.
+
+Usage: ``python experiments/serving_chaos.py
+crash|stall|sigterm|fleet-kill|fleet-rolling [--out_dir D]``
+(``<name> --fleet`` maps ``kill``/``rolling`` to the fleet scenarios.)
 """
 
 from __future__ import annotations
@@ -64,6 +85,20 @@ def start_server(out_dir: str, faults: str):
     if port is None:
         proc.kill()
         raise SystemExit("server never came up")
+    # the server listens BEFORE its warm start (readiness gating): wait for
+    # /readyz like a load balancer would, so the scenarios drive a warm
+    # engine instead of racing the startup probe
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz", timeout=10
+            ) as r:
+                if json.loads(r.read()).get("ready"):
+                    break
+        except Exception:  # noqa: BLE001 — 503 while starting
+            pass
+        time.sleep(0.1)
     return proc, port
 
 
@@ -190,19 +225,241 @@ def scenario_sigterm(out_dir):
           f"exit in {elapsed:.1f}s")
 
 
+# ---------------------------------------------------------------------------
+# fleet scenarios: a real `cli serve-fleet` router over 3 replicas
+# ---------------------------------------------------------------------------
+
+FLEET_SERVE_ARGS = [
+    "--num_slots", "2", "--prefill_chunk", "8",
+    "--num_layers", "1", "--hidden_size", "32", "--num_heads", "2",
+    "--ffn_dim", "64", "--seq_length", "64",
+    "--request_ttl_s", "120", "--drain_timeout_s", "30",
+]
+
+
+def start_fleet(out_dir, router_faults="", replicas=3,
+                replica_faults="slow_decode_ms=30"):
+    """Spawn `cli serve-fleet`; returns (proc, port, lines) where ``lines``
+    is the live stdout accumulator (a reader thread keeps the pipe drained
+    — the rolling-drain audit line arrives long after the listening line)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if router_faults:
+        env["GALVATRON_FAULTS"] = router_faults
+    else:
+        env.pop("GALVATRON_FAULTS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "galvatron_tpu.cli", "serve-fleet",
+         *FLEET_SERVE_ARGS, "--replicas", str(replicas),
+         "--fleet_dir", os.path.join(out_dir, "fleet"),
+         "--compile_cache_dir", os.path.join(out_dir, "cache"),
+         "--retry_budget", "2", "--replica_restart_backoff_s", "0.05",
+         "--replica_faults", replica_faults],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    lines = []
+    got_port = threading.Event()
+    port_holder = []
+
+    def pump():
+        for line in proc.stdout:
+            lines.append(line)
+            m = re.search(r"fleet router listening on http://[^:]+:(\d+)/api",
+                          line)
+            if m:
+                port_holder.append(int(m.group(1)))
+                got_port.set()
+        got_port.set()
+
+    threading.Thread(target=pump, daemon=True).start()
+    if not got_port.wait(timeout=120) or not port_holder:
+        proc.kill()
+        raise SystemExit("fleet router never came up:\n" + "".join(lines[-50:]))
+    return proc, port_holder[0], lines
+
+
+def wait_fleet_exit(proc, lines, timeout=120):
+    """(rc, full stdout) — the pump thread owns the pipe (``wait_exit``'s
+    blocking read would fight it), so the exit just joins the accumulator."""
+    rc = proc.wait(timeout=timeout)
+    time.sleep(0.3)  # let the pump drain the tail through EOF
+    return rc, "".join(lines)
+
+
+def wait_fleet_ready(port, replicas, timeout=300):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            h = healthz(port)
+            if h["fleet"]["ready_replicas"] >= replicas:
+                return h
+        except Exception:  # noqa: BLE001 — router still binding
+            pass
+        time.sleep(0.2)
+    raise SystemExit(f"fleet never reached {replicas} ready replicas")
+
+
+def check_fleet_drained(name, rc, out, out_dir, replicas=3):
+    assert rc == 0, f"{name}: expected exit 0, got {rc}\n{out[-3000:]}"
+    m = re.search(r"fleet drained: ok=True audit=(\{.*\})", out)
+    assert m, f"{name}: no clean fleet drain audit in output\n{out[-3000:]}"
+    audit = json.loads(m.group(1))
+    per = {a["idx"]: a for a in audit["replicas"] if "exit_code" in a}
+    for idx, a in per.items():
+        assert a["exit_code"] == 0, (name, idx, a)
+        assert a["clean_drain"] and a["flight_dump"], (name, idx, a)
+    print(f"{name}: fleet drained ok ({len(per)} replicas exit 0, zero "
+          f"leaked slots, flight dumps present)")
+    return audit
+
+
+def scenario_fleet_kill(out_dir):
+    """Kill one of three replicas mid-decode: zero requests lost, the
+    killed replica's in-flight work re-dispatches and completes within
+    deadline (retried_from >= 1), the replica restarts WARM from the
+    shared artifact store, and the fleet drains clean."""
+    proc, port, lines = start_fleet(
+        out_dir, router_faults="kill_replica_at_dispatch=2")
+    try:
+        wait_fleet_ready(port, 3)
+        results = []
+        threads = fire_clients(port, 6, 16, results)
+        for t in threads:
+            t.join(timeout=180)
+        ok = [r for r in results if r[0] == "ok"]
+        assert len(ok) == len(results), \
+            f"fleet-kill lost requests: {results}"
+        retried = [r for r in ok if r[1].get("retried_from", 0) >= 1]
+        assert retried, f"no request failed over (retried_from>=1): {results}"
+        # the killed replica restarts and the fleet recovers to 3 READY
+        h = wait_fleet_ready(port, 3, timeout=180)
+        assert h["requests"]["replica_restarts"] >= 1, h["requests"]
+        restarted = [r for r in h["replica"] if r["restarts"] >= 1]
+        assert restarted, h["replica"]
+        # warm restart: the respawned replica's serve log reports cache
+        # hits from the shared compile-artifact store
+        idx = restarted[0]["idx"]
+        log = open(os.path.join(out_dir, "fleet",
+                                f"replica-{idx}.log")).read()
+        warm_lines = re.findall(r"serving warm-start: .*\((\d+) cache hits",
+                                log)
+        assert len(warm_lines) >= 2, f"replica {idx} log:\n{log[-2000:]}"
+        assert int(warm_lines[-1]) >= 1, \
+            f"restart was not warm: {warm_lines} \n{log[-2000:]}"
+        drain(port)
+        rc, out = wait_fleet_exit(proc, lines, timeout=150)
+        audit = check_fleet_drained("fleet-kill", rc, out, out_dir)
+        assert audit["requests"]["served"] >= 6, audit["requests"]
+        print(f"  {len(retried)} failovers (retried_from>=1), "
+              f"replica {idx} restarted warm "
+              f"({warm_lines[-1]} cache hits)")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def scenario_fleet_rolling(out_dir):
+    """Rolling drain under sustained load: 100% of admitted requests
+    served, every replica exits 0, the fleet stays up through the roll,
+    and the outcome partition sums to the request total."""
+    proc, port, lines = start_fleet(out_dir,
+                                    replica_faults="slow_decode_ms=10")
+    try:
+        wait_fleet_ready(port, 3)
+        stop = threading.Event()
+        outcomes = {"ok": 0, "http": [], "err": []}
+        lock = threading.Lock()
+
+        def loadgen(i):
+            j = 0
+            while not stop.is_set():
+                try:
+                    post(port, {"prompts": [f"roll {i}-{j}"],
+                                "tokens_to_generate": 8, "ttl_s": 60.0},
+                         timeout=120)
+                    with lock:
+                        outcomes["ok"] += 1
+                except urllib.error.HTTPError as e:
+                    with lock:
+                        outcomes["http"].append(
+                            (e.code,
+                             json.loads(e.read() or b"{}").get("detail")))
+                except Exception as e:  # noqa: BLE001 — outcomes, not raises
+                    with lock:
+                        outcomes["err"].append(repr(e))
+                j += 1
+
+        threads = [threading.Thread(target=loadgen, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/drain?rolling=1", data=b"",
+            method="POST",
+        ), timeout=30)
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if any("fleet rolling drain: ok=" in l for l in lines):
+                break
+            time.sleep(0.2)
+        roll_line = next(
+            (l for l in lines if "fleet rolling drain: ok=" in l), None)
+        assert roll_line is not None, (
+            "rolling drain never completed:\n" + "".join(lines[-50:]))
+        assert "ok=True" in roll_line, roll_line
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        # 100% of admitted requests served: the deploy itself failed none
+        assert not outcomes["http"] and not outcomes["err"], outcomes
+        assert outcomes["ok"] > 0, outcomes
+        h = wait_fleet_ready(port, 3, timeout=120)  # back at full strength
+        served = h["requests"]["served"]
+        # outcome partition: every dispatch-side outcome sums to what the
+        # router admitted (client-side: all ok)
+        req = h["requests"]
+        total_outcomes = (req["served"] + req["expired"] + req["failed"]
+                          + req["client_error"]
+                          + req["rejected_saturated"]
+                          + req["rejected_unready"]
+                          + req["rejected_draining"])
+        assert req["served"] == outcomes["ok"], (req, outcomes)
+        assert total_outcomes == outcomes["ok"], (req, outcomes)
+        drain(port)
+        rc, out = wait_fleet_exit(proc, lines, timeout=150)
+        check_fleet_drained("fleet-rolling", rc, out, out_dir)
+        print(f"  {outcomes['ok']} requests served through the roll "
+              f"(0 failed), partition {total_outcomes}=={served} served")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
 SCENARIOS = {"crash": scenario_crash, "stall": scenario_stall,
-             "sigterm": scenario_sigterm}
+             "sigterm": scenario_sigterm,
+             "fleet-kill": scenario_fleet_kill,
+             "fleet-rolling": scenario_fleet_rolling}
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser("serving_chaos")
-    ap.add_argument("scenario", choices=sorted(SCENARIOS))
+    ap.add_argument("scenario",
+                    choices=sorted(SCENARIOS) + ["kill", "rolling"])
+    ap.add_argument("--fleet", action="store_true",
+                    help="map kill/rolling to the fleet- scenarios")
     ap.add_argument("--out_dir", default=None)
     ns = ap.parse_args(argv)
-    out_dir = ns.out_dir or f"/tmp/serving_chaos_{ns.scenario}"
+    scenario = ns.scenario
+    if ns.fleet and not scenario.startswith("fleet-"):
+        scenario = f"fleet-{scenario}"
+    if scenario not in SCENARIOS:
+        ap.error(f"unknown scenario {scenario!r}")
+    out_dir = ns.out_dir or f"/tmp/serving_chaos_{scenario}"
     shutil.rmtree(out_dir, ignore_errors=True)
     os.makedirs(out_dir, exist_ok=True)
-    SCENARIOS[ns.scenario](out_dir)
+    SCENARIOS[scenario](out_dir)
     return 0
 
 

@@ -33,6 +33,13 @@ models/<name>/{train_dist,search_dist,profiler}.py + profile_hardware):
                     continuous-batching engine by default (--num_slots,
                     --prefill_chunk, --request_ttl_s; --num_slots 0 = legacy
                     serialized path)
+  serve-fleet       resilient multi-replica router (serving/fleet.py):
+                    fronts N `serve` replica subprocesses with health-driven
+                    least-loaded dispatch, mid-flight failover inside the
+                    end-to-end deadline (--retry_budget), supervised replica
+                    restarts under the shared core/restart_policy.py table,
+                    and rolling drain (POST /drain?rolling=1) for
+                    zero-downtime deploys
   export-hf         trainer checkpoint → HuggingFace-format checkpoint
 
 The per-model modules (galvatron_tpu.models.<family>) re-export these with
@@ -323,6 +330,15 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         ns = initialize_galvatron("trace_export", rest, model_default)
         return _trace_export_mode(ns)
 
+    if mode == "serve-fleet":
+        # the multi-replica router (serving/fleet.py): parses the serve
+        # flags plus the fleet group, forwards everything non-fleet
+        # verbatim to N replica `cli serve` subprocesses
+        from galvatron_tpu.serving.fleet import serve_fleet_main
+
+        ns = initialize_galvatron("serve_fleet", rest, model_default)
+        return serve_fleet_main(ns, rest)
+
     if mode in ("generate", "serve"):
         import jax
 
@@ -406,11 +422,53 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
                 drain_timeout_s=ns.drain_timeout_s,
                 flight_dir=ns.flight_dir,
             )
-        if engine is not None and getattr(ns, "compile_cache_dir", None):
-            # warm-start the engine's two pinned programs BEFORE accepting
-            # traffic: a restarted server's first request pays a persistent-
-            # cache deserialize instead of two XLA compiles. Resolved like
-            # the trainer flag: '0'/'off'/'none' disables.
+        service = GenerationService(params, cfg, tok, ns.max_new_tokens,
+                                    ns.seed, engine=engine)
+        import threading as _threading
+
+        listening = _threading.Event()
+        if engine is not None:
+            # startup readiness gating: the server LISTENS first (so a
+            # router/load-balancer can poll /readyz and get an honest 503
+            # "starting"), then the engine warms on a side thread — the
+            # persistent-cache warm start plus one real generation through
+            # the scheduler, so the jitted programs genuinely exist — and
+            # only then does /readyz flip to 200. Direct /api clients are
+            # still accepted while starting; they simply share the compile,
+            # exactly the old lazy-first-request behavior.
+            service.starting = True
+            _threading.Thread(
+                target=_serve_warmup, args=(ns, engine, service, listening),
+                name="serve-warmup", daemon=True,
+            ).start()
+        run_server(
+            service,
+            port=ns.port, host=ns.host, max_pending=ns.max_pending,
+            drain_timeout_s=ns.drain_timeout_s, ready_event=listening,
+        )
+        # a drained SIGTERM/POST-/drain shutdown exits 0: zero-downtime
+        # rollouts treat this process as cleanly replaceable
+        return 0
+
+    print(
+        f"unknown mode {mode!r}; expected "
+        "train|run-elastic|search|profile|profile-hardware|check-plan|warmup|"
+        "trace-export|generate|serve|serve-fleet|export-hf"
+    )
+    return 2
+
+
+def _serve_warmup(ns, engine, service, listening) -> None:
+    """`cli serve` startup warm (side thread): persistent-cache warm start
+    of the two pinned programs (when a cache is wired), then ONE real
+    generation through the scheduler so the jitted entry points exist —
+    only then does ``service.starting`` clear and ``/readyz`` report ready.
+    Warmth is best-effort: any failure degrades to the lazy-compile path
+    (the first request pays it) but never blocks readiness forever."""
+    listening.wait(timeout=60.0)
+    try:
+        if getattr(ns, "compile_cache_dir", None):
+            # resolved like the trainer flag: '0'/'off'/'none' disables
             from galvatron_tpu.aot import warmup as aot_warmup
             from galvatron_tpu.aot.cache import (
                 ArtifactStore,
@@ -426,24 +484,19 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
                 print(
                     f"serving warm-start: {s['compiled']}/{s['programs']} "
                     f"programs ({s['hits']} cache hits, "
-                    f"{s['total_compile_ms']:.0f} ms)"
+                    f"{s['total_compile_ms']:.0f} ms)", flush=True,
                 )
-        run_server(
-            GenerationService(params, cfg, tok, ns.max_new_tokens, ns.seed,
-                              engine=engine),
-            port=ns.port, host=ns.host, max_pending=ns.max_pending,
-            drain_timeout_s=ns.drain_timeout_s,
-        )
-        # a drained SIGTERM/POST-/drain shutdown exits 0: zero-downtime
-        # rollouts treat this process as cleanly replaceable
-        return 0
-
-    print(
-        f"unknown mode {mode!r}; expected "
-        "train|run-elastic|search|profile|profile-hardware|check-plan|warmup|"
-        "trace-export|generate|serve|export-hf"
-    )
-    return 2
+        # the first scheduler iteration: an AOT lower/compile populates the
+        # persistent cache but not the jit call cache — one real request
+        # proves the engine serves before /readyz says so
+        engine.generate([[1]], max_new_tokens=2)
+    except Exception as e:  # noqa: BLE001 — warmth is optional, serving is not
+        print(f"serving warm-start failed (first request compiles lazily): "
+              f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+    finally:
+        service.starting = False
+        print("serving ready: warm start complete, /readyz now 200",
+              flush=True)
 
 
 def _warmup_mode(ns) -> int:

@@ -386,6 +386,55 @@ def _add_generate_args(p: argparse.ArgumentParser):
                    help="export-hf: directory for the HF-format checkpoint")
 
 
+def _add_fleet_args(p: argparse.ArgumentParser):
+    """serve-fleet: the multi-replica router (serving/fleet.py). Every
+    non-fleet flag forwards verbatim to the replica `cli serve` processes."""
+    g = p.add_argument_group("serve-fleet")
+    g.add_argument("--replicas", type=int, default=2,
+                   help="engine replica subprocesses the router fronts")
+    g.add_argument("--replica_ports", type=str, default="",
+                   help="comma list of fixed replica ports (one per "
+                   "--replicas); empty = ephemeral ports parsed from each "
+                   "replica's listening line")
+    g.add_argument("--retry_budget", type=int, default=2,
+                   help="max re-dispatches per request after a replica dies "
+                   "or refuses mid-flight (bounds the poison-request "
+                   "cascade); each retry carries the REMAINING end-to-end "
+                   "deadline and counts into the response's retried_from")
+    g.add_argument("--fleet_max_pending", type=int, default=0,
+                   help="fleet-wide shared admission bound (one coherent "
+                   "503 fleet_saturated + Retry-After); 0 = replicas x "
+                   "num_slots x 4")
+    g.add_argument("--max_replica_restarts", type=int, default=3,
+                   help="consecutive no-progress restarts per replica "
+                   "before it is given up (fleet degrades to the remaining "
+                   "capacity); completions in the dead incarnation beyond "
+                   "its startup warm probe reset the budget — the shared "
+                   "core/restart_policy.py table")
+    g.add_argument("--replica_restart_backoff_s", type=float, default=0.5,
+                   help="full-jitter backoff base for replica respawns")
+    g.add_argument("--probe_interval_s", type=float, default=0.25,
+                   help="per-replica /healthz probe cadence driving the "
+                   "STARTING/READY/DRAINING/DEAD state machine")
+    g.add_argument("--session_affinity", type=int, default=0,
+                   help="1 = pin requests carrying a 'session' body key to "
+                   "a stable replica (hash), falling back to least-loaded "
+                   "when that replica is out")
+    g.add_argument("--rolling_drain", type=int, default=1,
+                   help="fleet SHUTDOWN style (SIGTERM / plain POST "
+                   "/drain): 1 drains replicas one at a time so siblings "
+                   "absorb shed work until the last; 0 drains all at once. "
+                   "POST /drain?rolling=1 is the zero-downtime DEPLOY roll "
+                   "(drain + respawn each replica, fleet keeps serving)")
+    g.add_argument("--fleet_dir", type=str, default=None,
+                   help="router working dir: per-replica logs + flight "
+                   "dump dirs (the post-drain audit reads both)")
+    g.add_argument("--replica_faults", type=str, default="",
+                   help="GALVATRON_FAULTS spec installed in every REPLICA "
+                   "(e.g. slow_decode_ms=25); the router's own "
+                   "GALVATRON_FAULTS never leaks into replicas")
+
+
 def _add_check_plan_args(p: argparse.ArgumentParser):
     """Static plan validation (analysis/plan_check.py; no device, no compile)."""
     g = p.add_argument_group("check-plan")
@@ -502,6 +551,9 @@ def build_parser(mode: str, model_default: Optional[str] = None) -> argparse.Arg
         _add_trace_export_args(p)
     elif mode in ("generate", "serve", "export_hf"):
         _add_generate_args(p)
+    elif mode == "serve_fleet":
+        _add_generate_args(p)
+        _add_fleet_args(p)
     else:
         raise ValueError(f"unknown mode {mode}")
     return p

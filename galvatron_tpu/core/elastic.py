@@ -360,15 +360,22 @@ def _read_fingerprint(save_dir: Optional[str]) -> Dict[str, Any]:
     return fp if isinstance(fp, dict) else {}
 
 
-def _child_env(base_env: Dict[str, str], attempt: int, worlds: List[int]) -> Dict[str, str]:
+def child_pythonpath_env(base_env: Dict[str, str]) -> Dict[str, str]:
+    """Child-process env with the repo root on PYTHONPATH regardless of the
+    child's cwd. Join only a NON-EMPTY inherited value: "<root>:" would put
+    an empty entry — i.e. the child's cwd — on sys.path, letting a stray
+    json.py in the operator's launch dir shadow the stdlib only inside
+    children. Shared by this supervisor and the fleet router's replica
+    spawns (serving/fleet.py) — one copy of the rule."""
     env = dict(base_env)
-    # repo root on the child's path regardless of its cwd. Join only a
-    # NON-EMPTY inherited value: "<root>:" would put an empty entry — i.e.
-    # the child's cwd — on sys.path, letting a stray json.py in the
-    # operator's launch dir shadow the stdlib only inside children.
     root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     prior = env.get("PYTHONPATH", "")
     env["PYTHONPATH"] = root + os.pathsep + prior if prior else root
+    return env
+
+
+def _child_env(base_env: Dict[str, str], attempt: int, worlds: List[int]) -> Dict[str, str]:
+    env = child_pythonpath_env(base_env)
     if worlds:
         env[SIM_WORLD_ENV] = str(worlds[min(attempt, len(worlds) - 1)])
     if attempt > 0:
@@ -392,7 +399,7 @@ def run_elastic(
     ``(cmd, env) -> returncode``."""
     from galvatron_tpu.core import faults
     from galvatron_tpu.core.arguments import initialize_galvatron
-    from galvatron_tpu.core.retry import RetryPolicy
+    from galvatron_tpu.core.restart_policy import RestartPolicy
     from galvatron_tpu.obs.tracing import tracer
     from galvatron_tpu.utils.metrics import MetricsLogger
 
@@ -422,10 +429,14 @@ def run_elastic(
         run_elastic.last_obs_port = obs_server.port  # tests scrape the ephemeral port
         print(f"elastic supervisor sidecar: http://127.0.0.1:{obs_server.port}/healthz")
     worlds = faults.world_schedule()
-    policy = RetryPolicy(
-        attempts=max(1, ns.max_restarts + 1),
-        base_delay_s=ns.restart_backoff_s,
-        max_delay_s=ns.restart_backoff_cap_s,
+    # the shared supervisor decision table (core/restart_policy.py):
+    # consecutive-no-progress budget, progress-resets-streak, full-jitter
+    # backoff — identical arithmetic to the serving EngineSupervisor and
+    # the fleet router's replica supervision
+    policy = RestartPolicy(
+        max_restarts=ns.max_restarts,
+        backoff_s=ns.restart_backoff_s,
+        backoff_cap_s=ns.restart_backoff_cap_s,
     )
     if spawn is None:
         spawn = lambda c, env: subprocess.call(c, env=env)  # noqa: E731
@@ -448,7 +459,6 @@ def run_elastic(
         tracer.instant(f"elastic_{event}", **fields)
 
     attempt = 0  # children launched so far
-    consecutive = 0  # restarts since the last committed progress
     rc_final = 1
     note("supervisor_start", max_restarts=ns.max_restarts,
          step_timeout_s=float(getattr(ns, "step_timeout_s", 0) or 0),
@@ -504,24 +514,25 @@ def run_elastic(
                       file=sys.stderr, flush=True)
                 note("give_up", reason="replan_infeasible", attempts=attempt)
                 break
-            consecutive = 1 if progressed else consecutive + 1
-            if consecutive > ns.max_restarts:
-                print(f"run-elastic: giving up — {consecutive} consecutive "
-                      f"restarts without progress (--max_restarts "
-                      f"{ns.max_restarts})", file=sys.stderr, flush=True)
+            # preempted children checkpointed and exited on a signal: restart
+            # immediately — a preemption is the *expected* lifecycle, and
+            # backoff here only donates pod-hours to the void (the failure
+            # still counts against the no-progress budget)
+            decision = policy.on_failure(
+                progressed, immediate=(mode == "preempted")
+            )
+            if decision.give_up:
+                print(f"run-elastic: giving up — {decision.consecutive} "
+                      f"consecutive restarts without progress "
+                      f"(--max_restarts {ns.max_restarts})",
+                      file=sys.stderr, flush=True)
                 note("give_up", reason="restart_budget", attempts=attempt,
-                     consecutive=consecutive)
+                     consecutive=decision.consecutive)
                 break
-            if mode == "preempted":
-                # the child checkpointed and exited on a signal: restart
-                # immediately — a preemption is the *expected* lifecycle,
-                # and backoff here only donates pod-hours to the void
-                delay = 0.0
-            else:
-                delay = policy.delay(min(consecutive - 1, policy.attempts - 1))
+            delay = decision.backoff_s
             stats.restarts_total += 1
             note("restart", attempt=attempt, mode=mode,
-                 consecutive=consecutive, backoff_s=round(delay, 3))
+                 consecutive=decision.consecutive, backoff_s=round(delay, 3))
             print(f"run-elastic: child exit {rc} ({mode}); restart "
                   f"{stats.restarts_total} in {delay:.2f}s")
             if delay:

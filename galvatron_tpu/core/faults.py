@@ -47,6 +47,10 @@ cancellation machinery a real fault would):
 - ``client_stall=N``         — the server's disconnect poll treats the next
   N connections as vanished clients (the dead-client slot-leak scenario:
   cancellation must free the slot mid-decode).
+- ``kill_replica_at_dispatch=N`` — consumed by the fleet router
+  (`serving/fleet.py`): the replica chosen for dispatch N is SIGKILLed
+  shortly after the request is forwarded (once) — the
+  replica-dies-mid-flight scenario the failover path must absorb.
 
 The hooks are called from the real code paths (checkpoint save/commit, the
 retry wrapper, the trainer's loss observation and step loop), so an
@@ -178,6 +182,20 @@ def prefill_chunk(idx: int) -> None:
     if k is not None and idx == int(k):
         del _active["prefill_fail_at"]
         raise FaultInjected(f"injected prefill failure at chunk {idx}")
+
+
+def kill_replica(dispatch_idx: int) -> bool:
+    """Armed ``kill_replica_at_dispatch=N``: the fleet router SIGKILLs the
+    replica serving dispatch N shortly after forwarding the request — once,
+    so the supervised respawn proves recovery, not a kill loop. The router
+    is the consumer (the replica process cannot kill itself mid-accept
+    without also racing its own HTTP reply)."""
+    k = _active.get("kill_replica_at_dispatch")
+    if k is not None and dispatch_idx == int(k):
+        # pop, not del: concurrent dispatch threads may race the match, and
+        # only ONE caller gets the kill (the other sees the key gone)
+        return _active.pop("kill_replica_at_dispatch", None) is not None
+    return False
 
 
 def maybe_client_stall() -> bool:

@@ -1,0 +1,89 @@
+"""Shared restart/backoff/give-up decision policy for every supervisor.
+
+Three supervisors make the same decision after a failure — the elastic
+training supervisor (`core/elastic.py`, child process exits), the in-process
+serving engine supervisor (`serving/resilience.py`, decode-loop crashes),
+and the fleet router's per-replica supervision (`serving/fleet.py`, replica
+process deaths). The decision table is identical in all three::
+
+    ==================================    =====================================
+    condition                             decision
+    ==================================    =====================================
+    failure, progress since last one      restart (budget resets — progress)
+    failure, no progress, budget left     restart after full-jitter backoff
+    failure, no progress, budget spent    give up
+    ==================================    =====================================
+
+"Progress" is supervisor-defined (a newer committed checkpoint step, a
+completed request, a completed dispatch); what is shared is the *budget
+arithmetic*: the give-up bound counts CONSECUTIVE failures without
+progress — a progressed failure resets the streak to 1, never to 0 (the
+failure itself still counts), so ``max_restarts`` no-progress failures in
+a row exhaust the budget regardless of how long the run has been healthy.
+Backoff rides `core/retry.py`'s full-jitter schedule (uniform in
+``[0, base·2^n]`` capped), indexed by the no-progress streak; callers with
+a reason to skip the wait (elastic's preempted-save children checkpointed
+and *expect* to be rerun) pass ``immediate=True`` — the failure still
+counts against the budget, only the sleep is skipped.
+
+This module is pure decision arithmetic: no sleeping, no process control,
+no engine surgery — callers act on the returned :class:`RestartDecision`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from galvatron_tpu.core.retry import RetryPolicy
+
+
+@dataclasses.dataclass(frozen=True)
+class RestartDecision:
+    """One supervisor decision: restart (after ``backoff_s``) or give up."""
+
+    give_up: bool
+    consecutive: int  # no-progress failure streak INCLUDING this failure
+    backoff_s: float  # sleep before the restart (0.0 on give-up/immediate)
+
+    @property
+    def restart(self) -> bool:
+        return not self.give_up
+
+
+class RestartPolicy:
+    """The shared decision table, stateful over one supervised lifetime.
+
+    ``max_restarts`` bounds consecutive no-progress restarts: the
+    ``max_restarts + 1``-th no-progress failure in a row is a give-up.
+    ``max_restarts=0`` gives up on the first failure regardless of progress
+    (the streak resets to 1, never 0 — a zero budget supervises nothing).
+    """
+
+    def __init__(self, max_restarts: int = 3, backoff_s: float = 1.0,
+                 backoff_cap_s: float = 60.0, jitter: str = "full"):
+        self.max_restarts = max(0, int(max_restarts))
+        self.retry = RetryPolicy(
+            attempts=self.max_restarts + 1,
+            base_delay_s=float(backoff_s),
+            max_delay_s=float(backoff_cap_s),
+            jitter=jitter,
+        )
+        self.consecutive = 0  # failures since the last progressed failure
+
+    def on_failure(self, progressed: bool,
+                   immediate: bool = False) -> RestartDecision:
+        """Record one failure and decide. ``progressed`` = supervisor-level
+        progress happened since the previous failure (resets the streak to
+        1); ``immediate`` skips the backoff sleep but still counts the
+        failure against the budget."""
+        self.consecutive = 1 if progressed else self.consecutive + 1
+        if self.consecutive > self.max_restarts:
+            return RestartDecision(True, self.consecutive, 0.0)
+        delay = 0.0 if immediate else self.retry.delay(
+            min(self.consecutive - 1, self.retry.attempts - 1)
+        )
+        return RestartDecision(False, self.consecutive, delay)
+
+    def reset(self) -> None:
+        """Forget the streak (supervised entity replaced wholesale)."""
+        self.consecutive = 0

@@ -148,6 +148,51 @@ def server_metrics_text(service) -> str:
     return out.render()
 
 
+def fleet_metrics_text(router) -> str:
+    """Exposition for ``serving.fleet.FleetRouter``: fleet-level request
+    counters, the shared admission gate, and one state/restart row per
+    replica — the scrape a load balancer's operator actually needs (is the
+    fleet degraded? is one replica crash-looping?)."""
+    out = PromText()
+    out.add("fleet_uptime_seconds", time.time() - router.started_at)
+    out.add("fleet_replicas", len(router.replicas),
+            help_="configured replica count")
+    out.add("fleet_ready_replicas", router.ready_count(),
+            help_="replicas currently dispatchable (READY + reachable)")
+    out.add("fleet_ready", 1 if router.ready else 0)
+    out.add("fleet_draining", 1 if router.draining else 0)
+    for name, v in router.counters.snapshot().items():
+        if name == "replica_restarts":
+            # exposed ONLY as the per-replica labeled family below — a
+            # second unlabeled sample under the same name would split the
+            # family and double-count on sum()
+            continue
+        out.add(f"fleet_{name}_total", v, mtype="counter",
+                help_="fleet router request accounting" if name == "dispatched"
+                else "")
+    g = router.gate.snapshot()
+    out.add("fleet_gate_in_use", g["in_use"])
+    out.add("fleet_gate_capacity", g["capacity"])
+    for r in router.replicas:
+        labels = {"idx": r.idx}
+        out.add("fleet_replica_state_info", 1,
+                labels={**labels, "state": r.state},
+                help_="per-replica lifecycle state (in labels)")
+        out.add("fleet_replica_restarts_total", r.restarts_total,
+                labels=labels, mtype="counter")
+        out.add("fleet_replica_outstanding", r.outstanding, labels=labels,
+                help_="router-side in-flight dispatches on this replica")
+        s = (r.last_health.get("serving") or {})
+        out.add("fleet_replica_queue_depth", s.get("queue_depth"),
+                labels=labels)
+        out.add("fleet_replica_active_slots", s.get("active_slots"),
+                labels=labels)
+        out.add("fleet_replica_completed_total", s.get("completed"),
+                labels=labels, mtype="counter",
+                help_="completions of the replica's CURRENT incarnation")
+    return out.render()
+
+
 class TrainStats:
     """Mutable per-run gauge set the trainer updates each iteration and the
     sidecar renders on scrape. Plain attribute writes under the GIL — the

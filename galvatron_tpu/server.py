@@ -143,14 +143,21 @@ class GenerationService:
         self.drain_timeout_s = 30.0
         self._drain_lock = threading.Lock()
         self._drained = threading.Event()
+        # startup readiness gate (cli serve sets it, then clears it once the
+        # engine's warm start AND a first real generation have completed):
+        # a router/load-balancer watching /readyz must never dispatch into a
+        # replica still paying cold compile. /api stays open while starting
+        # — a direct client just shares the compile, exactly the lazy path.
+        self.starting = False
 
     @property
     def ready(self) -> bool:
-        """What ``/readyz`` keys on: accepting NEW work. Unready the moment
-        a drain begins (in-flight work may still be finishing — that is the
-        point: the load balancer stops routing before the last token lands)
-        and when the engine is dead (crash-restart budget exhausted)."""
-        if self.draining:
+        """What ``/readyz`` keys on: accepting NEW work. Unready while the
+        engine is still warming (``starting``), the moment a drain begins
+        (in-flight work may still be finishing — that is the point: the
+        load balancer stops routing before the last token lands), and when
+        the engine is dead (crash-restart budget exhausted)."""
+        if self.starting or self.draining:
             return False
         if self.engine is not None and not self.engine.alive:
             return False
@@ -198,7 +205,8 @@ class GenerationService:
         c = self.cfg
         req = self.counters.snapshot()
         out = {
-            "status": "draining" if self.draining else "ok",
+            "status": ("draining" if self.draining
+                       else "starting" if self.starting else "ok"),
             "ready": self.ready,
             "uptime_s": round(time.time() - self.started_at, 3),
             "requests_served": req["succeeded"],
@@ -316,7 +324,10 @@ class GenerationService:
             raise ServiceBusy(str(e), detail="draining",
                               retry_after_s=e.retry_after_s) from e
         except EngineRestarted as e:
-            raise ServiceBusy(str(e), detail="engine_restarted") from e
+            # Retry-After like draining 503s: the supervisor's own backoff
+            # delay says when the recovered engine will be looping again
+            raise ServiceBusy(str(e), detail="engine_restarted",
+                              retry_after_s=e.retry_after_s) from e
         except EngineClosed as e:
             raise ServiceBusy(str(e), detail="engine_closed") from e
         except FuturesTimeout as e:
@@ -560,7 +571,9 @@ def _make_handler(service: GenerationService, request_timeout_s: float):
                     return self._reply(200, {"ready": True})
                 return self._reply(503, {
                     "ready": False,
-                    "status": "draining" if service.draining else "engine_dead",
+                    "status": ("draining" if service.draining
+                               else "starting" if service.starting
+                               else "engine_dead"),
                 })
             if route == "/metrics":
                 from galvatron_tpu.obs.prom import CONTENT_TYPE, server_metrics_text

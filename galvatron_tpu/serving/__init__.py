@@ -18,6 +18,12 @@ Four layers, one per module:
   per iteration, chunked prefill on admission, host-side per-request
   sampling, retire-on-eos/budget/deadline/cancel, graceful ``drain`` with
   a post-drain zero-leak ``audit``.
+- [[fleet]] ``FleetRouter`` — the horizontal layer (``cli serve-fleet``):
+  N engine replicas behind one router with health-driven dispatch
+  (STARTING → READY → DRAINING → DEAD), mid-flight failover inside the
+  end-to-end deadline, supervised replica restarts, and rolling drain.
+  Imported lazily (it spawns subprocesses; most serving users never
+  need it): ``from galvatron_tpu.serving.fleet import FleetRouter``.
 
 ``server.GenerationService`` submits into the engine via futures; the
 legacy serialized ``generate_np`` path remains available when the engine is

@@ -292,6 +292,8 @@ class Engine:
             "tokens_per_s_last_step": round(self._last_step_tps, 3),
             "ttft_p50_s": ttft["p50"],
             "ttft_p95_s": ttft["p95"],
+            # the fleet bench reads the served tail per replica over HTTP
+            "ttft_p99_s": self.ttft.quantile(0.99),
             "submitted": sc["submitted"],
             "admitted": sc["admitted"],
             "completed": sc["completed"],
@@ -725,15 +727,18 @@ class Engine:
         self.slots.reset()
         self.scheduler.drain(exc)
 
-    def _crash_cleanup(self, exc: BaseException) -> None:
+    def _crash_cleanup(self, exc: BaseException,
+                       retry_after_s: Optional[float] = None) -> None:
         """Crash recovery, step 1 (called by the supervisor): fail the
         in-flight requests fast — continuous batching cannot replay
         mid-decode KV state, and the failed dispatch may have invalidated
         the donated cache buffers — and keep only the queued requests that
-        still have TTL budget."""
+        still have TTL budget. ``retry_after_s`` (the supervisor's backoff)
+        rides the failure so the 503 can carry an honest Retry-After."""
         wrapped = rz.EngineRestarted(
             f"engine restarted mid-request ({type(exc).__name__}: {exc}); "
-            "please resubmit"
+            "please resubmit",
+            retry_after_s=retry_after_s,
         )
         for slot in list(self._by_slot):
             req = self._release_slot(slot)

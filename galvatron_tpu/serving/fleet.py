@@ -1,0 +1,1086 @@
+"""Serving fleet: a resilient multi-replica router (`cli serve-fleet`).
+
+One engine process is one blast radius: a single crash or deploy takes 100%
+of capacity with it. This module fronts N engine replicas — each a real
+``cli serve`` subprocess on its own port, warm-started from a shared
+``--compile_cache_dir`` — behind one router that owns four concerns:
+
+**Health-driven dispatch.** Every replica moves through a small in-router
+state machine, probed via its own ``/healthz``/``/readyz``::
+
+    STARTING → READY → DRAINING → DEAD
+                                    ↓ (supervised respawn)
+                                 STARTING
+
+``/readyz`` stays 503 (status ``starting``) until the replica's engine has
+warm-started AND served a first real generation (`server.py` readiness
+gating), so the router never dispatches into a replica still paying cold
+compile. Dispatch picks the least-loaded READY replica by live occupancy —
+router-side outstanding requests plus the replica's last-probed queue
+depth — with optional session affinity (a stable hash of the request's
+``session`` key, falling back to least-loaded when the pinned replica is
+out). Fleet-wide admission is one shared bounded gate: saturation returns
+ONE coherent 503 (``detail: fleet_saturated``) with a ``Retry-After``
+header instead of N replicas' inconsistent ``queue_full``s.
+
+**Failover.** The router records every request at admission (exact body +
+absolute deadline), so a request whose replica dies mid-flight — process
+kill, connection reset, or a well-formed 503 ``engine_restarted`` from the
+replica's own crash supervision — is re-dispatched to a sibling with the
+*remaining* end-to-end deadline (``ttl_s`` is rewritten per attempt) and
+``retried_from`` counted into the response. The per-request retry budget
+(``--retry_budget``) bounds the cascade: a poison request that kills every
+replica it touches fails after the budget instead of felling the fleet.
+
+**Replica supervision.** A crashed replica restarts under the same
+consecutive-no-progress / full-jitter decision table as ``run-elastic``
+and the in-process ``EngineSupervisor`` (`core/restart_policy.py` — one
+shared policy module): progress = completions in the dead incarnation;
+give-up marks the replica permanently DEAD and the fleet *degrades* to the
+remaining capacity rather than dying. Respawned replicas warm from the
+shared compile-artifact store, so recovery costs manifest hits.
+
+**Rolling drain.** ``POST /drain?rolling=1`` drains replicas one at a
+time through the per-replica drain (PR 10's zero-downtime sequence) while
+the rest keep serving — each drained process exits 0 and is respawned
+(waiting for READY) before the next begins, which is the zero-downtime
+deploy: during the whole roll the fleet keeps admitting and every admitted
+request is served (work shed by the draining replica re-dispatches to a
+sibling). Plain ``POST /drain`` (and SIGTERM) is the fleet *shutdown*:
+router admission closes, replicas drain sequentially (so siblings absorb
+shed work until the last one), and a fleet-level post-drain audit checks
+every replica exited 0, reported ``leaked=False``, and left a flight dump.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Sequence, Set
+
+from galvatron_tpu.core import faults
+from galvatron_tpu.core.restart_policy import RestartPolicy
+from galvatron_tpu.obs.tracing import tracer
+from galvatron_tpu.utils.metrics import Counters
+
+# --- replica lifecycle states ------------------------------------------------
+
+STARTING = "STARTING"
+READY = "READY"
+DRAINING = "DRAINING"
+DEAD = "DEAD"
+
+#: every replica state, in flow order (DESIGN.md § Serving fleet renders
+#: this exact list — a doc-sync test keeps them matched)
+REPLICA_STATES = (STARTING, READY, DRAINING, DEAD)
+
+#: legal edges. STARTING can die (crash before ever ready) or be told to
+#: drain (a rolling drain reaching a mid-restart replica); DEAD → STARTING
+#: is the supervised respawn.
+REPLICA_TRANSITIONS = {
+    STARTING: frozenset((READY, DRAINING, DEAD)),
+    READY: frozenset((DRAINING, DEAD)),
+    DRAINING: frozenset((DEAD,)),
+    DEAD: frozenset((STARTING,)),
+}
+
+
+class IllegalReplicaTransition(RuntimeError):
+    """A replica-state edge outside :data:`REPLICA_TRANSITIONS` — a router
+    bookkeeping bug, never a replica's fault."""
+
+
+_LISTEN_RE = re.compile(r"listening on http://[^:]+:(\d+)/api")
+
+#: fleet-only CLI flags (each takes one value) stripped from the raw serve
+#: argv before it is forwarded to replicas — everything else (model shape,
+#: engine knobs, --compile_cache_dir) forwards verbatim, so a replica is
+#: exactly the `cli serve` the same command line would have started.
+FLEET_ONLY_FLAGS = frozenset((
+    "--replicas", "--replica_ports", "--retry_budget", "--fleet_max_pending",
+    "--max_replica_restarts", "--replica_restart_backoff_s",
+    "--probe_interval_s", "--session_affinity", "--rolling_drain",
+    "--fleet_dir", "--replica_faults",
+))
+
+#: router-owned flags also stripped (the router binds --port/--host itself;
+#: --flight_dir is re-pointed per replica so dumps do not collide)
+_ROUTER_OWNED_FLAGS = frozenset(("--port", "--host", "--flight_dir"))
+
+
+def replica_argv(serve_argv: Sequence[str], port: int,
+                 flight_dir: str) -> List[str]:
+    """The raw ``serve-fleet`` argv minus fleet/router-owned flags, plus
+    this replica's own ``--port``/``--flight_dir``. Handles both
+    ``--flag value`` and ``--flag=value`` spellings."""
+    strip = FLEET_ONLY_FLAGS | _ROUTER_OWNED_FLAGS
+    out: List[str] = []
+    i = 0
+    argv = list(serve_argv)
+    while i < len(argv):
+        tok = argv[i]
+        flag = tok.split("=", 1)[0]
+        if flag in strip:
+            i += 1 if "=" in tok else 2
+            continue
+        out.append(tok)
+        i += 1
+    out += ["--port", str(port), "--host", "127.0.0.1",
+            "--flight_dir", flight_dir]
+    return out
+
+
+class Replica:
+    """One supervised engine subprocess, as the router sees it."""
+
+    def __init__(self, idx: int, serve_argv: Sequence[str], *,
+                 fleet_dir: str, port: int = 0,
+                 env: Optional[Dict[str, str]] = None,
+                 restart_policy: Optional[RestartPolicy] = None):
+        self.idx = idx
+        self.serve_argv = list(serve_argv)
+        self.fixed_port = int(port)  # 0 = ephemeral, parsed from stdout
+        self.port: Optional[int] = None
+        self.flight_dir = os.path.join(fleet_dir, f"replica-{idx}", "flight")
+        self.log_path = os.path.join(fleet_dir, f"replica-{idx}.log")
+        self.env = env
+        self.proc: Optional[subprocess.Popen] = None
+        self.state = DEAD  # spawn() advances DEAD → STARTING
+        self.reachable = False
+        self.last_health: Dict[str, Any] = {}
+        self.outstanding = 0  # router-side in-flight dispatches
+        self._lock = threading.Lock()
+        self.policy = restart_policy or RestartPolicy()
+        self.restarts_total = 0
+        self.gave_up = False
+        self.last_exit_code: Optional[int] = None
+        self._state_lock = threading.Lock()
+        self._spawn_lock = threading.Lock()
+
+    # -- state machine ------------------------------------------------------
+
+    def advance(self, state: str, **info) -> None:
+        """Validated state transition. Same-state advances are no-ops: the
+        monitor and a drain can both observe the same exit — DEAD twice is
+        one fact seen from two threads, not a bookkeeping bug."""
+        with self._state_lock:
+            if state == self.state:
+                return
+            if state not in REPLICA_TRANSITIONS.get(self.state, frozenset()):
+                raise IllegalReplicaTransition(
+                    f"replica {self.idx}: illegal transition "
+                    f"{self.state} → {state}"
+                )
+            self.state = state
+        tracer.instant(f"replica_{state.lower()}", idx=self.idx,
+                       port=self.port, **info)
+
+    def try_advance(self, state: str, only_from, **info) -> bool:
+        """Atomic conditional transition: advance to ``state`` only if the
+        current state is in ``only_from``, under the state lock. The probe
+        and drain threads both move replicas concurrently with the exit
+        observer — a check-then-advance outside the lock would raise
+        :class:`IllegalReplicaTransition` on perfectly legal races (a
+        replica dying between the check and the advance)."""
+        with self._state_lock:
+            if self.state not in only_from:
+                return False
+            self.state = state
+        tracer.instant(f"replica_{state.lower()}", idx=self.idx,
+                       port=self.port, **info)
+        return True
+
+    # -- process control ----------------------------------------------------
+
+    def spawn(self) -> bool:
+        """Launch (or relaunch) the ``cli serve`` subprocess; returns False
+        when another thread already respawned this replica (the monitor's
+        crash respawn and a rolling drain's deploy respawn can race — the
+        spawn lock makes exactly ONE incarnation win, never an orphaned
+        process only one of them tracks). stdout is teed into the
+        per-replica log (the drain audit greps it) and the listening line
+        is parsed for the port when it is ephemeral."""
+        with self._spawn_lock:
+            if self.state != DEAD:
+                return False
+            os.makedirs(self.flight_dir, exist_ok=True)
+            self.reachable = False
+            self.last_health = {}
+            self.port = self.fixed_port or None
+            argv = replica_argv(self.serve_argv, self.fixed_port,
+                                self.flight_dir)
+            from galvatron_tpu.core.elastic import child_pythonpath_env
+
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "galvatron_tpu.cli", "serve", *argv],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                env=child_pythonpath_env(self.env if self.env is not None
+                                         else dict(os.environ)),
+            )
+            self.last_exit_code = None
+            self.advance(STARTING, pid=self.proc.pid)
+        threading.Thread(
+            target=self._pump_stdout, args=(self.proc,),
+            name=f"replica-{self.idx}-log", daemon=True,
+        ).start()
+        return True
+
+    def _pump_stdout(self, proc: subprocess.Popen) -> None:
+        """Drain the child's stdout into the log file (a full pipe would
+        wedge the replica mid-print) and latch the listening port."""
+        with open(self.log_path, "a") as log:
+            for line in proc.stdout:
+                log.write(line)
+                log.flush()
+                # latch the port only for the CURRENT incarnation: a stale
+                # pump still draining a dead process's buffer must not
+                # publish the dead port over the respawn's fresh one
+                if self.port is None and self.proc is proc:
+                    m = _LISTEN_RE.search(line)
+                    if m:
+                        self.port = int(m.group(1))
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    def kill(self) -> None:
+        if self.alive:
+            self.proc.kill()
+
+    # -- dispatch bookkeeping ----------------------------------------------
+
+    def begin_dispatch(self) -> None:
+        with self._lock:
+            self.outstanding += 1
+
+    def end_dispatch(self) -> None:
+        with self._lock:
+            self.outstanding -= 1
+
+    @property
+    def load(self) -> float:
+        """Live occupancy the dispatcher minimizes: router-side outstanding
+        plus the replica's last-probed queue depth + active slots."""
+        s = (self.last_health.get("serving") or {})
+        return (self.outstanding
+                + float(s.get("queue_depth") or 0)
+                + float(s.get("active_slots") or 0))
+
+    @property
+    def completed(self) -> int:
+        """Completions of the CURRENT incarnation (counters reset with the
+        process) — the supervision progress signal."""
+        return int((self.last_health.get("serving") or {}).get("completed") or 0)
+
+    def dispatchable(self) -> bool:
+        return (self.state == READY and self.reachable and self.alive
+                and self.port is not None)
+
+    def snapshot(self) -> Dict[str, Any]:
+        s = (self.last_health.get("serving") or {})
+        return {
+            "idx": self.idx,
+            "port": self.port,
+            "pid": self.pid,
+            "state": self.state,
+            "reachable": self.reachable,
+            "outstanding": self.outstanding,
+            "queue_depth": s.get("queue_depth"),
+            "active_slots": s.get("active_slots"),
+            "completed": s.get("completed"),
+            "engine_restarts": s.get("engine_restarts"),
+            "ttft_p99_s": s.get("ttft_p99_s"),
+            "restarts": self.restarts_total,
+            "gave_up": self.gave_up,
+            "last_exit_code": self.last_exit_code,
+        }
+
+
+class _FleetGate:
+    """Fleet-wide bounded admission (shared backpressure): one semaphore in
+    front of every replica, so saturation is ONE coherent 503."""
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, int(capacity))
+        self._sem = threading.BoundedSemaphore(self.capacity)
+        self._lock = threading.Lock()
+        self.in_use = 0
+
+    def acquire(self) -> bool:
+        ok = self._sem.acquire(blocking=False)
+        if ok:
+            with self._lock:
+                self.in_use += 1
+        return ok
+
+    def release(self) -> None:
+        with self._lock:
+            self.in_use -= 1
+        self._sem.release()
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"capacity": self.capacity, "in_use": self.in_use,
+                    "saturated": self.in_use >= self.capacity}
+
+
+class FleetRouter:
+    """Router process state: replicas, monitors, dispatch, drain."""
+
+    def __init__(self, serve_argv: Sequence[str], *,
+                 replicas: int = 2,
+                 replica_ports: Optional[Sequence[int]] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 retry_budget: int = 2,
+                 request_ttl_s: Optional[float] = 30.0,
+                 drain_timeout_s: float = 30.0,
+                 max_replica_restarts: int = 3,
+                 restart_backoff_s: float = 0.5,
+                 restart_backoff_cap_s: float = 10.0,
+                 probe_interval_s: float = 0.25,
+                 session_affinity: bool = False,
+                 fleet_max_pending: int = 0,
+                 fleet_dir: Optional[str] = None,
+                 replica_env: Optional[Dict[str, str]] = None,
+                 replica_faults: str = "",
+                 rolling_shutdown: bool = True,
+                 num_slots_hint: int = 4,
+                 startup_timeout_s: float = 180.0):
+        n = max(1, int(replicas))
+        ports = list(replica_ports or [])
+        if ports and len(ports) != n:
+            raise ValueError(
+                f"--replica_ports names {len(ports)} ports for "
+                f"--replicas {n}"
+            )
+        self.host = host
+        self.retry_budget = max(0, int(retry_budget))
+        self.request_ttl_s = request_ttl_s if (request_ttl_s or 0) > 0 else None
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.probe_interval_s = max(0.02, float(probe_interval_s))
+        self.session_affinity = bool(session_affinity)
+        self.rolling_shutdown = bool(rolling_shutdown)
+        self.startup_timeout_s = float(startup_timeout_s)
+        self.fleet_dir = fleet_dir or os.path.abspath("fleet_dir")
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        # replica env: the router's own GALVATRON_FAULTS must NOT leak into
+        # replicas (router-level chaos like kill_replica_at_dispatch would
+        # otherwise arm nonsense keys in every child); --replica_faults is
+        # the explicit way to degrade the replicas themselves
+        env = dict(replica_env if replica_env is not None else os.environ)
+        env.pop(faults.ENV_VAR, None)
+        if replica_faults:
+            env[faults.ENV_VAR] = replica_faults
+        self.replicas: List[Replica] = [
+            Replica(
+                i, serve_argv, fleet_dir=self.fleet_dir,
+                port=ports[i] if ports else 0, env=env,
+                restart_policy=RestartPolicy(
+                    max_restarts=max_replica_restarts,
+                    backoff_s=restart_backoff_s,
+                    backoff_cap_s=restart_backoff_cap_s,
+                ),
+            )
+            for i in range(n)
+        ]
+        self.gate = _FleetGate(
+            fleet_max_pending or n * max(1, int(num_slots_hint)) * 4
+        )
+        self.counters = Counters(
+            "dispatched", "served", "retried", "rejected_saturated",
+            "rejected_unready", "rejected_draining", "expired", "failed",
+            "client_error", "replica_restarts",
+        )
+        self.started_at = time.time()
+        self.draining = False
+        self._drain_lock = threading.Lock()
+        self._rolling_lock = threading.Lock()
+        self.drain_audit: Dict[str, Any] = {}
+        self._drained = threading.Event()
+        self._stop = False
+        self._serving = False  # serve_forever started (start() sets it)
+        self._monitors: List[threading.Thread] = []
+        self.httpd = ThreadingHTTPServer((host, port), _make_handler(self))
+        self.port = self.httpd.server_address[1]
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "FleetRouter":
+        for r in self.replicas:
+            r.spawn()
+        for r in self.replicas:
+            t = threading.Thread(target=self._monitor, args=(r,),
+                                 name=f"fleet-monitor-{r.idx}", daemon=True)
+            t.start()
+            self._monitors.append(t)
+        self._serving = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         name="fleet-http", daemon=True).start()
+        return self
+
+    def wait_ready(self, min_replicas: int = 1,
+                   timeout_s: Optional[float] = None) -> bool:
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else self.startup_timeout_s)
+        while time.monotonic() < deadline:
+            if self.ready_count() >= min_replicas:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def ready_count(self) -> int:
+        return sum(1 for r in self.replicas if r.dispatchable())
+
+    @property
+    def ready(self) -> bool:
+        """Router readiness: at least one dispatchable replica and not
+        draining — a degraded fleet is still a fleet."""
+        return not self.draining and self.ready_count() > 0
+
+    def close(self) -> None:
+        """Hard stop (tests/error paths): kill everything, no drain."""
+        self._stop = True
+        for r in self.replicas:
+            r.kill()
+        try:
+            if self._serving:
+                # shutdown() handshakes with serve_forever — calling it on
+                # a never-started server parks forever on the rendezvous
+                self.httpd.shutdown()
+            self.httpd.server_close()
+        except Exception:  # noqa: BLE001 — already closed is fine
+            pass
+
+    # -- replica supervision ------------------------------------------------
+
+    def _monitor(self, r: Replica) -> None:
+        """Per-replica monitor: classify exits, probe health, keep the
+        state machine honest. The one writer of ``r.state`` outside
+        drain()'s DRAINING mark."""
+        while not self._stop:
+            if r.gave_up:
+                time.sleep(self.probe_interval_s)
+                continue
+            # pin the incarnation: rolling_drain respawns concurrently, and
+            # classifying the OLD proc's exit against the NEW proc's state
+            # would mark a healthy respawn dead (and leak its process)
+            proc = r.proc
+            rc = proc.poll() if proc is not None else None
+            if rc is not None and r.state != DEAD:
+                if r.proc is not proc:
+                    continue  # already respawned by another thread
+                r.last_exit_code = rc
+                r.reachable = False
+                expected = r.state == DRAINING or self.draining or self._stop
+                r.advance(DEAD, exit_code=rc, expected=expected)
+                if expected:
+                    continue
+                # crash: the shared decision table. Progress = completions
+                # in the incarnation that just died BEYOND the startup
+                # readiness probe (cli serve's warm generation completes one
+                # request per incarnation — counting it would make every
+                # post-READY crash look progressed and the give-up budget
+                # unreachable)
+                progressed = r.completed > 1
+                decision = r.policy.on_failure(progressed)
+                tracer.instant(
+                    "replica_crash", idx=r.idx, exit_code=rc,
+                    consecutive=decision.consecutive, progressed=progressed,
+                )
+                if decision.give_up:
+                    r.gave_up = True
+                    tracer.instant("replica_give_up", idx=r.idx,
+                                   restarts=r.restarts_total)
+                    print(f"fleet: replica {r.idx} gave up after "
+                          f"{r.restarts_total} restart(s); serving degrades "
+                          f"to {self.ready_count()} ready replica(s)",
+                          flush=True)
+                    continue
+                if decision.backoff_s:
+                    time.sleep(decision.backoff_s)
+                if self._stop or self.draining:
+                    continue
+                # spawn() is atomic under the replica's spawn lock and only
+                # proceeds from DEAD — a rolling drain's deploy respawn
+                # racing this crash respawn yields exactly one incarnation
+                if r.spawn():
+                    r.restarts_total += 1
+                    self.counters.inc("replica_restarts")
+                    print(f"fleet: replica {r.idx} crashed (exit {rc}); "
+                          f"restart {r.restarts_total} after "
+                          f"{decision.backoff_s:.2f}s backoff", flush=True)
+                continue
+            if rc is None and r.port is not None:
+                self._probe(r)
+            time.sleep(self.probe_interval_s)
+
+    def _probe(self, r: Replica) -> None:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{r.port}/healthz", timeout=5
+            ) as resp:
+                doc = json.loads(resp.read())
+        except Exception:  # noqa: BLE001 — unreachable is a state, not an error
+            r.reachable = False
+            return
+        r.last_health = doc
+        r.reachable = True
+        status = doc.get("status")
+        ready = bool(doc.get("ready"))
+        # try_advance, not advance: a drain/exit can move the replica
+        # between our state read and the transition — a lost race here is
+        # a no-op, never an IllegalReplicaTransition that kills the monitor
+        if r.state == STARTING and ready:
+            r.try_advance(READY, (STARTING,))
+        elif r.state == READY and status == "draining":
+            # an externally-initiated replica drain (operator hit the
+            # replica's own /drain): honor it — stop dispatching
+            r.try_advance(DRAINING, (READY,), reason="external")
+        elif r.state == READY and status == "ok" and not ready:
+            # process alive but its engine gave up (crash budget spent):
+            # capacity-wise this replica is dead — recycle the process so
+            # the supervised respawn gets a fresh engine
+            tracer.instant("replica_engine_dead", idx=r.idx)
+            r.kill()
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _pick(self, body: Dict[str, Any],
+              excluded: Set[int]) -> Optional[Replica]:
+        ready = [r for r in self.replicas
+                 if r.dispatchable() and r.idx not in excluded]
+        if not ready:
+            return None
+        if self.session_affinity:
+            session = body.get("session")
+            if isinstance(session, str) and session:
+                pinned = self.replicas[
+                    zlib.crc32(session.encode()) % len(self.replicas)
+                ]
+                if pinned in ready:
+                    return pinned
+        return min(ready, key=lambda r: (r.load, r.idx))
+
+    def handle_api(self, raw: bytes):
+        """One routed request: admission record → gate → dispatch loop with
+        failover. Returns ``(status_code, payload_dict, headers_or_None)``."""
+        if self.draining:
+            self.counters.inc("rejected_draining")
+            return 503, {"error": "fleet draining", "detail": "draining"}, {
+                "Retry-After": str(max(1, int(self.drain_timeout_s)))}
+        try:
+            body = json.loads(raw or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
+            # admission-time request record: the exact body plus an absolute
+            # deadline — what makes a mid-flight retry exact (same prompt
+            # and params, only ttl_s rewritten to the REMAINING budget)
+            ttl = body.get("ttl_s")
+            ttl = float(ttl) if ttl is not None else self.request_ttl_s
+        except (ValueError, TypeError) as e:
+            # a client typo (ttl_s: "abc") is a 400, not a router failure —
+            # counted so the outcome partition stays lossless
+            self.counters.inc("client_error")
+            return 400, {"error": str(e)}, None
+        deadline = time.monotonic() + ttl if ttl and ttl > 0 else None
+        if not self.gate.acquire():
+            self.counters.inc("rejected_saturated")
+            return 503, {
+                "error": f"fleet saturated "
+                         f"({self.gate.capacity} pending requests)",
+                "detail": "fleet_saturated",
+            }, {"Retry-After": "1"}
+        try:
+            return self._dispatch_loop(body, deadline)
+        finally:
+            self.gate.release()
+
+    def _dispatch_loop(self, body: Dict[str, Any], deadline: Optional[float]):
+        attempts = 0  # re-dispatches so far (retried_from in the response)
+        excluded: Set[int] = set()
+        last_err = None
+        while True:
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.counters.inc("expired")
+                    return 503, {
+                        "error": "end-to-end deadline exhausted "
+                                 f"(after {attempts} retr"
+                                 f"{'y' if attempts == 1 else 'ies'})",
+                        "detail": "expired",
+                    }, None
+            r = self._pick(body, excluded)
+            if r is None and excluded:
+                # every sibling was tried or is out: one more pass over the
+                # full fleet (the failed replica may have recovered)
+                excluded = set()
+                r = self._pick(body, excluded)
+            if r is None:
+                self.counters.inc("rejected_unready")
+                code, payload, headers = 503, {
+                    "error": "no ready replica", "detail": "no_ready_replica",
+                }, {"Retry-After": "1"}
+                if last_err is not None:
+                    payload["last_error"] = last_err
+                return code, payload, headers
+            # inc() returns the post-increment value atomically: two
+            # concurrent dispatches must never observe the same index (the
+            # kill fault is consumed exactly once)
+            n = self.counters.inc("dispatched") - 1
+            if faults.kill_replica(n):
+                # the chaos seam: SIGKILL the chosen replica shortly after
+                # the request lands on it — this very request must fail
+                # over to a sibling inside its remaining deadline
+                threading.Thread(
+                    target=lambda: (time.sleep(0.2), r.kill()),
+                    name="fleet-chaos-kill", daemon=True,
+                ).start()
+            ok, result = self._proxy(r, body, remaining)
+            if ok:
+                code, payload, headers = result
+                if code == 200 and isinstance(payload, dict):
+                    self.counters.inc("served")
+                    payload["retried_from"] = attempts
+                    return code, payload, headers
+                detail = payload.get("detail") if isinstance(payload, dict) else None
+                if code == 503 and detail in (
+                    "engine_restarted", "queue_full", "shed", "draining",
+                    "engine_closed",
+                ):
+                    # the replica refused or lost the request for a reason a
+                    # sibling can absorb — failover-eligible
+                    last_err = f"replica {r.idx}: 503 {detail}"
+                else:
+                    # deterministic outcomes (400s, expired, 500s) pass
+                    # through verbatim: retrying a poison request elsewhere
+                    # is exactly the cascade the budget exists to prevent
+                    if detail == "expired":
+                        self.counters.inc("expired")
+                    elif code >= 500:
+                        self.counters.inc("failed")
+                    elif code >= 400:
+                        # replica-side validation rejections (bad prompts,
+                        # out-of-range budgets): part of the partition too
+                        self.counters.inc("client_error")
+                    if isinstance(payload, dict) and attempts:
+                        payload["retried_from"] = attempts
+                    return code, payload, headers
+            else:
+                # transport-level loss: connection refused/reset/timeout —
+                # the replica died (or is dying) with our request on it
+                last_err = f"replica {r.idx}: {result}"
+                r.reachable = False
+            if attempts >= self.retry_budget:
+                self.counters.inc("failed")
+                return 503, {
+                    "error": f"request failed after {attempts + 1} "
+                             f"dispatch(es): {last_err}",
+                    "detail": "retry_budget_exhausted",
+                }, None
+            attempts += 1
+            excluded.add(r.idx)
+            self.counters.inc("retried")
+            tracer.instant("fleet_failover", replica=r.idx,
+                           attempts=attempts, error=str(last_err)[:200])
+
+    def _proxy(self, r: Replica, body: Dict[str, Any],
+               remaining: Optional[float]):
+        """Forward one attempt to one replica. Returns ``(True, (code,
+        payload, headers))`` for any HTTP response, ``(False, error_str)``
+        for transport-level loss."""
+        fwd = dict(body)
+        fwd.pop("session", None)  # router-level concern, not the engine's
+        if remaining is not None:
+            fwd["ttl_s"] = max(0.05, remaining)
+        data = json.dumps(fwd).encode()
+        timeout = (remaining + 10.0) if remaining is not None else 600.0
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{r.port}/api", data=data,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        r.begin_dispatch()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return True, (resp.status, json.loads(resp.read()), None)
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except ValueError:
+                payload = {"error": "unparseable replica response"}
+            headers = None
+            ra = e.headers.get("Retry-After") if e.headers else None
+            if ra:
+                headers = {"Retry-After": ra}
+            return True, (e.code, payload, headers)
+        except Exception as e:  # noqa: BLE001 — transport loss is an outcome
+            return False, f"{type(e).__name__}: {e}"
+        finally:
+            r.end_dispatch()
+
+    # -- drain --------------------------------------------------------------
+
+    def _drain_one(self, r: Replica, timeout_s: float) -> Dict[str, Any]:
+        """PR 10's per-replica drain, driven from the router: mark DRAINING
+        (dispatch stops), POST /drain, wait for exit, audit the exit code,
+        the drained log line, and the flight dump."""
+        # try_advance: the replica may die between the state read and the
+        # mark — it then drains via its exit path, which is fine
+        r.try_advance(DRAINING, (STARTING, READY), reason="fleet")
+        proc = r.proc  # pin the incarnation: a racing respawn must not
+        # swap the handle out from under the wait
+        posted = False
+        if r.alive and r.port is not None:
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{r.port}/drain", data=b"",
+                    method="POST",
+                ), timeout=10)
+                posted = True
+            except Exception:  # noqa: BLE001 — a dying replica still drains via exit
+                pass
+        rc = None
+        if proc is not None:
+            if not posted and proc.poll() is None:
+                # no reachable /drain (mid-respawn, port unknown): SIGTERM
+                # runs the replica's OWN graceful drain — a SIGKILL here
+                # would fail a healthy replica's audit for no reason
+                proc.terminate()
+            try:
+                rc = proc.wait(timeout=timeout_s + 15.0)
+            except subprocess.TimeoutExpired:
+                proc.terminate()
+                try:
+                    rc = proc.wait(timeout=timeout_s + 15.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    rc = proc.wait(timeout=10)
+        r.last_exit_code = rc
+        if r.state != DEAD:
+            r.advance(DEAD, exit_code=rc, expected=True)
+        return self._audit_one(r, rc)
+
+    def _audit_one(self, r: Replica, rc: Optional[int]) -> Dict[str, Any]:
+        try:
+            log = open(r.log_path).read()
+        except OSError:
+            log = ""
+        clean = "server drained: leaked=False" in log
+        dumps = (os.listdir(r.flight_dir)
+                 if os.path.isdir(r.flight_dir) else [])
+        flight = any(f.startswith("flight_") for f in dumps)
+        return {
+            "idx": r.idx, "exit_code": rc, "clean_drain": clean,
+            "flight_dump": flight, "restarts": r.restarts_total,
+            "ok": rc == 0 and clean and flight,
+        }
+
+    def rolling_drain(self) -> Dict[str, Any]:
+        """Zero-downtime deploy: drain each replica in turn (the rest keep
+        serving — router admission stays OPEN), audit its exit, respawn it,
+        wait for READY, then move to the next. Serialized: two concurrent
+        rolls would drain the fleet from both ends."""
+        with self._rolling_lock:
+            audits = []
+            for r in self.replicas:
+                if r.gave_up:
+                    audits.append({"idx": r.idx, "skipped": "gave_up"})
+                    continue
+                # a mid-restart replica finishes starting before its turn
+                deadline = time.monotonic() + self.startup_timeout_s
+                while (r.state == STARTING and time.monotonic() < deadline
+                       and not self._stop):
+                    time.sleep(0.05)
+                audit = self._drain_one(r, self.drain_timeout_s)
+                audits.append(audit)
+                if self._stop or self.draining:
+                    break  # a fleet shutdown raced the roll: stop respawning
+                if r.spawn():
+                    r.restarts_total += 1
+                    self.counters.inc("replica_restarts")
+                    r.policy.reset()  # a deploy is a fresh incarnation, not a crash
+                # else: the monitor's crash respawn won the race — either
+                # way exactly one incarnation is coming up; wait for it
+                if not self._wait_replica_ready(r):
+                    audits[-1]["respawn_ready"] = False
+                else:
+                    audits[-1]["respawn_ready"] = True
+            out = {
+                "rolling": True,
+                "replicas": audits,
+                "ok": all(a.get("ok") and a.get("respawn_ready", True)
+                          for a in audits if "skipped" not in a),
+            }
+            tracer.instant("fleet_rolling_drain_done", ok=out["ok"])
+            print(f"fleet rolling drain: ok={out['ok']} "
+                  f"audit={json.dumps(out)}", flush=True)
+            return out
+
+    def _wait_replica_ready(self, r: Replica) -> bool:
+        deadline = time.monotonic() + self.startup_timeout_s
+        while time.monotonic() < deadline and not self._stop:
+            if r.dispatchable():
+                return True
+            time.sleep(0.05)
+        return False
+
+    def drain(self, reason: str = "drain") -> Dict[str, Any]:
+        """Fleet shutdown: admission closes (one coherent 503 +
+        Retry-After; /readyz unready), replicas drain sequentially — work
+        shed by a draining replica re-dispatches to the still-open
+        siblings until the last one — then the router stops. Idempotent;
+        returns the fleet-level post-drain audit."""
+        with self._drain_lock:
+            first = not self.draining
+            self.draining = True
+        if not first:
+            self._drained.wait(
+                timeout=(self.drain_timeout_s + 20.0) * len(self.replicas)
+            )
+            return self.drain_audit
+        tracer.instant("fleet_drain_begin", reason=reason)
+        audits = []
+        targets = [r for r in self.replicas if not r.gave_up]
+        if self.rolling_shutdown:
+            for r in targets:
+                audits.append(self._drain_one(r, self.drain_timeout_s))
+        else:
+            threads = []
+            results: Dict[int, Dict[str, Any]] = {}
+
+            def one(rep):
+                results[rep.idx] = self._drain_one(rep, self.drain_timeout_s)
+
+            for r in targets:
+                t = threading.Thread(target=one, args=(r,), daemon=True)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(timeout=self.drain_timeout_s + 30.0)
+            audits = [results.get(r.idx, {"idx": r.idx, "ok": False})
+                      for r in targets]
+        self._stop = True
+        audit = {
+            "reason": reason,
+            "replicas": audits,
+            "requests": self.counters.snapshot(),
+            "leaked": self.gate.snapshot()["in_use"] != 0,
+            "ok": all(a.get("ok") for a in audits) and
+                  self.gate.snapshot()["in_use"] == 0,
+        }
+        self.drain_audit = audit
+        tracer.instant("fleet_drain_done", ok=audit["ok"],
+                       leaked=audit["leaked"])
+        self._drained.set()
+        return audit
+
+    # -- probes -------------------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        return {
+            "status": "draining" if self.draining else "ok",
+            "ready": self.ready,
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "fleet": {
+                "replicas": len(self.replicas),
+                "ready_replicas": self.ready_count(),
+                "retry_budget": self.retry_budget,
+                "gate": self.gate.snapshot(),
+            },
+            "requests": self.counters.snapshot(),
+            "replica": [r.snapshot() for r in self.replicas],
+        }
+
+
+def _make_handler(router: FleetRouter):
+    class Handler(BaseHTTPRequestHandler):
+        timeout = 600.0
+
+        def _reply(self, code, payload, headers=None):
+            data = json.dumps(payload).encode()
+            try:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+            except (BrokenPipeError, ConnectionResetError, TimeoutError,
+                    OSError):
+                self.close_connection = True
+
+        def _handle(self):
+            route, _, query = self.path.partition("?")
+            route = route.rstrip("/")
+            if route == "/drain":
+                rolling = "rolling=1" in query
+                if rolling:
+                    threading.Thread(target=router.rolling_drain,
+                                     daemon=True).start()
+                    return self._reply(200, {"status": "rolling_drain",
+                                             "rolling": True})
+                threading.Thread(target=drain_and_stop,
+                                 args=(router, "POST /drain"),
+                                 daemon=True).start()
+                return self._reply(200, {
+                    "status": "draining", "rolling": False,
+                    "drain_timeout_s": router.drain_timeout_s,
+                })
+            if route != "/api":
+                return self._reply(404, {"error": "use /api or /drain"})
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+                code, payload, headers = router.handle_api(raw)
+                return self._reply(code, payload, headers)
+            except TimeoutError:
+                self.close_connection = True
+                return
+            except Exception as e:  # noqa: BLE001 — surface to client
+                router.counters.inc("failed")
+                return self._reply(
+                    500, {"error": f"{type(e).__name__}: {e}"}
+                )
+
+        do_POST = _handle
+        do_PUT = _handle
+
+        def do_GET(self):
+            route = self.path.partition("?")[0].rstrip("/")
+            if route == "/healthz":
+                return self._reply(200, router.health())
+            if route == "/readyz":
+                if router.ready:
+                    return self._reply(200, {
+                        "ready": True,
+                        "ready_replicas": router.ready_count(),
+                    })
+                return self._reply(503, {
+                    "ready": False,
+                    "status": ("draining" if router.draining
+                               else "no_ready_replica"),
+                    "ready_replicas": router.ready_count(),
+                })
+            if route == "/metrics":
+                from galvatron_tpu.obs.prom import (
+                    CONTENT_TYPE,
+                    fleet_metrics_text,
+                )
+
+                try:
+                    data = fleet_metrics_text(router).encode()
+                except Exception as e:  # noqa: BLE001 — scrape must not kill routing
+                    return self._reply(
+                        500, {"error": f"{type(e).__name__}: {e}"}
+                    )
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    self.close_connection = True
+                return
+            return self._reply(404, {
+                "error": "use /api (POST/PUT), /healthz, /readyz, /metrics "
+                         "(GET), or /drain[?rolling=1] (POST)"
+            })
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    return Handler
+
+
+def drain_and_stop(router: FleetRouter, reason: str) -> Dict[str, Any]:
+    """The fleet shutdown sequence (SIGTERM and plain ``POST /drain``):
+    drain + audit, then stop ``serve_forever`` so the process exits 0."""
+    audit = router.drain(reason=reason)
+    try:
+        router.httpd.shutdown()
+    except Exception:  # noqa: BLE001 — already stopped
+        pass
+    return audit
+
+
+def serve_fleet_main(ns, raw_argv: Sequence[str]) -> int:
+    """``cli serve-fleet`` entry: build the router from the parsed flags,
+    forward everything non-fleet to the replicas, serve until drained."""
+    import signal as _signal
+
+    faults.init_from_env()
+    if getattr(ns, "flight_dir", None) and not tracer.enabled:
+        tracer.enable()
+    ports = [int(p) for p in
+             (ns.replica_ports or "").replace(" ", "").split(",") if p]
+    router = FleetRouter(
+        raw_argv,
+        replicas=ns.replicas,
+        replica_ports=ports or None,
+        host=ns.host, port=ns.port,
+        retry_budget=ns.retry_budget,
+        request_ttl_s=ns.request_ttl_s if ns.request_ttl_s > 0 else None,
+        drain_timeout_s=ns.drain_timeout_s,
+        max_replica_restarts=ns.max_replica_restarts,
+        restart_backoff_s=ns.replica_restart_backoff_s,
+        probe_interval_s=ns.probe_interval_s,
+        session_affinity=bool(ns.session_affinity),
+        fleet_max_pending=ns.fleet_max_pending,
+        fleet_dir=ns.fleet_dir,
+        replica_faults=ns.replica_faults or "",
+        rolling_shutdown=bool(ns.rolling_drain),
+        num_slots_hint=ns.num_slots,
+    )
+    # install the handler BEFORE spawning replicas: a SIGTERM landing in
+    # the startup window would otherwise kill the router with the default
+    # action and orphan every child it had already spawned
+    try:
+        _signal.signal(
+            _signal.SIGTERM,
+            lambda signum, frame: threading.Thread(
+                target=drain_and_stop, args=(router, f"signal {signum}"),
+                daemon=True,
+            ).start(),
+        )
+    except ValueError:
+        pass  # not the main thread
+    router.start()
+    print(f"fleet router listening on http://{router.host}:{router.port}/api "
+          f"({len(router.replicas)} replicas)", flush=True)
+    # serve_forever runs on the router's own thread (start()); this thread
+    # just waits for the drain that SIGTERM or POST /drain will run
+    try:
+        router._drained.wait()
+    except KeyboardInterrupt:
+        drain_and_stop(router, "keyboard interrupt")
+    audit = router.drain_audit
+    try:
+        router.httpd.shutdown()
+        router.httpd.server_close()
+    except Exception:  # noqa: BLE001 — already stopped
+        pass
+    print(f"fleet drained: ok={audit.get('ok')} "
+          f"audit={json.dumps(audit)}", flush=True)
+    if getattr(ns, "flight_dir", None):
+        from galvatron_tpu.obs.flight import dump_flight
+
+        dump_flight(ns.flight_dir, tracer, reason="fleet drained",
+                    extra={"ok": audit.get("ok")})
+    return 0 if audit.get("ok") else 1

@@ -42,7 +42,7 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-from galvatron_tpu.core.retry import RetryPolicy
+from galvatron_tpu.core.restart_policy import RestartPolicy
 from galvatron_tpu.obs.tracing import tracer
 
 # --- request lifecycle states ------------------------------------------------
@@ -143,7 +143,15 @@ class EngineClosed(RuntimeError):
 class EngineRestarted(RuntimeError):
     """The engine crashed and restarted while this request was in flight.
     Mid-decode KV state cannot be replayed — the request fails fast with a
-    503 so the client retries against the recovered engine."""
+    503 so the client retries against the recovered engine.
+    ``retry_after_s`` is the supervisor's own backoff delay (it knows when
+    the engine will be looping again) — the server surfaces it as a
+    ``Retry-After`` header, like draining 503s, and the fleet router uses
+    it to time the re-dispatch."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 # --- in-process crash supervision -------------------------------------------
@@ -173,16 +181,23 @@ class EngineSupervisor:
     def __init__(self, max_restarts: int = 3, backoff_s: float = 0.05,
                  backoff_cap_s: float = 2.0, flight_dir: Optional[str] = None):
         self.max_restarts = max(0, int(max_restarts))
-        self.policy = RetryPolicy(
-            attempts=self.max_restarts + 1,
-            base_delay_s=float(backoff_s),
-            max_delay_s=float(backoff_cap_s),
+        # the shared supervisor decision table (core/restart_policy.py):
+        # elastic, this supervisor, and the fleet router all budget restarts
+        # with the same consecutive-no-progress arithmetic
+        self.policy = RestartPolicy(
+            max_restarts=self.max_restarts,
+            backoff_s=float(backoff_s),
+            backoff_cap_s=float(backoff_cap_s),
         )
         self.flight_dir = flight_dir
         self.restarts_total = 0
-        self.consecutive = 0  # restarts since the last completed request
         self.gave_up = False
         self._last_completed = 0
+
+    @property
+    def consecutive(self) -> int:
+        """Restarts since the last completed request (the policy's streak)."""
+        return self.policy.consecutive
 
     def note_counter_reset(self) -> None:
         """The engine reset its counters (``reset_metrics``): drop the
@@ -196,35 +211,38 @@ class EngineSupervisor:
         completed = engine.scheduler.counters.get("completed")
         progressed = completed > self._last_completed
         self._last_completed = completed
-        self.consecutive = 1 if progressed else self.consecutive + 1
+        decision = self.policy.on_failure(progressed)
         tracer.instant(
             "engine_crash", error=f"{type(exc).__name__}: {exc}",
-            consecutive=self.consecutive, in_flight=len(engine._by_slot),
+            consecutive=decision.consecutive, in_flight=len(engine._by_slot),
         )
-        engine._crash_cleanup(exc)
-        give_up = self.consecutive > self.max_restarts
+        # in-flight 503s carry the supervisor's own backoff as Retry-After:
+        # the engine is looping again after exactly that delay (give-up 503s
+        # carry none — there is nothing to come back to)
+        engine._crash_cleanup(
+            exc,
+            retry_after_s=None if decision.give_up else decision.backoff_s,
+        )
         if self.flight_dir:
             from galvatron_tpu.obs.flight import dump_flight
 
             dump_flight(
                 self.flight_dir, tracer,
-                reason=f"engine {'give-up' if give_up else 'crash'}: "
+                reason=f"engine {'give-up' if decision.give_up else 'crash'}: "
                        f"{type(exc).__name__}: {exc}",
                 extra={"restarts_total": self.restarts_total,
-                       "consecutive": self.consecutive},
+                       "consecutive": decision.consecutive},
             )
-        if give_up:
+        if decision.give_up:
             self.gave_up = True
             tracer.instant("engine_give_up", restarts=self.restarts_total,
-                           consecutive=self.consecutive)
+                           consecutive=decision.consecutive)
             return False
         self.restarts_total += 1
         engine.counters.inc("engine_restarts")
-        delay = self.policy.delay(min(self.consecutive - 1,
-                                      self.policy.attempts - 1))
-        if delay:
-            time.sleep(delay)
+        if decision.backoff_s:
+            time.sleep(decision.backoff_s)
         engine._warm_rebuild()
         tracer.instant("engine_restart", restarts=self.restarts_total,
-                       backoff_s=round(delay, 3))
+                       backoff_s=round(decision.backoff_s, 3))
         return True

@@ -1,0 +1,485 @@
+"""Serving fleet (serving/fleet.py + cli serve-fleet): the multi-replica
+router — replica state machine, least-loaded dispatch + session affinity,
+fleet-wide admission, mid-flight failover within the end-to-end deadline,
+supervised replica restarts under the shared core/restart_policy.py table,
+rolling drain, and the fleet post-drain audit. The e2e tests spawn REAL
+`cli serve` replica subprocesses (the same processes production runs)."""
+
+import json
+import os
+import re
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from galvatron_tpu.core import faults
+from galvatron_tpu.core.restart_policy import RestartDecision, RestartPolicy
+from galvatron_tpu.serving import fleet as fl
+
+# tiny CPU model, shared with experiments/serving_chaos.py's fleet scenarios
+SERVE_ARGS = [
+    "--num_slots", "2", "--prefill_chunk", "8",
+    "--num_layers", "1", "--hidden_size", "32", "--num_heads", "2",
+    "--ffn_dim", "64", "--seq_length", "64",
+    "--request_ttl_s", "60", "--drain_timeout_s", "20",
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _post(port, body, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as r:
+        return json.loads(r.read())
+
+
+def _router(tmp_path, n, serve_argv=None, **kw):
+    kw.setdefault("replica_env", dict(os.environ, JAX_PLATFORMS="cpu"))
+    kw.setdefault("probe_interval_s", 0.1)
+    kw.setdefault("restart_backoff_s", 0.05)
+    r = fl.FleetRouter(serve_argv or SERVE_ARGS, replicas=n,
+                       fleet_dir=str(tmp_path / "fleet"), **kw)
+    r.start()
+    assert r.wait_ready(n, timeout_s=300), (
+        f"fleet never reached {n} ready replicas: "
+        f"{[x.snapshot() for x in r.replicas]}"
+    )
+    return r
+
+
+# ---------------------------------------------------------------------------
+# shared restart policy (core/restart_policy.py)
+# ---------------------------------------------------------------------------
+
+
+def test_restart_policy_decision_matrix():
+    """The shared decision table, pinned: no-progress failures accumulate
+    to give-up, progress resets the streak to 1 (never 0), immediate skips
+    only the sleep, and backoff stays inside the full-jitter ceiling."""
+    p = RestartPolicy(max_restarts=2, backoff_s=0.1, backoff_cap_s=1.0)
+    d1 = p.on_failure(progressed=False)
+    assert isinstance(d1, RestartDecision)
+    assert d1.restart and d1.consecutive == 1
+    assert 0.0 <= d1.backoff_s <= 0.1  # full jitter in [0, base * 2^0]
+    d2 = p.on_failure(progressed=False)
+    assert d2.restart and d2.consecutive == 2
+    assert 0.0 <= d2.backoff_s <= 0.2
+    d3 = p.on_failure(progressed=False)
+    assert d3.give_up and not d3.restart and d3.consecutive == 3
+    # progress resets the streak — to 1, because the failure itself counts
+    p2 = RestartPolicy(max_restarts=2, backoff_s=0.1)
+    for _ in range(5):
+        d = p2.on_failure(progressed=True)
+        assert d.restart and d.consecutive == 1
+    # immediate: counts against the budget, skips only the backoff
+    p3 = RestartPolicy(max_restarts=2, backoff_s=10.0)
+    d = p3.on_failure(progressed=False, immediate=True)
+    assert d.restart and d.backoff_s == 0.0 and d.consecutive == 1
+    assert p3.on_failure(False, immediate=True).restart
+    assert p3.on_failure(False, immediate=True).give_up
+    # max_restarts=0 supervises nothing: first failure gives up, even with
+    # progress (the streak resets to 1, which already exceeds 0)
+    p4 = RestartPolicy(max_restarts=0)
+    assert p4.on_failure(progressed=True).give_up
+    # reset() forgets the streak (entity replaced wholesale, e.g. a deploy)
+    p5 = RestartPolicy(max_restarts=1)
+    assert p5.on_failure(False).restart
+    p5.reset()
+    assert p5.on_failure(False).restart  # streak back to 1, not 2
+
+
+def test_restart_policy_shared_by_both_existing_supervisors():
+    """The factoring satellite's contract: the serving EngineSupervisor and
+    the elastic supervisor both run on core/restart_policy.py (their
+    decision-matrix behavior is pinned by the existing tests in
+    test_serving_resilience.py / test_elastic.py, which pass unchanged)."""
+    import inspect
+
+    from galvatron_tpu.core import elastic
+    from galvatron_tpu.serving.resilience import EngineSupervisor
+
+    sup = EngineSupervisor(max_restarts=5, backoff_s=0.2)
+    assert isinstance(sup.policy, RestartPolicy)
+    assert sup.policy.max_restarts == 5
+    assert sup.consecutive == 0  # delegated to the shared policy
+    src = inspect.getsource(elastic.run_elastic)
+    assert "RestartPolicy" in src and "on_failure" in src
+
+
+# ---------------------------------------------------------------------------
+# replica state machine + argv plumbing (no subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def test_replica_state_machine_edges(tmp_path):
+    r = fl.Replica(0, SERVE_ARGS, fleet_dir=str(tmp_path))
+    assert r.state == fl.DEAD  # pre-spawn
+    r.advance(fl.STARTING)
+    r.advance(fl.READY)
+    r.advance(fl.DRAINING)
+    with pytest.raises(fl.IllegalReplicaTransition):
+        r.advance(fl.READY)  # draining never goes back to ready
+    r.advance(fl.DEAD)
+    r.advance(fl.DEAD)  # same-state advance is a no-op (two observers, one exit)
+    r.advance(fl.STARTING)  # supervised respawn
+    with pytest.raises(fl.IllegalReplicaTransition):
+        r.advance(fl.STARTING + "X")
+
+
+def test_replica_argv_strips_fleet_and_router_flags():
+    raw = ["--num_slots", "2", "--replicas", "3", "--retry_budget=4",
+           "--port", "5000", "--host", "0.0.0.0", "--flight_dir", "/x",
+           "--fleet_dir=/y", "--replica_faults", "slow_decode_ms=5",
+           "--hidden_size", "32", "--compile_cache_dir", "/cache"]
+    out = fl.replica_argv(raw, 7001, "/flights/r0")
+    # fleet-only and router-owned flags gone, both spellings
+    for bad in ("--replicas", "--retry_budget=4", "--fleet_dir=/y",
+                "--replica_faults", "0.0.0.0", "/x", "/y"):
+        assert bad not in out, (bad, out)
+    # serve flags forward verbatim (shared compile cache included)
+    assert out[out.index("--num_slots") + 1] == "2"
+    assert out[out.index("--hidden_size") + 1] == "32"
+    assert out[out.index("--compile_cache_dir") + 1] == "/cache"
+    # the replica's own port/host/flight_dir appended
+    assert out[out.index("--port") + 1] == "7001"
+    assert out[out.index("--host") + 1] == "127.0.0.1"
+    assert out[out.index("--flight_dir") + 1] == "/flights/r0"
+
+
+def _fake_ready(r, port, queue_depth=0, active=0, outstanding=0):
+    r.proc = types.SimpleNamespace(poll=lambda: None, pid=4242,
+                                   kill=lambda: None)
+    r.port = port
+    r.state = fl.READY
+    r.reachable = True
+    r.outstanding = outstanding
+    r.last_health = {"serving": {"queue_depth": queue_depth,
+                                 "active_slots": active, "completed": 0}}
+
+
+def test_dispatch_least_loaded_and_session_affinity(tmp_path):
+    """_pick minimizes live occupancy (router outstanding + probed queue
+    depth + active slots); session affinity pins by stable hash and falls
+    back to least-loaded when the pinned replica is out."""
+    r = fl.FleetRouter(SERVE_ARGS, replicas=3,
+                       fleet_dir=str(tmp_path / "f"), session_affinity=True)
+    try:
+        for i, rep in enumerate(r.replicas):
+            _fake_ready(rep, 7000 + i)
+        r.replicas[0].outstanding = 3
+        r.replicas[1].last_health["serving"]["queue_depth"] = 2
+        assert r._pick({}, set()).idx == 2  # least loaded
+        r.replicas[2].last_health["serving"]["active_slots"] = 9
+        assert r._pick({}, set()).idx == 1
+        # exclusion (failover) skips the failed replica
+        assert r._pick({}, {1}).idx == 0
+        # session affinity: same session → same replica, deterministically
+        import zlib
+
+        pin = zlib.crc32(b"user-42") % 3
+        assert r._pick({"session": "user-42"}, set()).idx == pin
+        # pinned replica out → least-loaded fallback, not an error
+        r.replicas[pin].state = fl.DEAD
+        got = r._pick({"session": "user-42"}, set())
+        assert got is not None and got.idx != pin
+    finally:
+        r.close()
+
+
+def test_fleet_gate_bounds_admission():
+    g = fl._FleetGate(2)
+    assert g.acquire() and g.acquire()
+    assert not g.acquire()  # saturated: the fleet-wide coherent 503
+    assert g.snapshot() == {"capacity": 2, "in_use": 2, "saturated": True}
+    g.release()
+    assert g.acquire()
+    g.release()
+    g.release()
+    assert g.snapshot()["in_use"] == 0
+
+
+def test_design_doc_replica_state_machine_in_sync():
+    """DESIGN.md § Serving fleet must name every replica state the router
+    defines (same doc-sync style as the request-lifecycle table)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    text = open(os.path.join(root, "docs", "DESIGN.md")).read()
+    m = re.search(r"## Serving fleet\n(.*?)(?:\n## |\Z)", text, re.S)
+    assert m, "DESIGN.md has no '## Serving fleet' section"
+    section = m.group(1)
+    missing = [s for s in fl.REPLICA_STATES if s not in section]
+    assert not missing, f"states missing from DESIGN.md: {missing}"
+
+
+# ---------------------------------------------------------------------------
+# startup readiness gating (server.py satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_readyz_unready_during_slow_warm_start():
+    """/readyz reports 503 (status 'starting') for the whole warm-start
+    window and flips to 200 only when it completes — what keeps a router
+    from dispatching into a replica still paying cold compile. /healthz
+    stays 200 (liveness) and /api stays open (a direct client just shares
+    the compile)."""
+    import jax
+
+    from galvatron_tpu.models import modeling
+    from galvatron_tpu.models.modeling import ModelConfig
+    from galvatron_tpu.models.tokenizer import ByteTokenizer, pad_vocab_size
+    from galvatron_tpu.server import GenerationService, run_server
+
+    cfg = ModelConfig(vocab_size=pad_vocab_size(259), hidden_size=32,
+                      num_layers=1, num_heads=2, ffn_dim=64, max_seq_len=64)
+    tok = ByteTokenizer()
+    params = modeling.init_model_params(jax.random.key(0), cfg)
+    svc = GenerationService(params, cfg, tok, max_new_default=4, engine=None)
+    svc.starting = True  # what cli serve sets before its warm thread runs
+    ready = threading.Event()
+    threading.Thread(target=run_server, args=(svc, 0),
+                     kwargs={"ready_event": ready}, daemon=True).start()
+    assert ready.wait(10)
+    port = svc.httpd.server_address[1]
+    statuses = []
+
+    def slow_warm():
+        # a deliberately slow warm start: the poller below must observe
+        # unready DURING it, not just before
+        time.sleep(0.5)
+        svc.starting = False
+
+    threading.Thread(target=slow_warm, daemon=True).start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            _get(port, "/readyz")
+            statuses.append("ready")
+            break
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            body = json.loads(e.read())
+            assert body["status"] == "starting" and body["ready"] is False
+            statuses.append("starting")
+        time.sleep(0.05)
+    assert statuses[0] == "starting" and statuses[-1] == "ready", statuses
+    assert statuses.count("starting") >= 2  # observed DURING the warm window
+    assert _get(port, "/healthz")["status"] == "ok"  # starting cleared
+    svc.httpd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# e2e: real replica subprocesses behind the router
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_parity_backpressure_and_metrics(tmp_path):
+    """One 2-replica fleet pinning three contracts: (1) greedy decode
+    through the router is BIT-identical to a direct single-replica request;
+    (2) fleet-wide saturation is one coherent 503 (detail fleet_saturated,
+    Retry-After present); (3) /healthz//metrics expose the fleet families."""
+    r = _router(tmp_path, 2, fleet_max_pending=1)
+    try:
+        body = {"prompts": ["parity check"], "tokens_to_generate": 8}
+        direct = _post(r.replicas[0].port, dict(body))
+        routed = _post(r.port, dict(body))
+        assert routed["tokens"] == direct["tokens"]  # bit-identical greedy
+        assert routed["retried_from"] == 0
+        # saturation: hold the single gate permit, the next request 503s
+        assert r.gate.acquire()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(r.port, dict(body))
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After")
+            assert json.loads(ei.value.read())["detail"] == "fleet_saturated"
+        finally:
+            r.gate.release()
+        h = _get(r.port, "/healthz")
+        assert h["fleet"]["ready_replicas"] == 2
+        assert {x["state"] for x in h["replica"]} == {"READY"}
+        assert _get(r.port, "/readyz")["ready"] is True
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{r.port}/metrics", timeout=30
+        ) as resp:
+            text = resp.read().decode()
+        from test_obs import assert_valid_exposition
+
+        assert_valid_exposition(text)
+        for family in ("galvatron_fleet_ready_replicas",
+                       "galvatron_fleet_dispatched_total",
+                       "galvatron_fleet_retried_total",
+                       "galvatron_fleet_replica_state_info",
+                       "galvatron_fleet_replica_restarts_total"):
+            assert family in text, family
+        audit = r.drain("test done")
+        assert audit["ok"], audit
+        assert all(a["exit_code"] == 0 and a["clean_drain"]
+                   and a["flight_dump"] for a in audit["replicas"]), audit
+    finally:
+        r.close()
+
+
+def test_fleet_kill_one_of_three_failover_within_deadline(tmp_path):
+    """The acceptance chaos e2e: 3 replicas under concurrent load, one
+    SIGKILLed mid-decode — ZERO requests lost (the dead replica's in-flight
+    work re-dispatches to a sibling and completes within its ORIGINAL
+    end-to-end deadline, retried_from >= 1 in the response), the replica
+    restarts WARM (manifest hits from the shared artifact store), and the
+    fleet post-drain audit shows exit 0 + zero leaked slots everywhere."""
+    cache = str(tmp_path / "shared_cache")
+    r = _router(tmp_path, 3, retry_budget=2,
+                replica_faults="slow_decode_ms=30",
+                serve_argv=SERVE_ARGS + ["--compile_cache_dir", cache])
+    ttl = 45.0
+    try:
+        faults.configure(kill_replica_at_dispatch=2)
+        results = []
+
+        def one(i):
+            t0 = time.monotonic()
+            try:
+                out = _post(r.port, {"prompts": [f"client {i}"],
+                                     "tokens_to_generate": 16,
+                                     "ttl_s": ttl}, timeout=120)
+                results.append(("ok", out["retried_from"],
+                                time.monotonic() - t0))
+            except Exception as e:  # noqa: BLE001 — a loss is the failure mode
+                results.append(("err", repr(e)))
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert len(results) == 6
+        lost = [x for x in results if x[0] != "ok"]
+        assert not lost, f"replica kill lost requests: {results}"
+        retried = [x for x in results if x[1] >= 1]
+        assert retried, f"no request failed over: {results}"
+        for kind, retries, elapsed in results:
+            assert elapsed < ttl, (retries, elapsed)  # original deadline held
+        # the killed replica restarts and the fleet recovers to 3 READY
+        assert r.wait_ready(3, timeout_s=180), [x.snapshot()
+                                               for x in r.replicas]
+        assert r.counters.get("replica_restarts") >= 1
+        restarted = [x for x in r.replicas if x.restarts_total >= 1]
+        assert restarted
+        # warm restart: the respawn's serve log reports artifact-store hits
+        log = open(restarted[0].log_path).read()
+        warm = re.findall(r"serving warm-start: .*\((\d+) cache hits", log)
+        assert len(warm) >= 2 and int(warm[-1]) >= 1, (warm, log[-1500:])
+        audit = r.drain("kill test done")
+        assert audit["ok"] and not audit["leaked"], audit
+        per = {a["idx"]: a for a in audit["replicas"]}
+        assert all(a["exit_code"] == 0 and a["clean_drain"]
+                   and a["flight_dump"] for a in per.values()), audit
+    finally:
+        r.close()
+
+
+def test_fleet_rolling_drain_serves_all_admitted(tmp_path):
+    """Rolling drain e2e: POST /drain?rolling=1 during sustained load —
+    every replica drains in turn (exit 0), the fleet keeps serving the
+    whole time (100% of admitted requests served, none failed by the
+    deploy), and capacity is back at full strength afterwards."""
+    r = _router(tmp_path, 2, retry_budget=3,
+                replica_faults="slow_decode_ms=10")
+    try:
+        stop = threading.Event()
+        outcomes = {"ok": 0, "fail": []}
+        lock = threading.Lock()
+
+        def loadgen(i):
+            j = 0
+            while not stop.is_set():
+                try:
+                    _post(r.port, {"prompts": [f"roll {i}-{j}"],
+                                   "tokens_to_generate": 6,
+                                   "ttl_s": 60.0}, timeout=120)
+                    with lock:
+                        outcomes["ok"] += 1
+                except Exception as e:  # noqa: BLE001 — deploy-failed request
+                    with lock:
+                        outcomes["fail"].append(repr(e))
+                j += 1
+
+        threads = [threading.Thread(target=loadgen, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        with urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{r.port}/drain?rolling=1", data=b"",
+            method="POST",
+        ), timeout=30) as resp:
+            assert json.loads(resp.read())["rolling"] is True
+        deadline = time.time() + 300
+        while time.time() < deadline and not r.drain_audit:
+            if r._rolling_lock.acquire(blocking=False):
+                # acquired = the roll finished (it holds the lock throughout)
+                r._rolling_lock.release()
+                if all(x.restarts_total >= 1 for x in r.replicas):
+                    break
+            time.sleep(0.2)
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        assert not outcomes["fail"], outcomes  # 100% of admitted served
+        assert outcomes["ok"] > 0
+        assert r.wait_ready(2, timeout_s=120)  # full strength after the roll
+        # each replica's drained incarnation exited 0 with a clean audit
+        for rep in r.replicas:
+            log = open(rep.log_path).read()
+            assert "server drained: leaked=False" in log, log[-1500:]
+        audit = r.drain("rolling test done")
+        assert audit["ok"], audit
+        snap = r.counters.snapshot()
+        # outcome partition: everything the router admitted was served
+        assert snap["served"] == outcomes["ok"], (snap, outcomes)
+        assert snap["failed"] == 0 and snap["expired"] == 0, snap
+    finally:
+        r.close()
+
+
+def test_fleet_give_up_degrades_to_remaining_capacity(tmp_path):
+    """A replica whose restart budget is exhausted is given up — the fleet
+    DEGRADES (remaining capacity keeps serving, /readyz stays 200) instead
+    of dying with it."""
+    r = _router(tmp_path, 2, max_replica_restarts=0)
+    try:
+        victim = r.replicas[0]
+        victim.kill()
+        deadline = time.time() + 60
+        while time.time() < deadline and not victim.gave_up:
+            time.sleep(0.05)
+        assert victim.gave_up and victim.state == fl.DEAD
+        assert r.ready_count() == 1 and r.ready  # degraded, not dead
+        assert _get(r.port, "/readyz")["ready_replicas"] == 1
+        out = _post(r.port, {"prompts": ["still serving"],
+                             "tokens_to_generate": 4})
+        assert out["text"] is not None
+        audit = r.drain("give-up test done")
+        # the surviving replica drains clean; the gave-up one is excluded
+        assert [a["idx"] for a in audit["replicas"]] == [1]
+        assert audit["ok"], audit
+    finally:
+        r.close()

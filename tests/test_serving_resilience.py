@@ -438,7 +438,8 @@ def test_http_chaos_engine_crash_under_load(tmp_path):
                 except urllib.error.HTTPError as e:
                     body = json.loads(e.read() or b"{}")
                     outcomes.append(("http", e.code, body,
-                                     time.monotonic() - t0))
+                                     time.monotonic() - t0,
+                                     e.headers.get("Retry-After")))
 
             with ThreadPoolExecutor(max_workers=6) as ex:
                 list(ex.map(one, range(6)))
@@ -448,6 +449,9 @@ def test_http_chaos_engine_crash_under_load(tmp_path):
             for o in fails:
                 assert o[1] == 503 and o[2]["detail"] == "engine_restarted"
                 assert o[3] < 60.0  # well inside the request deadline
+                # engine_restarted carries Retry-After like draining 503s:
+                # the supervisor's own backoff says when to come back
+                assert o[4] is not None and int(o[4]) >= 1, o
             st = engine.stats()
             assert st["engine_restarts"] == 1
             # recovered: subsequent requests succeed
@@ -651,6 +655,18 @@ def test_sigterm_drains_and_exits_zero(tmp_path):
                 break
             assert time.time() < deadline, "server never came up"
         assert port, "no listening line"
+        # honor the readiness gate: the server now listens BEFORE its warm
+        # start (so /readyz is pollable), and a well-behaved load balancer
+        # does not route until it flips — firing during the warm window
+        # would race the warm probe for the slots
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                if _get(port, "/readyz")["ready"]:
+                    break
+            except Exception:  # noqa: BLE001 — 503 while starting
+                pass
+            time.sleep(0.1)
         results = []
 
         def client(i):
