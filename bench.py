@@ -122,8 +122,32 @@ def layer_diff_ms(base, bsz, seq, l1, l2, rounds=5, train=False):
     return float(np.median(diffs))
 
 
+# environment provenance stamped into EVERY metric line: overlap numbers are
+# meaningless without knowing which XLA flags / jax / chip produced them, and
+# the driver archives bench output long after the run env is gone. Populated
+# once in main() (after any XLA_FLAGS mutation the run performs).
+_ENV: dict = {}
+
+
+def _env_provenance() -> dict:
+    import os
+
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        kind = "unknown"
+    return {
+        "jax_version": jax.__version__,
+        "device_kind": kind,
+        "num_devices": jax.device_count(),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+    }
+
+
 def emit(metric, value, unit, **extra):
-    print(json.dumps({"metric": metric, "value": value, "unit": unit, **extra}))
+    print(json.dumps(
+        {"metric": metric, "value": value, "unit": unit, **_ENV, **extra}
+    ))
 
 
 def memory_metrics(smoke: bool):
@@ -334,10 +358,113 @@ def compile_metrics(smoke: bool):
         emit(f"compile_time_{prog}_ms", c["compile_ms"], "ms", **extra)
 
 
+def _overlap_step_ms(cfg, hp, bsz, seq, iters):
+    """Median-free short window over a real build_runtime train step —
+    the on/off arms share shape and data, so constant overheads cancel in
+    the delta. Returns (ms/step, last loss)."""
+    from galvatron_tpu.parallel.hybrid import build_runtime
+
+    rt = build_runtime(cfg, hp, global_batch_size=bsz, seq_len=seq)
+    state = rt.init_state(jax.random.key(0))
+    batch = rt.shard_batch(
+        np.random.RandomState(0)
+        .randint(1, cfg.vocab_size, (bsz, seq + 1))
+        .astype(np.int32)
+    )
+    state, loss = rt.train_step(state, batch)  # compile + warm
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = rt.train_step(state, batch)
+    jax.block_until_ready(state)
+    return (time.perf_counter() - t0) / iters * 1000.0, float(loss)
+
+
+def _overlap_pair(cfg, hp_off, hp_on, metric, bsz, seq, iters, **tags):
+    """Time the paired off/on arms and emit one metric line: value = the
+    overlap-ON step time, extras carry the off arm, the delta, and (when the
+    device peak is known) the bubble fraction of each arm — the number the
+    overlap work is supposed to move DOWN."""
+    from galvatron_tpu.obs.stepstats import StepStats
+
+    off_ms, off_loss = _overlap_step_ms(cfg, hp_off, bsz, seq, iters)
+    on_ms, on_loss = _overlap_step_ms(cfg, hp_on, bsz, seq, iters)
+    extra = dict(tags)
+    extra.update(
+        off_ms=round(off_ms, 4),
+        delta_ms=round(off_ms - on_ms, 4),
+        speedup=round(off_ms / on_ms, 4) if on_ms > 0 else 0.0,
+        # the decomposition must not change the math: both arms see the
+        # same data, so their losses agree to dtype tolerance
+        loss_abs_diff=round(abs(off_loss - on_loss), 6),
+    )
+    for name, hp, ms in (("off", hp_off, off_ms), ("on", hp_on, on_ms)):
+        stat = StepStats(cfg, bsz, seq, hp=hp).per_iter(ms)
+        if stat.get("bubble_fraction") is not None:
+            extra[f"bubble_fraction_{name}"] = stat["bubble_fraction"]
+            extra[f"comm_wait_ms_{name}"] = stat["comm_wait_ms"]
+    emit(metric, round(on_ms, 4), "ms", **extra)
+
+
+def tp_overlap_metrics(smoke: bool):
+    """Collective-matmul on/off (DESIGN.md "Overlap"): the same uniform
+    tp+sp train step with the ops/collective_matmul decomposition on vs
+    off. On single-device hosts (CI CPU) both arms take the plain-einsum
+    fallback and the delta reads ~0 — the line still emits."""
+    from galvatron_tpu.core.strategy import HybridParallelConfig
+    from galvatron_tpu.models.modeling import ModelConfig
+
+    world = jax.device_count()
+    tp = world if world & (world - 1) == 0 else 1
+    seq = 128 if smoke else 2048
+    bsz = max(2, world) if smoke else max(8, world)
+    cfg = ModelConfig(
+        vocab_size=512 if smoke else 32000,
+        hidden_size=256 if smoke else 4096,
+        num_layers=2, num_heads=4 if smoke else 32,
+        ffn_dim=1024 if smoke else 11008, max_seq_len=seq,
+        dtype=jnp.bfloat16, param_dtype=jnp.bfloat16, attn_impl="xla",
+    )
+    mk = lambda ov: HybridParallelConfig.uniform(
+        cfg.num_layers, tp=tp, sp=(tp > 1), tp_overlap=ov,
+    )
+    _overlap_pair(
+        cfg, mk(False), mk(True), "overlap_collective_matmul_train_step_ms",
+        bsz, seq, iters=3 if smoke else 10, tp=tp,
+    )
+
+
+def grad_overlap_metrics(smoke: bool):
+    """Async ZeRO gradient overlap on/off: uniform zero2 train step with
+    per-layer backward reduce-scatter pinning (sharding.overlap_grad_sync)
+    on vs off. Single-device arms are both no-ops (delta ~0, line emits)."""
+    from galvatron_tpu.core.strategy import HybridParallelConfig
+    from galvatron_tpu.models.modeling import ModelConfig
+
+    world = jax.device_count()
+    seq = 128 if smoke else 2048
+    bsz = max(2, world) if smoke else max(8, world)
+    cfg = ModelConfig(
+        vocab_size=512 if smoke else 32000,
+        hidden_size=256 if smoke else 4096,
+        num_layers=2, num_heads=4 if smoke else 32,
+        ffn_dim=1024 if smoke else 11008, max_seq_len=seq,
+        dtype=jnp.bfloat16, param_dtype=jnp.bfloat16, attn_impl="xla",
+    )
+    mk = lambda ov: HybridParallelConfig.uniform(
+        cfg.num_layers, dp_type="zero2", grad_overlap=ov,
+    )
+    _overlap_pair(
+        cfg, mk(False), mk(True), "overlap_grad_sync_train_step_ms",
+        bsz, seq, iters=3 if smoke else 10, world=world,
+    )
+
+
 def main():
     from galvatron_tpu.models.modeling import ModelConfig
 
     smoke = "--smoke" in sys.argv
+    _ENV.update(_env_provenance())
     bsz, seq = (2, 128) if smoke else (8, 2048)
     base = ModelConfig(
         vocab_size=512 if smoke else 32000,
@@ -387,6 +514,21 @@ def main():
             "llama7b_shape_fwdbwd_ms_per_layer_per_sample_bf16",
             0, "ms", skipped=f"{type(e).__name__}: {e}"[:200],
         )
+
+    # overlap push (DESIGN.md "Overlap"): paired on/off deltas for the
+    # collective-matmul decomposition and the async ZeRO grad reduce-scatter.
+    # Failure-isolated PER SECTION — a tp_overlap regression must not cost
+    # the grad-overlap line, and neither may cost the headline.
+    try:
+        tp_overlap_metrics(smoke)
+    except Exception as e:
+        emit("overlap_collective_matmul_train_step_ms", 0, "ms",
+             skipped=f"{type(e).__name__}: {e}"[:200])
+    try:
+        grad_overlap_metrics(smoke)
+    except Exception as e:
+        emit("overlap_grad_sync_train_step_ms", 0, "ms",
+             skipped=f"{type(e).__name__}: {e}"[:200])
 
     if "--memory" in sys.argv:
         try:

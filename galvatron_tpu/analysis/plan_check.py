@@ -152,6 +152,7 @@ _LAYER_LIST_KEYS = (
     "cp_sizes_enc",
     "cp_impls",
     "ep_sizes_enc",
+    "tp_overlap_flags",
 )
 
 
@@ -632,6 +633,22 @@ def _check_structural(
                     hint=f"enable sp_flags on those layers, set vocab_tp=1, or "
                     "use the gpipe schedule",
                     field=f"sp_flags[{bad[0]}]",
+                    source=source,
+                )
+            )
+
+    # tp_overlap is a TP-seam rewrite: without TP there is no projection
+    # collective to overlap, and the runtime would silently ignore the flag
+    # (the dispatch gates on tp > 1) — a plan carrying it lies about itself
+    for i, s in enumerate(hp.layer_strategies):
+        if s.tp_overlap and s.tp <= 1:
+            out.append(
+                Diagnostic(
+                    "GTA018",
+                    f"layer {i}: tp_overlap_flags is set but tp={s.tp} — there "
+                    "is no TP projection collective to overlap",
+                    hint=f"clear tp_overlap_flags[{i}] or raise tp_sizes_enc[{i}]",
+                    field=f"tp_overlap_flags[{i}]",
                     source=source,
                 )
             )

@@ -158,6 +158,25 @@ def _add_training_args(p: argparse.ArgumentParser):
         "recompute; reference: Megatron --recompute-granularity selective)",
     )
     g.add_argument("--sequence_parallel", type=int, default=0)
+    g.add_argument("--global_tp_overlap", type=int, default=0,
+                   help="1 = decomposed collective-matmul on the TP "
+                   "projection seams of every tp>1 layer "
+                   "(ops/collective_matmul.py): the qkv/MLP-up seq "
+                   "all-gather and the output-projection reduce "
+                   "pipeline behind the GEMM chunks via shard_map/ppermute "
+                   "rings instead of blocking in GSPMD (DESIGN.md 'Overlap')")
+    g.add_argument("--grad_overlap", type=int, default=0,
+                   help="1 = async ZeRO gradient overlap: zero2/zero3 "
+                   "gradient reduce-scatters are pinned per-layer into the "
+                   "backward graph (one bucket per layer, issued as that "
+                   "layer's backward completes) instead of trailing the "
+                   "whole backward (sharding.overlap_grad_sync)")
+    g.add_argument("--xla_overlap", type=str, default="off",
+                   choices=["off", "auto", "aggressive"],
+                   help="curated XLA latency-hiding-scheduler flag set "
+                   "appended to XLA_FLAGS before backend init (TPU only; "
+                   "parallel/mesh.apply_xla_overlap). Recorded in the run "
+                   "manifest and BENCH extra fields for reproducibility")
     g.add_argument("--context_parallel_deg", type=int, default=1)
     g.add_argument("--context_parallel_impl", type=str, default="ring",
                    choices=["ring", "a2a"],
@@ -272,6 +291,11 @@ def _add_search_args(p: argparse.ArgumentParser):
     g.add_argument("--enable_cp", type=int, default=0)
     g.add_argument("--enable_ep", type=int, default=0,
                    help="search expert parallelism (MoE models)")
+    g.add_argument("--enable_tp_overlap", type=int, default=0,
+                   help="enumerate the collective-matmul tp_overlap variant "
+                   "on tp>1 layers (doubles those cells of the space; the "
+                   "cost model prices the overlapped tp time at "
+                   "TP_OVERLAP_RESIDUAL)")
     g.add_argument("--max_ep_deg", type=int, default=8)
     g.add_argument("--max_tp_deg", type=int, default=8)
     g.add_argument("--max_vpp_deg", type=int, default=1,
@@ -699,6 +723,8 @@ def hybrid_config_from_args(ns: argparse.Namespace, num_layers: int, world: int)
             sp=bool(ns.sequence_parallel),
             cp=ns.context_parallel_deg,
             cp_impl=ns.context_parallel_impl,
+            tp_overlap=bool(getattr(ns, "global_tp_overlap", 0)),
+            grad_overlap=bool(getattr(ns, "grad_overlap", 0)),
             chunks=chunks,
             pipeline_type=ns.pipeline_type,
             vocab_tp=ns.vocab_tp,

@@ -11,6 +11,14 @@ sharded-update / all-gather(param) pattern ZeRO hand-implements.
 A hand-rolled optimizer (rather than optax) keeps the state structure
 transparent for per-leaf sharding and for the search engine's memory cost
 model (4×param model states, reference: galvatron/core/cost_model.py:31).
+
+With ``HybridParallelConfig.grad_overlap`` on, ZeRO-2/3 gradients arrive
+here already reduce-scattered per layer: sharding.overlap_grad_sync pins
+each layer's gradient cotangent to the opt-state spec during backward, so
+XLA issues the reduce-scatter as soon as that layer's backward finishes
+instead of in one trailing block. Nothing in this module changes — the
+update math is elementwise and sharding-agnostic; only the timing of the
+collectives moves.
 """
 
 from __future__ import annotations

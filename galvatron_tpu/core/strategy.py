@@ -71,6 +71,12 @@ class LayerStrategy:
       ep: expert-parallel degree for MoE layers — experts sharded over the
         minor data-parallel axes (reference EP groups: site_package/megatron/
         core/parallel_state.py:450-478; SwitchMLP transformer.py:161-295).
+      tp_overlap: decomposed collective-matmul on the TP projection seams —
+        the qkv/MLP-up all-gather and the output-projection reduce-scatter
+        are pipelined against the matmul via shard_map/ppermute
+        (ops/collective_matmul.py; Wang et al., ASPLOS'23) instead of left
+        to GSPMD as blocking collectives. Only meaningful with tp>1 — the
+        plan checker rejects tp_overlap on tp==1 layers (GTA018).
     """
 
     tp: int = 1
@@ -81,6 +87,7 @@ class LayerStrategy:
     cp: int = 1
     ep: int = 1
     cp_impl: str = "ring"
+    tp_overlap: bool = False
 
     def __post_init__(self):
         try:
@@ -141,6 +148,14 @@ class HybridParallelConfig:
     # accounting"): 'policy' (default — one gate save per layer, fp32
     # widenings rematerialized) | 'gate' (product-only remat) | 'off'
     mlp_recompute: str = "policy"
+    # async ZeRO gradient overlap: pin each zero2/zero3 layer's parameter
+    # cotangents to their reduce-scattered (opt-state) sharding AT THE LAYER'S
+    # POINT in the backward graph (parallel/sharding.overlap_grad_sync), so
+    # GSPMD issues one gradient reduce-scatter bucket per layer as its
+    # backward completes — overlappable with the next layer's dgrad compute —
+    # instead of a trailing blob after the whole backward. No numeric effect;
+    # layout/schedule only (DESIGN.md "Overlap").
+    grad_overlap: bool = False
 
     def __post_init__(self):
         if self.pipeline_type not in ("gpipe", "pipedream_flush"):
@@ -240,6 +255,7 @@ class HybridParallelConfig:
             "cp_sizes_enc": ",".join(str(s.cp) for s in ls),
             "cp_impls": ",".join(s.cp_impl for s in ls),
             "ep_sizes_enc": ",".join(str(s.ep) for s in ls),
+            "tp_overlap_flags": ",".join(str(int(s.tp_overlap)) for s in ls),
             "pp_division": ",".join(str(n) for n in (self.pp_division or [])),
             "chunks": self.chunks,
             "pipeline_type": self.pipeline_type,
@@ -249,6 +265,7 @@ class HybridParallelConfig:
             "default_dp_type": self.default_dp_type,
             "mixed_precision": self.mixed_precision,
             "mlp_recompute": self.mlp_recompute,
+            "grad_overlap": int(self.grad_overlap),
         }
 
     @classmethod
@@ -274,6 +291,7 @@ class HybridParallelConfig:
         cp_impls = d.get("cp_impls")
         cp_impls = cp_impls.split(",") if cp_impls else ["ring"] * n
         ep = ints("ep_sizes_enc") or [1] * n
+        tov = ints("tp_overlap_flags") or [0] * n
         strategies = [
             LayerStrategy(
                 tp=tps[i],
@@ -284,6 +302,7 @@ class HybridParallelConfig:
                 cp=cp[i],
                 cp_impl=cp_impls[i],
                 ep=ep[i],
+                tp_overlap=bool(tov[i]),
             )
             for i in range(n)
         ]
@@ -300,6 +319,7 @@ class HybridParallelConfig:
             default_dp_type=default_dp,
             mixed_precision=d.get("mixed_precision", "bf16"),
             mlp_recompute=d.get("mlp_recompute", "policy"),
+            grad_overlap=bool(int(d.get("grad_overlap", 0))),
         )
 
     def save(self, path: str) -> None:
@@ -324,11 +344,12 @@ class HybridParallelConfig:
         cp_impl: str = "ring",
         ep: int = 1,
         tp_consec: bool = True,
+        tp_overlap: bool = False,
         **kw,
     ) -> "HybridParallelConfig":
         s = LayerStrategy(
             tp=tp, tp_consec=tp_consec, dp_type=dp_type, ckpt=ckpt, sp=sp,
-            cp=cp, cp_impl=cp_impl, ep=ep,
+            cp=cp, cp_impl=cp_impl, ep=ep, tp_overlap=tp_overlap,
         )
         return cls(pp=pp, layer_strategies=[s] * num_layers, vocab_tp=kw.pop("vocab_tp", tp), **kw)
 
@@ -380,6 +401,8 @@ def form_strategy(s: LayerStrategy, pp: int = 1, dp: int = 1) -> str:
         tag += "*"
     if s.sp:
         tag += "s"
+    if s.tp_overlap:
+        tag += "o"
     if s.cp > 1:
         tag += (f"r{s.cp}" if s.cp_impl == "ring" else f"u{s.cp}")
     if s.ckpt == "full":

@@ -39,6 +39,14 @@ from galvatron_tpu.profiling.runtime import RuntimeProfiler
 def train(ns: argparse.Namespace, verbose: bool = True) -> dict:
     from galvatron_tpu.obs import tracing as obs_tracing
 
+    # --xla_overlap: the curated latency-hiding flag set must land in
+    # XLA_FLAGS before _train_impl's first backend touch (distributed init,
+    # mesh build) — a later append would be silently ignored by the already-
+    # initialized runtime. The applied set rides the manifest fingerprint.
+    from galvatron_tpu.parallel.mesh import apply_xla_overlap
+
+    ns.xla_overlap_applied = apply_xla_overlap(getattr(ns, "xla_overlap", "off"))
+
     # span tracer lifecycle wrapper: enable happens out here so that a
     # setup failure ANYWHERE in _train_impl (corrupt restore, loader build,
     # sidecar bind, ...) cannot leak the enabled process-wide singleton into
@@ -247,6 +255,10 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
         "mesh_axes": [str(a) for a in rt.mesh.axis_names],
         "plan_hash": plan_hash(hp),
         "global_bsz": int(ns.global_train_batch_size),
+        # scheduler provenance (--xla_overlap): mode + the flags actually
+        # appended, so a perf delta across manifests is attributable
+        "xla_overlap": getattr(ns, "xla_overlap", "off"),
+        "xla_overlap_flags": list(getattr(ns, "xla_overlap_applied", []) or []),
     }
     # AOT compile subsystem (galvatron_tpu/aot; DESIGN.md § AOT compile
     # subsystem): an explicit --compile_cache_dir arms the startup consult —
@@ -878,6 +890,11 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                         if metrics.path or train_obs is not None
                         else {}
                     )
+                    if stat.get("comm_wait_ms") is not None:
+                        step_sp.set(
+                            comm_wait_ms=stat["comm_wait_ms"],
+                            bubble_fraction=stat["bubble_fraction"],
+                        )
                     if metrics.path:
                         metrics.log(
                             "train_iter", step=it,
@@ -903,6 +920,10 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                             train_obs.tflops_per_device = stat.get("tflops_per_device")
                             train_obs.mfu = stat.get("mfu")
                             train_obs.hfu = stat.get("hfu")
+                            train_obs.comm_wait_ms = stat.get("comm_wait_ms")
+                            train_obs.bubble_fraction = stat.get(
+                                "bubble_fraction"
+                            )
                             train_obs.packing_efficiency = stat.get(
                                 "packing_efficiency"
                             )

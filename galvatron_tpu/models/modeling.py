@@ -115,6 +115,16 @@ class ModelConfig:
     # jnp ops GSPMD can partition); a real-TPU topology AOT compile does
     # (tests/test_topology_aot.py). None → direct call (single device).
     flash_shard_ctx: Optional[Any] = None
+    # (mesh, dp_axes, tp_axes, sp) installed by the layer hooks for tp>1
+    # layers whose plan sets tp_overlap (core/strategy.LayerStrategy): the
+    # column-parallel projections (_proj_up: qkv, MLP gate/up) route through
+    # ops.collective_matmul.allgather_einsum on sp layers — the blocking
+    # GSPMD seq all-gather becomes a ppermute ring pipelined behind the GEMM
+    # chunks — and the row-parallel projections (_proj_down: wo, w2) through
+    # einsum_reducescatter, which pipelines the trailing all-reduce /
+    # reduce-scatter as an accumulator ring. None → plain einsums (GSPMD
+    # inserts the blocking collectives).
+    tp_overlap_ctx: Optional[Any] = None
     # vision families (reference legacy vit/swin model_type branches,
     # galvatron/core/parallel.py:64-89, cost_model.py:76,87-106).
     # image_size > 0 switches the input pipeline from token ids to uint8
@@ -723,6 +733,13 @@ def attention_xla(q, k, v, cfg: ModelConfig, bias=None, q_offset=0, seg_ids=None
     a row holding a single segment produces a bit-identical mask, which is
     what makes the packed-vs-padded gradient-parity test exact."""
     b, s, nh, hd = q.shape
+    if s == 1 and bias is None and seg_ids is None and cfg.causal:
+        # KV-cache decode: skip the _repeat_kv materialization and the
+        # (b, n, 1, k) score reshuffle — the GQA-native dot-product path
+        # reads the cache once (tests/test_flash_attention.py parity case)
+        from galvatron_tpu.ops.flash_attention import decode_attention
+
+        return decode_attention(q, k, v, q_offset=q_offset)
     k = _repeat_kv(k, nh // k.shape[2])
     v = _repeat_kv(v, nh // v.shape[2])
     scores = jnp.einsum("bqnh,bknh->bnqk", q, k).astype(jnp.float32) / np.sqrt(hd)
@@ -832,6 +849,43 @@ def _flash_shard_map(cfg: ModelConfig, fn, arg_dims, out_dims):
     return wrapped
 
 
+def _proj_up(subscripts, x, w, cfg: ModelConfig, w_shard_dim: int):
+    """Column-parallel projection einsum (qkv, MLP gate/up). With
+    tp_overlap_ctx installed and the layer sequence-parallel, ``x`` arrives
+    seq-sharded over the tp axes and the GSPMD-inserted blocking seq
+    all-gather is replaced by the decomposed all-gather⊗matmul ring
+    (ops.collective_matmul). Non-sp layers keep the plain einsum — x is
+    already tp-replicated, there is no gather to overlap."""
+    if cfg.tp_overlap_ctx is None:
+        return jnp.einsum(subscripts, x, w)
+    from galvatron_tpu.ops import collective_matmul as cm
+
+    mesh, dp_ax, tp_ax, sp = cfg.tp_overlap_ctx
+    if not sp:
+        return jnp.einsum(subscripts, x, w)
+    return cm.allgather_einsum(
+        subscripts, x, w, mesh=mesh, dp_axes=dp_ax, tp_axes=tp_ax,
+        w_shard_dim=w_shard_dim,
+    )
+
+
+def _proj_down(subscripts, x, w, cfg: ModelConfig, w_shard_dim: int):
+    """Row-parallel projection einsum (wo, MLP down). With tp_overlap_ctx
+    installed the trailing TP reduction is pipelined as the accumulator-ring
+    reduce-scatter⊗matmul (ops.collective_matmul): sp layers keep the
+    seq-scattered output layout; non-sp layers gather it back (the reduce
+    half of the all-reduce still overlaps)."""
+    if cfg.tp_overlap_ctx is None:
+        return jnp.einsum(subscripts, x, w)
+    from galvatron_tpu.ops import collective_matmul as cm
+
+    mesh, dp_ax, tp_ax, sp = cfg.tp_overlap_ctx
+    return cm.einsum_reducescatter(
+        subscripts, x, w, mesh=mesh, dp_axes=dp_ax, tp_axes=tp_ax,
+        w_shard_dim=w_shard_dim, scatter_output=bool(sp),
+    )
+
+
 def _constrain_qkv(qkv, cfg: ModelConfig):
     """Pin the stacked (b, 3, n, s, d) qkv (and, via the vjp transpose, its
     dqkv cotangent) to (dp, -, tp, -, -) when the layer hook installed
@@ -880,7 +934,7 @@ def _attn_block_headmajor(x, p, cfg: ModelConfig, rope, remat_attn: bool):
     n = cfg.num_heads
     w = p["wqkv"].astype(x.dtype)
     if cfg.qkv_blocked:
-        qkv = jnp.einsum("bsh,hcnd->bcnsd", x, w.reshape(h, 3, n, hd))
+        qkv = _proj_up("bsh,hcnd->bcnsd", x, w.reshape(h, 3, n, hd), cfg, w_shard_dim=2)
         if "wqkv_b" in p:
             qkv = qkv + p["wqkv_b"].astype(x.dtype).reshape(3, n, hd)[None, :, :, None, :]
         qkv = _constrain_qkv(qkv, cfg)
@@ -900,8 +954,9 @@ def _attn_block_headmajor(x, p, cfg: ModelConfig, rope, remat_attn: bool):
             if remat_attn:
                 core_qkv = jax.checkpoint(core_qkv)
             o = _constrain_attn_out(core_qkv(qkv), cfg)
-            y = jnp.einsum(
-                "bnsd,nde->bse", o, p["wo"].astype(x.dtype).reshape(n, hd, h)
+            y = _proj_down(
+                "bnsd,nde->bse", o, p["wo"].astype(x.dtype).reshape(n, hd, h),
+                cfg, w_shard_dim=0,
             )
             if "wo_b" in p:
                 y = y + p["wo_b"].astype(x.dtype)
@@ -955,7 +1010,10 @@ def _attn_block_headmajor(x, p, cfg: ModelConfig, rope, remat_attn: bool):
     if remat_attn:
         core = jax.checkpoint(core)
     o = _constrain_attn_out(core(q, k, v), cfg)
-    y = jnp.einsum("bnsd,nde->bse", o, p["wo"].astype(x.dtype).reshape(n, hd, h))
+    y = _proj_down(
+        "bnsd,nde->bse", o, p["wo"].astype(x.dtype).reshape(n, hd, h),
+        cfg, w_shard_dim=0,
+    )
     if "wo_b" in p:
         y = y + p["wo_b"].astype(x.dtype)
     return y
@@ -1014,11 +1072,23 @@ def mlp_block(x, p, cfg: ModelConfig, train: bool = True):
         from galvatron_tpu.models import moe
 
         return moe.moe_block(x, p, cfg, train=train)
+    # _proj_up/_proj_down only serve the (B, S, H) token stream; vision /
+    # windowed layouts keep the plain matmul (tp_overlap_ctx is token-only)
+    up = (
+        (lambda x_, w_: _proj_up("bsh,hf->bsf", x_, w_, cfg, w_shard_dim=1))
+        if x.ndim == 3
+        else (lambda x_, w_: x_ @ w_)
+    )
+    down = (
+        (lambda x_, w_: _proj_down("bsf,fh->bsh", x_, w_, cfg, w_shard_dim=0))
+        if x.ndim == 3
+        else (lambda x_, w_: x_ @ w_)
+    )
     if cfg.act_fn == "swiglu":
         # fused [w1 | w3] gate GEMM (~3.5 ms/layer-batch over two narrow
         # matmuls on the v5e 7B-shape bench)
         f = p["w13"].shape[-1] // 2
-        g = x @ p["w13"].astype(x.dtype)
+        g = up(x, p["w13"].astype(x.dtype))
         if "w13_b" in p:
             g = g + p["w13_b"].astype(x.dtype)
         g = checkpoint_name(g, "mlp_gate")
@@ -1031,9 +1101,9 @@ def mlp_block(x, p, cfg: ModelConfig, train: bool = True):
             # reach), so the one-gate-save guarantee falls back to the
             # product-only remat here
             prod = jax.checkpoint(prod)
-        y = prod(g) @ p["w2"].astype(x.dtype)
+        y = down(prod(g), p["w2"].astype(x.dtype))
     else:
-        g = x @ p["w1"].astype(x.dtype)
+        g = up(x, p["w1"].astype(x.dtype))
         if "w1_b" in p:
             g = g + p["w1_b"].astype(x.dtype)
         g = checkpoint_name(g, "mlp_gate")
@@ -1044,7 +1114,7 @@ def mlp_block(x, p, cfg: ModelConfig, train: bool = True):
             cfg.mlp_recompute == "policy" and cfg.fused_norm
         ):
             act = jax.checkpoint(act)
-        y = act(g) @ p["w2"].astype(x.dtype)
+        y = down(act(g), p["w2"].astype(x.dtype))
     if "w2_b" in p:
         y = y + p["w2_b"].astype(x.dtype)
     return y

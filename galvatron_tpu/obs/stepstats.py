@@ -181,6 +181,7 @@ class StepStats:
             out: Dict[str, Optional[float]] = {
                 "tokens_per_s": None, "tflops_per_device": None,
                 "mfu": None, "hfu": None,
+                "comm_wait_ms": None, "bubble_fraction": None,
             }
             if nonpad_tokens is not None:
                 out["tokens_per_s_raw"] = None
@@ -202,11 +203,25 @@ class StepStats:
         if nonpad_tokens is not None:
             out["tokens_per_s_raw"] = round(raw_tokens / s, 3)
             out["packing_efficiency"] = round(useful_frac, 6)
+        # comm-wait / bubble accounting (DESIGN.md "Overlap"): the host tracer
+        # cannot see device-side collective stalls, so the aggregate is
+        # derived — ideal_ms is the step's hardware-FLOPs time at peak, and
+        # everything above it is non-compute (collective exposure, launch
+        # gaps, stragglers). Absolute values lean on the analytic FLOPs model;
+        # what the overlap work reads is the paired on/off DELTA on a fixed
+        # shape, where the model error cancels. None on unknown peaks (CPU).
+        out["comm_wait_ms"] = None
+        out["bubble_fraction"] = None
         if self._peak:
             denom = self._peak * self.num_devices
             out["mfu"] = round(flops_rate / denom, 6)
             out["hfu"] = round(
                 useful_frac * scale * self.hardware_flops_per_step / s / denom, 6
+            )
+            ideal_ms = scale * self.hardware_flops_per_step / denom * 1000.0
+            out["comm_wait_ms"] = round(max(0.0, iter_ms - ideal_ms), 3)
+            out["bubble_fraction"] = round(
+                max(0.0, 1.0 - ideal_ms / iter_ms), 6
             )
         return out
 

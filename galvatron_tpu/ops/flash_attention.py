@@ -1078,6 +1078,18 @@ def flash_attention(
     b, s, n, d = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(d))
+    if s == 1:
+        # one query row: the tiled kernels degenerate to block 1 with zero
+        # reuse — the dot-product decode path is exact and cheaper. With a
+        # single same-length key, causal and full masks coincide.
+        if rope is not None:
+            from galvatron_tpu.models import modeling
+
+            q = modeling.apply_rope(q, *rope)
+            k = modeling.apply_rope(k, *rope)
+        return decode_attention(
+            q, k, v, q_offset=k.shape[1] - 1, sm_scale=sm_scale
+        )
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if not flash_tileable(s, block_q) or not flash_tileable(s, block_k):
@@ -1096,3 +1108,37 @@ def flash_attention(
     vt = jnp.transpose(v, (0, 2, 1, 3))
     out = _flash(qt, kt, vt, rope, sm_scale, causal, block_q, block_k)
     return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def decode_attention(q, k, v, q_offset=0, sm_scale=None):
+    """Single-query attention for KV-cache decode (q_len == 1).
+
+    q: (B, 1, n, d); k/v: (B, S, kv, d), n % kv == 0. Flash tiling buys
+    nothing for one query row — there is no q x k tile reuse, and the
+    (block_q, block_k) kernels cannot even launch on q_len 1. The decode
+    step is a pure dot-product: two einsums and a masked fp32 softmax.
+
+    GQA-native: kv heads are NOT repeated. The group dim ``g = n // kv``
+    rides inside the einsum (q reshaped head-dim (kv, g), kv-major to match
+    modeling._repeat_kv's interleave), so the KV cache — the dominant HBM
+    traffic of a decode step — is read once instead of materialized g x.
+
+    ``q_offset``: absolute position of the query token, scalar or (B,)
+    (continuous batching: each slot at its own depth). Keys at positions
+    > offset are masked; cache tails past the write point never leak in.
+    """
+    b, q_len, n, d = q.shape
+    assert q_len == 1, f"decode_attention requires q_len == 1, got {q_len}"
+    kv = k.shape[2]
+    g = n // kv
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    qg = q[:, 0].reshape(b, kv, g, d)
+    scores = jnp.einsum("bkgh,bskh->bkgs", qg, k).astype(jnp.float32)
+    scores = scores * sm_scale
+    k_pos = jnp.arange(k.shape[1])
+    allowed = k_pos[None] <= jnp.reshape(jnp.asarray(q_offset), (-1, 1))
+    scores = jnp.where(allowed[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgs,bskh->bkgh", probs, v)
+    return out.reshape(b, 1, n, d)

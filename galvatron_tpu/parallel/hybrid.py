@@ -53,9 +53,11 @@ from galvatron_tpu.parallel.mesh import (
 from galvatron_tpu.parallel.sharding import (
     constrain,
     cp_shard_axes,
+    overlap_grad_sync,
     param_spec,
     sharding_tree,
     with_flash_shard_ctx,
+    with_tp_overlap_ctx,
 )
 
 
@@ -175,6 +177,11 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
     """Per-layer execution hook: sharding-constraint boundary (redistribution)
     + optional remat (checkpoint_wrapper) + ring-attention dispatch."""
 
+    # async ZeRO gradient overlap (sharding.overlap_grad_sync): the hook pins
+    # each zero2/zero3 layer's param cotangents to their reduce-scattered
+    # sharding, so the per-layer gradient buckets issue during backward
+    grad_annots = modeling.model_annotations(cfg) if hp.grad_overlap else None
+
     def hook(i: int, x, lp, enc_out=None, seg_ids=None):
         s = hp.layer_strategies[i]
         x = constrain(x, mesh, activation_spec(axes, s))
@@ -213,6 +220,9 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
         # Mosaic kernels cannot be auto-partitioned by GSPMD — see
         # sharding.with_flash_shard_ctx / modeling._flash_shard_map
         layer_cfg = with_flash_shard_ctx(layer_cfg, s, mesh, axes)
+        # decomposed collective-matmul on the TP projection seams — see
+        # sharding.with_tp_overlap_ctx / ops.collective_matmul
+        layer_cfg = with_tp_overlap_ctx(layer_cfg, s, mesh, axes)
         if layer_cfg.pos_embed == "rope":
             # packed rows: per-segment position reset → per-row gathered tables
             cos_sin = (
@@ -230,6 +240,13 @@ def _make_layer_hook(cfg: ModelConfig, hp: HybridParallelConfig, mesh: Mesh, axe
             else None
         )
         is_encoder = cfg.enc_layers > 0 and i < cfg.enc_layers
+        if grad_annots is not None and s.dp_type in ("zero2", "zero3"):
+            la = (
+                grad_annots["enc_layers"][i]
+                if is_encoder
+                else grad_annots["layers"][i - cfg.enc_layers]
+            )
+            lp = overlap_grad_sync(lp, la, mesh, axes, s)
 
         def run(x_, lp_):
             if cfg.swin_depths:

@@ -78,6 +78,7 @@ from galvatron_tpu.parallel.sharding import (
     param_spec,
     sharding_tree,
     with_flash_shard_ctx,
+    with_tp_overlap_ctx,
 )
 
 def cpu_sim_compiler_options(mesh=None):
@@ -395,6 +396,7 @@ def make_block_fn(
                     attn_out_shard_ctx=(mesh, axes.dp_axes(s.tp, s.tp_consec, s.cp))
                 )
             layer_cfg = with_flash_shard_ctx(layer_cfg, s, mesh, axes)
+            layer_cfg = with_tp_overlap_ctx(layer_cfg, s, mesh, axes)
 
             def run(x_, lp_):
                 if s.cp > 1:

@@ -60,6 +60,7 @@ from galvatron_tpu.parallel.sharding import (
     param_spec,
     sharding_tree,
     with_flash_shard_ctx,
+    with_tp_overlap_ctx,
 )
 
 
@@ -323,6 +324,7 @@ def _make_section_fns(cfg: ModelConfig, hp: HybridParallelConfig, mesh, axes):
         for q, s in enumerate(enc_pos):
             x = constrain(x, mesh, act_spec(s))
             lcfg = with_flash_shard_ctx(cfg, s, mesh, axes)
+            lcfg = with_tp_overlap_ctx(lcfg, s, mesh, axes)
             if s.ckpt == "full" and lcfg.mlp_recompute != "off":
                 # full-layer remat subsumes the gate-save policy
                 lcfg = lcfg.replace(mlp_recompute="off")
@@ -345,6 +347,7 @@ def _make_section_fns(cfg: ModelConfig, hp: HybridParallelConfig, mesh, axes):
         for q, s in enumerate(dec_pos):
             x = constrain(x, mesh, act_spec(s))
             lcfg = with_flash_shard_ctx(cfg, s, mesh, axes)
+            lcfg = with_tp_overlap_ctx(lcfg, s, mesh, axes)
             if s.ckpt == "full" and lcfg.mlp_recompute != "off":
                 lcfg = lcfg.replace(mlp_recompute="off")
             run = lambda x_, lp_, lcfg=lcfg: modeling.decoder_layer(

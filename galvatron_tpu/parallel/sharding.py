@@ -22,6 +22,7 @@ shards (at most one per param is honored, the first divisible one).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
@@ -137,6 +138,66 @@ def with_flash_shard_ctx(layer_cfg, s: LayerStrategy, mesh: Mesh, axes: MeshAxes
             axes.tp_axes(s.tp, s.tp_consec),
         )
     )
+
+
+def with_tp_overlap_ctx(layer_cfg, s: LayerStrategy, mesh: Mesh, axes: MeshAxes):
+    """Install ``tp_overlap_ctx`` on a layer's ModelConfig when the plan sets
+    ``tp_overlap`` (decomposed collective-matmul on the TP projection seams —
+    see ops/collective_matmul.py and modeling._proj_up/_proj_down). Shared by
+    every engine, like with_flash_shard_ctx. cp>1 layers are excluded — the
+    ring/ulysses paths own their projection seams."""
+    if (
+        not getattr(s, "tp_overlap", False)
+        or s.tp <= 1
+        or mesh.devices.size <= 1
+        or s.cp > 1
+    ):
+        return layer_cfg
+    return layer_cfg.replace(
+        tp_overlap_ctx=(
+            mesh,
+            axes.dp_axes(s.tp, s.tp_consec, s.cp),
+            axes.tp_axes(s.tp, s.tp_consec),
+            bool(s.sp),
+        )
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _grad_shard(x, mesh, spec):
+    return x
+
+
+def _grad_shard_fwd(x, mesh, spec):
+    return x, None
+
+
+def _grad_shard_bwd(mesh, spec, _res, g):
+    return (constrain(g, mesh, spec),)
+
+
+_grad_shard.defvjp(_grad_shard_fwd, _grad_shard_bwd)
+
+
+def overlap_grad_sync(params, annots, mesh: Mesh, axes: MeshAxes, s: LayerStrategy):
+    """Async ZeRO gradient overlap: identity on ``params``, but each leaf's
+    COTANGENT is pinned to its reduce-scattered (opt-state) sharding at the
+    layer's point in the backward graph. Without the pin GSPMD is free to
+    defer every zero2/zero3 gradient reduce-scatter to the jit output
+    boundary — one trailing blob after the whole backward; with it, each
+    layer's bucket is issued as its backward completes and overlaps the next
+    layer's dgrad compute (the ZeRO overlap, Rajbhandari et al.). Applied by
+    the pp=1 layer hook when HybridParallelConfig.grad_overlap is set."""
+    if s.dp_type not in ("zero2", "zero3"):
+        return params
+
+    def leaf(p, a):
+        spec = param_spec(p.shape, a, axes, s, for_opt_state=True)
+        if all(e is None for e in spec):
+            return p
+        return _grad_shard(p, mesh, spec)
+
+    return jax.tree.map(leaf, params, annots, is_leaf=lambda x: hasattr(x, "shape"))
 
 
 def cp_shard_axes(s: LayerStrategy, axes: MeshAxes) -> dict:

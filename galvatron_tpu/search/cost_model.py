@@ -586,6 +586,14 @@ def other_time_cost(
 # recompute — re-measure in ONE place.
 REMAT_FULL_FACTOR = 3.85
 REMAT_SELECTIVE_FACTOR = 3.25
+# Residual fraction of the blocking TP-collective time that survives when the
+# layer runs the decomposed collective-matmul (s.tp_overlap — ops/
+# collective_matmul.py): the ring hides T-1 of T hops behind the GEMM chunks,
+# leaving the first hop, the per-chunk launch overhead, and (non-sp) the
+# output-gather half exposed. ASPLOS'23 (Wang et al.) reports 60-80% of the
+# collective hidden on TPU ICI for transformer projection shapes; priced
+# conservatively until a measured profile replaces it.
+TP_OVERLAP_RESIDUAL = 0.4
 
 
 def layer_time_cost(
@@ -644,6 +652,10 @@ def layer_time_cost(
     tp_ms = 4.0 * _allreduce_ms(act_msg, s.tp, tp_bw)
     if s.ckpt == "full" or recompute_factor is not None:
         tp_ms *= 1.5  # forward-replay schedules replay the fwd collectives
+    if s.tp_overlap and s.tp > 1:
+        # decomposed collective-matmul pipelines the projection collectives
+        # behind the GEMM chunks — only the residual exposure is priced
+        tp_ms *= TP_OVERLAP_RESIDUAL
     # (selective recompute replays no TP collectives: the attention core sits
     # between the column- and row-parallel linears)
     # CP: the ring rotates K/V cp-1 hops per pass (the diagonal hop is

@@ -55,6 +55,11 @@ class SearchSpace:
     allow_zero3: bool = True
     allow_strided: bool = True
     allow_cp: bool = False
+    # decomposed collective-matmul on the TP projection seams as a searched
+    # dimension (LayerStrategy.tp_overlap; cost_model.TP_OVERLAP_RESIDUAL
+    # prices the hidden collective). Opt-in: it doubles the tp>1 candidate
+    # count and only helps where the projection collectives are exposed.
+    allow_tp_overlap: bool = False
     # expert parallelism as a searched dimension (MoE models; the reference
     # carries SwitchMLP but never searches EP — SURVEY §2.3 ⚠). ep candidates
     # ∈ powers of two up to the dp extent (and max_ep) that divide
@@ -155,17 +160,21 @@ def generate_layer_strategies(space: SearchSpace, pp: int) -> List[LayerStrategy
                 and (space.max_ep is None or e <= space.max_ep)
                 and space.moe_experts % e == 0
             ]
-        for consec, sp, dpt, cp, ep in itertools.product(
-            consec_opts, sp_opts, dp_types, cp_opts, ep_opts
+        tov_opts = [False, True] if (space.allow_tp_overlap and tp > 1) else [False]
+        for consec, sp, dpt, cp, ep, tov in itertools.product(
+            consec_opts, sp_opts, dp_types, cp_opts, ep_opts, tov_opts
         ):
             if cp > 1 and sp:
                 continue
             if cp > 1 and ep > 1:  # they share mesh axes (strategy.validate)
                 continue
+            if cp > 1 and tov:  # cp layers own their projection seams
+                continue
             for ckpt in [False, True] if space.allow_ckpt else [False]:
                 out.append(
                     LayerStrategy(
-                        tp=tp, tp_consec=consec, dp_type=dpt, ckpt=ckpt, sp=sp, cp=cp, ep=ep
+                        tp=tp, tp_consec=consec, dp_type=dpt, ckpt=ckpt, sp=sp,
+                        cp=cp, ep=ep, tp_overlap=tov,
                     )
                 )
     return out

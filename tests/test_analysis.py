@@ -189,12 +189,20 @@ def _case_gta016():
     )
 
 
+def _case_gta018():
+    ls = [LayerStrategy(tp=2, tp_overlap=True), LayerStrategy(tp=1, tp_overlap=True)]
+    return (
+        dict(plan=HybridParallelConfig(layer_strategies=ls), world_size=8),
+        "GTA018", "tp_overlap_flags[1]",
+    )
+
+
 _CASES = [
     _case_gta001, _case_gta002, _case_gta002_length, _case_gta003,
     _case_gta004, _case_gta005, _case_gta006, _case_gta007, _case_gta008,
     _case_gta009, _case_gta009_dp, _case_gta010, _case_gta011, _case_gta012,
     _case_gta013, _case_gta014, _case_gta015, _case_gta015_recorded,
-    _case_gta016,
+    _case_gta016, _case_gta018,
 ]
 
 

@@ -239,6 +239,59 @@ def test_peak_flops_env_override(monkeypatch):
     assert stepstats.peak_flops_per_device() is None
 
 
+def test_bubble_accounting(monkeypatch):
+    """comm_wait_ms / bubble_fraction (DESIGN.md § Overlap): derived from
+    the hardware-FLOPs ideal; None when the peak is unknown; clamped at a
+    step faster than the model's ideal (never negative)."""
+    monkeypatch.delenv("GALVATRON_PEAK_TFLOPS", raising=False)
+    cfg = _tiny_cfg()
+    st = stepstats.StepStats(cfg, 8, 32, peak_tflops_override=0.001)
+    ndev = jax.device_count()
+    ideal_ms = st.hardware_flops_per_step / (0.001e12 * ndev) * 1000.0
+    out = st.per_iter(10.0)
+    assert out["comm_wait_ms"] == pytest.approx(max(0.0, 10.0 - ideal_ms), abs=2e-3)
+    assert out["bubble_fraction"] == pytest.approx(
+        max(0.0, 1.0 - ideal_ms / 10.0), abs=1e-4)
+    # a faster-than-ideal measurement clamps to 0, not negative
+    fast = st.per_iter(ideal_ms / 2.0)
+    assert fast["comm_wait_ms"] == 0.0 and fast["bubble_fraction"] == 0.0
+    # unknown peak (CPU, no override): fields present but None
+    out_cpu = stepstats.StepStats(cfg, 8, 32).per_iter(10.0)
+    assert out_cpu["comm_wait_ms"] is None
+    assert out_cpu["bubble_fraction"] is None
+    # and the degenerate iter_ms path carries them too (schema stability)
+    assert st.per_iter(None)["bubble_fraction"] is None
+
+
+def test_apply_xla_overlap_flag_sets(monkeypatch):
+    """--xla_overlap: unknown modes are hard errors; 'off' is a no-op; the
+    TPU-only flag sets never reach XLA_FLAGS on non-TPU backends (the CPU
+    client crashes the process on unknown --xla_tpu_* flags)."""
+    from galvatron_tpu.parallel.mesh import (
+        XLA_OVERLAP_FLAG_SETS, apply_xla_overlap,
+    )
+
+    with pytest.raises(ValueError):
+        apply_xla_overlap("fastest")
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    assert apply_xla_overlap("off") == []
+    # this suite runs on CPU: auto/aggressive must not touch XLA_FLAGS
+    assert apply_xla_overlap("aggressive") == []
+    assert os.environ["XLA_FLAGS"] == "--xla_force_host_platform_device_count=8"
+    # the curated sets are ordered supersets: aggressive ⊃ auto ⊃ off
+    assert set(XLA_OVERLAP_FLAG_SETS["auto"]) < set(
+        XLA_OVERLAP_FLAG_SETS["aggressive"])
+    assert XLA_OVERLAP_FLAG_SETS["off"] == ()
+    # on a TPU-pinned backend the flags append once (idempotent)
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    got = apply_xla_overlap("auto")
+    assert list(XLA_OVERLAP_FLAG_SETS["auto"]) == got
+    assert all(f in os.environ["XLA_FLAGS"] for f in got)
+    before = os.environ["XLA_FLAGS"]
+    apply_xla_overlap("auto")
+    assert os.environ["XLA_FLAGS"] == before
+
+
 # ---------------------------------------------------------------------------
 # Prometheus exposition
 # ---------------------------------------------------------------------------

@@ -117,6 +117,38 @@ def test_strategy_space_generation():
     assert all(s.tp * s.cp <= 2 for s in cands4)
 
 
+def test_tp_overlap_enumeration_and_pricing():
+    """allow_tp_overlap doubles only the tp>1 cells (never tp==1, never
+    cp>1 — the plan checker would reject tp==1 as GTA018), and the cost
+    model prices the overlapped variant strictly cheaper on any layer that
+    pays TP communication."""
+    import dataclasses
+
+    from galvatron_tpu.search.cost_model import (
+        TP_OVERLAP_RESIDUAL, layer_time_cost,
+    )
+
+    space = SearchSpace(world_size=8)
+    base = generate_layer_strategies(space, pp=1)
+    assert not any(s.tp_overlap for s in base)  # opt-in: default space unchanged
+    space.allow_tp_overlap = True
+    cands = generate_layer_strategies(space, pp=1)
+    assert any(s.tp_overlap and s.tp > 1 for s in cands)
+    assert not any(s.tp_overlap and (s.tp == 1 or s.cp > 1) for s in cands)
+    assert 0.0 < TP_OVERLAP_RESIDUAL < 1.0
+    lt, hw = toy_costs().layer_types[0], toy_hw()
+    checked = 0
+    for s in cands:
+        if not (s.tp_overlap and s.tp > 1):
+            continue
+        plain = dataclasses.replace(s, tp_overlap=False)
+        t_ov = layer_time_cost(lt, s, hw, world=8, pp=1, global_bsz=8)
+        t_plain = layer_time_cost(lt, plain, hw, world=8, pp=1, global_bsz=8)
+        assert t_ov < t_plain, (s, t_ov, t_plain)
+        checked += 1
+    assert checked > 0
+
+
 def test_tight_budget_forces_sharded_strategies():
     """With a generous budget the search picks plain DP (fastest by the cost
     model); squeezing the budget must move it to ZeRO/TP/ckpt strategies."""

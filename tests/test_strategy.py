@@ -24,12 +24,13 @@ def test_json_roundtrip(tmp_path):
     strategies = [
         LayerStrategy(tp=1, dp_type="zero3", ckpt=True),
         LayerStrategy(tp=2, tp_consec=False, dp_type="ddp"),
-        LayerStrategy(tp=4, dp_type="zero2", sp=True),
+        LayerStrategy(tp=4, dp_type="zero2", sp=True, tp_overlap=True),
         LayerStrategy(tp=2, cp=2),
     ]
     hp = HybridParallelConfig(
         pp=2, layer_strategies=strategies, chunks=4,
         pipeline_type="pipedream_flush", vocab_tp=2, default_dp_type="zero2",
+        grad_overlap=True,
     )
     path = tmp_path / "cfg.json"
     hp.save(str(path))
@@ -44,7 +45,25 @@ def test_json_roundtrip(tmp_path):
     assert [s.ckpt for s in hp2.layer_strategies] == ["full", False, False, False]
     assert [s.sp for s in hp2.layer_strategies] == [False, False, True, False]
     assert [s.cp for s in hp2.layer_strategies] == [1, 1, 1, 2]
+    assert [s.tp_overlap for s in hp2.layer_strategies] == [False, False, True, False]
+    assert hp2.grad_overlap is True
     assert hp2.pp_division == hp.pp_division
+    # overlap terms are SEMANTIC: two plans differing only in them must not
+    # collide in the plan-keyed compile-artifact cache
+    from galvatron_tpu.core.strategy import plan_hash
+
+    assert plan_hash(hp) != plan_hash(
+        HybridParallelConfig(
+            pp=2, layer_strategies=[
+                LayerStrategy(tp=1, dp_type="zero3", ckpt=True),
+                LayerStrategy(tp=2, tp_consec=False, dp_type="ddp"),
+                LayerStrategy(tp=4, dp_type="zero2", sp=True),
+                LayerStrategy(tp=2, cp=2),
+            ], chunks=4,
+            pipeline_type="pipedream_flush", vocab_tp=2,
+            default_dp_type="zero2",
+        )
+    )
 
 
 def test_ckpt_modes():
