@@ -102,6 +102,20 @@ def _drive(port, clients, requests_per_client, tokens, prompt_len):
     return wall, total_tokens, lats
 
 
+def _hist_quantile(snap, q):
+    """Upper-bound quantile from a cumulative-bucket histogram snapshot
+    (the standard bucketed estimate a Prometheus histogram_quantile makes):
+    the smallest bucket bound whose cumulative count covers q."""
+    total = snap.get("count", 0)
+    if not total:
+        return None
+    target = q * total
+    for b in sorted((k for k in snap["buckets"] if k != "+Inf"), key=float):
+        if snap["buckets"][b] >= target:
+            return float(b)
+    return float("inf")
+
+
 def _pct(xs, q):
     return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))] if xs else None
 
@@ -312,6 +326,25 @@ def run_fleet_overload(ns):
         lats.sort()
         ttft_p99s = [r["ttft_p99_s"] for r in router.health()["replica"]
                      if r.get("ttft_p99_s")]
+        # fleet-level TTFT from the aggregation path the router's /metrics
+        # actually serves: per-replica cumulative buckets summed into ONE
+        # histogram (quantile gauges don't aggregate — the max-of-p99s
+        # above is a bound, the merged-bucket read is the fleet p99) — and
+        # the same scrape must pass the CI exposition linter
+        from galvatron_tpu.obs.aggregate import exposition_lint
+        from galvatron_tpu.obs.prom import fleet_metrics_text
+        from galvatron_tpu.utils.metrics import Histogram
+
+        lint_errors = exposition_lint(fleet_metrics_text(router))
+        hist_snaps = []
+        for r in router.replicas:
+            s = (r.last_health.get("serving") or {})
+            if s.get("ttft_hist"):
+                hist_snaps.append(s["ttft_hist"])
+        fleet_hist_p99 = (
+            _hist_quantile(Histogram.merge_snapshots(hist_snaps), 0.99)
+            if hist_snaps else None
+        )
         tr = threading.Thread(target=trickle, daemon=True)
         tr.start()
         roll = router.rolling_drain()
@@ -337,6 +370,10 @@ def run_fleet_overload(ns):
                 outcomes["served"] * ns.tokens / wall, 3),
             "ttft_p99_s_served_max_replica": (
                 round(max(ttft_p99s), 4) if ttft_p99s else None),
+            "ttft_p99_s_fleet_hist": (
+                round(fleet_hist_p99, 4)
+                if fleet_hist_p99 not in (None, float("inf")) else None),
+            "metrics_lint_errors": len(lint_errors),
             "latency_p99_s_served": (
                 round(_pct(lats, 0.99), 4) if lats else None),
             "rolling_ok": roll["ok"],

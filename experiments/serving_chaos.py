@@ -238,7 +238,7 @@ FLEET_SERVE_ARGS = [
 
 
 def start_fleet(out_dir, router_faults="", replicas=3,
-                replica_faults="slow_decode_ms=30"):
+                replica_faults="slow_decode_ms=30", extra_args=()):
     """Spawn `cli serve-fleet`; returns (proc, port, lines) where ``lines``
     is the live stdout accumulator (a reader thread keeps the pipe drained
     — the rolling-drain audit line arrives long after the listening line)."""
@@ -253,7 +253,7 @@ def start_fleet(out_dir, router_faults="", replicas=3,
          "--fleet_dir", os.path.join(out_dir, "fleet"),
          "--compile_cache_dir", os.path.join(out_dir, "cache"),
          "--retry_budget", "2", "--replica_restart_backoff_s", "0.05",
-         "--replica_faults", replica_faults],
+         "--replica_faults", replica_faults, *extra_args],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
@@ -313,13 +313,31 @@ def check_fleet_drained(name, rc, out, out_dir, replicas=3):
     return audit
 
 
+def _lint_metrics(url_or_path):
+    """Run the exposition linter as CI would (obs/aggregate.py CLI)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-m", "galvatron_tpu.obs.aggregate", "lint",
+         url_or_path],
+        cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, \
+        f"exposition lint failed for {url_or_path}:\n{r.stdout}{r.stderr}"
+
+
 def scenario_fleet_kill(out_dir):
     """Kill one of three replicas mid-decode: zero requests lost, the
     killed replica's in-flight work re-dispatches and completes within
     deadline (retried_from >= 1), the replica restarts WARM from the
-    shared artifact store, and the fleet drains clean."""
+    shared artifact store, and the fleet drains clean. Runs with tracing
+    armed (--flight_dir) so the post-drain merge-export proves the
+    fleet-wide trace: the failed-over request's trace_id appears on the
+    router track AND the replica track that finally served it."""
     proc, port, lines = start_fleet(
-        out_dir, router_faults="kill_replica_at_dispatch=2")
+        out_dir, router_faults="kill_replica_at_dispatch=2",
+        extra_args=("--flight_dir", os.path.join(out_dir, "router-flight"),
+                    "--slo", "1"))
     try:
         wait_fleet_ready(port, 3)
         results = []
@@ -346,16 +364,84 @@ def scenario_fleet_kill(out_dir):
         assert len(warm_lines) >= 2, f"replica {idx} log:\n{log[-2000:]}"
         assert int(warm_lines[-1]) >= 1, \
             f"restart was not warm: {warm_lines} \n{log[-2000:]}"
+        # metrics aggregation: the router is the single scrape target —
+        # per-replica-labeled families, fleet sums, and cumulative TTFT/
+        # latency histogram buckets (the fleet merge needs a probe cycle
+        # to refresh each replica's snapshot)
+        deadline = time.time() + 60
+        text = ""
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30
+            ) as r:
+                text = r.read().decode()
+            if "galvatron_fleet_ttft_hist_seconds_fleet_bucket" in text:
+                break
+            time.sleep(0.5)
+        assert 'galvatron_fleet_serving_completed_total{replica="0"}' in text, \
+            text[-2000:]
+        assert "galvatron_fleet_serving_completed_sum_total" in text, \
+            text[-2000:]
+        assert "galvatron_fleet_ttft_hist_seconds_fleet_bucket" in text, \
+            text[-2000:]
+        assert "galvatron_slo_breached" in text, text[-2000:]
+        _lint_metrics(f"http://127.0.0.1:{port}/metrics")
+        _lint_metrics(f"http://127.0.0.1:{h['replica'][0]['port']}/metrics")
         drain(port)
         rc, out = wait_fleet_exit(proc, lines, timeout=150)
         audit = check_fleet_drained("fleet-kill", rc, out, out_dir)
         assert audit["requests"]["served"] >= 6, audit["requests"]
+        check_merged_trace(out_dir)
         print(f"  {len(retried)} failovers (retried_from>=1), "
               f"replica {idx} restarted warm "
-              f"({warm_lines[-1]} cache hits)")
+              f"({warm_lines[-1]} cache hits), merged trace shows the "
+              f"failover hop")
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def check_merged_trace(out_dir):
+    """Post-drain: `cli trace-export --merge` over every flight dump the
+    fleet left (router + per-replica) must yield ONE timeline where the
+    failed-over request's trace_id spans the router's pid track and the
+    pid track of the replica that served the retry (the failover hop).
+    The originally-targeted replica was SIGKILLed — its in-memory span
+    ring died with it, which is exactly why the dumps that DID land must
+    still tell the story end to end."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    merged_path = os.path.join(out_dir, "merged.trace.json")
+    r = subprocess.run(
+        [sys.executable, "-m", "galvatron_tpu.cli", "trace-export",
+         "--merge", out_dir, "-o", merged_path],
+        cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, f"merge-export failed:\n{r.stdout}{r.stderr}"
+    merged = json.load(open(merged_path))
+    events = merged.get("traceEvents", [])
+    ids = {}
+    for ev in events:
+        t = (ev.get("args") or {}).get("trace_id")
+        if t:
+            ids.setdefault(t, set()).add(ev.get("pid"))
+    assert ids, "merged timeline carries no trace ids"
+    all_pids = {p for pids in ids.values() for p in pids}
+    assert len(all_pids) >= 2, \
+        f"trace ids never crossed a process boundary: {ids}"
+    failover_ids = {
+        (ev.get("args") or {}).get("trace_id")
+        for ev in events if ev.get("name") == "fleet_failover"
+    } - {None}
+    assert failover_ids, "router recorded no fleet_failover with a trace_id"
+    hop = [t for t in failover_ids if len(ids.get(t, ())) >= 2]
+    assert hop, (
+        f"failover trace never reached a second process track: "
+        f"{ {t: sorted(ids.get(t, ())) for t in failover_ids} }"
+    )
+    print(f"  merged {merged_path}: {len(ids)} trace ids over "
+          f"{len(all_pids)} process tracks; failover trace "
+          f"{hop[0]} spans {sorted(ids[hop[0]])}")
 
 
 def scenario_fleet_rolling(out_dir):

@@ -27,7 +27,9 @@ models/<name>/{train_dist,search_dist,profiler}.py + profile_hardware):
                     peak-buffer stats land in a JSONL report
   trace-export      convert a crash flight-recorder dump (flight_<ts>.json)
                     or raw span records into Chrome trace-event JSON loadable
-                    in Perfetto / chrome://tracing (obs/tracing.py)
+                    in Perfetto / chrome://tracing (obs/tracing.py);
+                    --merge DIR fuses every dump under a directory into ONE
+                    clock-aligned multi-process timeline (obs/correlate.py)
   generate          KV-cache text generation from a checkpoint (or random init)
   serve             REST generation server (text_generation_server equivalent);
                     continuous-batching engine by default (--num_slots,
@@ -425,6 +427,20 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
             )
         service = GenerationService(params, cfg, tok, ns.max_new_tokens,
                                     ns.seed, engine=engine)
+        if getattr(ns, "slo", 0):
+            # server-side SLO engine: this replica observes TTFT (the router
+            # cannot see first-token time through a non-streaming proxy) plus
+            # its own availability/deadline outcomes. Events land beside the
+            # flight dumps when --flight_dir is set; gauges + /healthz
+            # degraded_reasons work either way.
+            from galvatron_tpu.obs.slo import SLOEngine, build_serving_rules
+
+            service.slo = SLOEngine(
+                rules=build_serving_rules(ns),
+                events_path=(os.path.join(ns.flight_dir, "slo_events.jsonl")
+                             if getattr(ns, "flight_dir", None) else None),
+                source="server",
+            )
         import threading as _threading
 
         listening = _threading.Event()
@@ -665,15 +681,40 @@ def _warmup_model_config(ns, d: dict, path: str):
 
 
 def _trace_export_mode(ns) -> int:
-    """Flight dump / span records → Chrome trace-event JSON (Perfetto)."""
+    """Flight dump / span records → Chrome trace-event JSON (Perfetto).
+
+    ``--merge`` fuses every ``flight_*.json`` under a directory into ONE
+    timeline (obs/correlate.py): per-process pid track groups, clocks
+    aligned via each dump's ``epoch_wall`` anchor — a fleet request's
+    trace_id visibly hops router → replica-A → replica-B. Torn dumps are
+    skipped with a warning (same contract as ``read_metrics``' torn tail).
+    """
+    if getattr(ns, "merge", False):
+        from galvatron_tpu.obs.correlate import merge_directory
+
+        try:
+            out, used = merge_directory(ns.input_path, ns.output)
+        except ValueError as e:
+            print(f"error: {e}")
+            return 2
+        print(f"merged {len(used)} flight dump(s) → {out} "
+              "(load in Perfetto or chrome://tracing)")
+        return 0
     from galvatron_tpu.obs.flight import FLIGHT_SCHEMA
     from galvatron_tpu.obs.tracing import chrome_trace
 
     try:
         with open(ns.input_path) as f:
             doc = json.load(f)
-    except (OSError, ValueError) as e:
+    except OSError as e:
         print(f"error: cannot read {ns.input_path}: {e}")
+        return 2
+    except ValueError as e:
+        # torn/partial dump (crash mid-write): diagnose, don't traceback —
+        # the merge path skips these; single-file export has nothing left
+        lineno = getattr(e, "lineno", "?")
+        print(f"error: {ns.input_path}: torn/partial flight dump (crash "
+              f"mid-write?) — JSON parse failed at line {lineno}")
         return 2
     if isinstance(doc, dict) and doc.get("schema") == FLIGHT_SCHEMA:
         spans = doc.get("spans", [])

@@ -140,6 +140,15 @@ def _add_training_args(p: argparse.ArgumentParser):
                    help="per-device peak dense TFLOP/s for MFU (default: "
                    "auto from the TPU generation, or the "
                    "GALVATRON_PEAK_TFLOPS env; unknown = mfu omitted)")
+    g.add_argument("--slo_step_time_drift", type=float, default=0.0,
+                   help="arm the trainer's step-time-drift SLO (obs/slo.py): "
+                   "a step is 'bad' when measured iter time exceeds the "
+                   "plan's predicted step time by more than this fraction "
+                   "(e.g. 0.25 = 25%% slow); sustained drift over both burn "
+                   "windows raises an slo_breach event. Needs a "
+                   "--galvatron_config_path whose search recorded "
+                   "search_cost_ms. The drift gauge is ROADMAP item 2's "
+                   "online re-plan signal. Implies a per-iter sync. 0 = off")
     # hybrid-parallel GLOBAL flags (used when no galvatron_config_path)
     g.add_argument("--pp_deg", type=int, default=1)
     g.add_argument("--pp_division", type=_int_list, default=None,
@@ -406,6 +415,28 @@ def _add_generate_args(p: argparse.ArgumentParser):
                    "engine warm-starts its two pinned programs before "
                    "accepting traffic, so a restarted server's first request "
                    "pays a cache deserialize, not two XLA compiles")
+    # SLO burn-rate engine (obs/slo.py). Deliberately NOT fleet-only flags:
+    # serve-fleet forwards them verbatim to every replica, so the router
+    # (availability/deadline from dispatch outcomes) and the replicas
+    # (server-side TTFT) alert on one coherent rule set.
+    g.add_argument("--slo", type=int, default=0,
+                   help="1 = arm the SLO burn-rate engine (obs/slo.py): "
+                   "availability / TTFT p99 / deadline-miss rules evaluated "
+                   "over fast+slow sliding windows; breaches land in "
+                   "slo_events.jsonl, /metrics gauges, and /healthz "
+                   "degraded_reasons")
+    g.add_argument("--slo_ttft_p99_s", type=float, default=None,
+                   help="TTFT target (seconds) for the ttft_p99 rule "
+                   "(default: the rule table's 2.0s)")
+    g.add_argument("--slo_availability", type=float, default=None,
+                   help="availability target fraction (default 0.99)")
+    g.add_argument("--slo_deadline_miss_ratio", type=float, default=None,
+                   help="minimum fraction of requests that must finish "
+                   "within their end-to-end deadline (default 0.95)")
+    g.add_argument("--slo_window_fast_s", type=float, default=None,
+                   help="fast burn-rate window (default 30s)")
+    g.add_argument("--slo_window_slow_s", type=float, default=None,
+                   help="slow burn-rate window (default 300s)")
     g.add_argument("--output_dir", type=str, default=None,
                    help="export-hf: directory for the HF-format checkpoint")
 
@@ -526,9 +557,18 @@ def _add_trace_export_args(p: argparse.ArgumentParser):
     g = p.add_argument_group("trace-export")
     g.add_argument("input_path",
                    help="a flight_<ts>.json dump (obs/flight.py) or a raw "
-                   "span-record JSON list")
+                   "span-record JSON list; with --merge, a DIRECTORY "
+                   "searched recursively for flight_*.json dumps")
     g.add_argument("--output", "-o", type=str, default=None,
-                   help="output path (default: <input>.trace.json)")
+                   help="output path (default: <input>.trace.json; merge: "
+                   "<dir>/merged.trace.json)")
+    g.add_argument("--merge", action="store_true",
+                   help="fuse every flight_*.json under input_path into ONE "
+                   "Perfetto timeline (obs/correlate.py): each dump becomes "
+                   "a pid-keyed track group, clocks aligned via the dumps' "
+                   "epoch_wall anchors, so a fleet request's trace_id hops "
+                   "router → replica → failover replica on one view. Torn "
+                   "dumps are skipped with a warning, not fatal")
 
 
 def _add_hardware_args(p: argparse.ArgumentParser):

@@ -428,6 +428,16 @@ def run_elastic(
         obs_server = ObsServer(stats.render, port=ns.obs_port, health_fn=stats.health)
         run_elastic.last_obs_port = obs_server.port  # tests scrape the ephemeral port
         print(f"elastic supervisor sidecar: http://127.0.0.1:{obs_server.port}/healthz")
+        # child train-gauge aggregation: the child logs train_iter JSONL to
+        # --metrics_path and the sidecar tails the last 64KB at scrape time
+        # (prom.ElasticStats.child_train_gauges) — mfu/bubble/tokens_per_s
+        # survive on the supervisor's scrape target across child restarts
+        # with no IPC and no second port. A user-passed --metrics_path is
+        # honored; otherwise one is injected beside the checkpoints.
+        if getattr(ns, "metrics_path", None):
+            stats.child_metrics_path = ns.metrics_path
+        elif ns.save:
+            stats.child_metrics_path = os.path.join(ns.save, "train_metrics.jsonl")
     worlds = faults.world_schedule()
     # the shared supervisor decision table (core/restart_policy.py):
     # consecutive-no-progress budget, progress-resets-streak, full-jitter
@@ -448,6 +458,10 @@ def run_elastic(
         # stale). Before the first save, the user's --load (or a fresh
         # init) applies.
         child_argv = list(argv) + ["--obs_port", "0"]
+        if stats.child_metrics_path and not getattr(ns, "metrics_path", None):
+            # injected (not user-passed): give the child the sidecar's
+            # tail target so its train_iter gauges aggregate upward
+            child_argv += ["--metrics_path", stats.child_metrics_path]
         if ns.save and (
             not getattr(ns, "load", None) or _last_step(ns.save) is not None
         ):

@@ -232,7 +232,7 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
     )
 
     from galvatron_tpu.obs import tracing as obs_tracing
-    from galvatron_tpu.utils.metrics import MetricsLogger
+    from galvatron_tpu.utils.metrics import SCHEMA_VERSION, MetricsLogger
 
     # opened before restore so a corrupt-latest fallback (ckpt_fallback) is
     # visible in the same JSONL stream as the training events. Multihost:
@@ -517,12 +517,48 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
     # the loop would run ahead of a stalled collective by the dispatch
     # depth and the deadline would measure dispatch, not the hang
     watchdog_on = bool(getattr(ns, "step_timeout_s", 0.0))
+    # cost-model fidelity anchor: the plan's predicted step time
+    # (search_cost_ms, written by SearchEngine.save_result) — read ONCE
+    # here so the per-iter drift gauge and the end-of-run report share it.
+    # The prediction only applies when training the searched batch size.
+    predicted_ms = None
+    if ns.galvatron_config_path:
+        import json as _json
+
+        try:
+            with open(ns.galvatron_config_path) as f:
+                _plan_doc = _json.load(f)
+            if _plan_doc.get("global_bsz") == ns.global_train_batch_size:
+                predicted_ms = _plan_doc.get("search_cost_ms")
+        except (OSError, ValueError):
+            pass
+    # step-time-drift SLO (obs/slo.py): sustained (iter_ms - predicted)/
+    # predicted past the flag's threshold raises a burn-rate breach — the
+    # drift gauge is ROADMAP item 2's online re-plan signal. Drift needs
+    # the realized per-iter time, so arming it joins sync_each below.
+    train_slo = None
+    slo_drift_on = (
+        bool(getattr(ns, "slo_step_time_drift", 0.0))
+        and jax.process_index() == 0
+    )
+    if slo_drift_on:
+        from galvatron_tpu.obs.slo import SLOEngine, build_training_rules
+
+        _slo_dir = ns.save or (
+            os.path.dirname(metrics.path) or "." if metrics.path else None
+        )
+        train_slo = SLOEngine(
+            rules=build_training_rules(ns),
+            events_path=(os.path.join(_slo_dir, "slo_events.jsonl")
+                         if _slo_dir else None),
+            source="trainer",
+        )
     # metrics.path, not ns.metrics_path: on a pod only process 0 owns the
     # JSONL sink — the other hosts must not pay a per-iter sync for a no-op
     # logger (their sentinel/tracing terms still apply to all hosts alike)
     sync_each = bool(
         ns.check_loss or metrics.path or sentinel.armed or tracer.enabled
-        or obs_on or watchdog_on
+        or obs_on or watchdog_on or slo_drift_on
     )
     prof = RuntimeProfiler(warmup_iters=1, windowed=not sync_each)
     # step accounting (obs/stepstats.py): tokens/s + achieved TFLOP/s + MFU
@@ -895,9 +931,17 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                             comm_wait_ms=stat["comm_wait_ms"],
                             bubble_fraction=stat["bubble_fraction"],
                         )
+                    # step-time drift vs the plan's prediction: the signed
+                    # ratio the re-planner (ROADMAP item 2) and the drift
+                    # SLO both consume
+                    drift = (
+                        (iter_ms - predicted_ms) / predicted_ms
+                        if predicted_ms and iter_ms is not None
+                        else None
+                    )
                     if metrics.path:
                         metrics.log(
-                            "train_iter", step=it,
+                            "train_iter", schema=SCHEMA_VERSION, step=it,
                             # a disarmed run can still diverge: bare NaN/Infinity
                             # is not valid JSON (same reason anomaly_skip
                             # stringifies), so non-finite losses log as strings
@@ -909,13 +953,20 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                             batch_size=cur_bs,
                             iter_ms=iter_ms,
                             **stat,
+                            **({"step_time_drift": round(drift, 4)}
+                               if drift is not None else {}),
                         )
+                    if train_slo is not None and drift is not None:
+                        train_slo.observe_drift("step_time_drift", drift,
+                                                step=it)
                     if train_obs is not None:
                         train_obs.iterations += 1
                         if loss_val is not None:
                             train_obs.last_loss = loss_val
                         if iter_ms is not None:
                             train_obs.last_iter_ms = iter_ms
+                            train_obs.predicted_iter_ms = predicted_ms
+                            train_obs.step_time_drift = drift
                             train_obs.tokens_per_s = stat.get("tokens_per_s")
                             train_obs.tflops_per_device = stat.get("tflops_per_device")
                             train_obs.mfu = stat.get("mfu")
@@ -1061,6 +1112,8 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
         finally:
             if obs_server is not None:
                 obs_server.close()
+            if train_slo is not None:
+                train_slo.close()
             if tracer_owned:
                 # this run turned tracing on; turn it off (and drop the
                 # ring) so spans cannot leak into a later run in-process
@@ -1069,20 +1122,9 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
     # throughput from actual samples processed (rampup runs at smaller sizes)
     avg_bs = (consumed - consumed_at_start) / iters_run if iters_run else 0
     # cost-model fidelity: predicted-vs-measured iteration time when training
-    # the searched strategy at its searched batch size (the benchmark the
-    # reference itself optimizes, SURVEY §6; search_cost_ms is written by
-    # SearchEngine.save_result)
-    predicted_ms = None
-    if ns.galvatron_config_path:
-        import json as _json
-
-        try:
-            with open(ns.galvatron_config_path) as f:
-                d = _json.load(f)
-            if d.get("global_bsz") == ns.global_train_batch_size:
-                predicted_ms = d.get("search_cost_ms")
-        except (OSError, ValueError):
-            pass
+    # the searched strategy at its searched batch size (SURVEY §6);
+    # predicted_ms was resolved once before the loop — the per-iter drift
+    # gauge/SLO and this report read the same anchor
     report = (
         prof.report(avg_bs, seq, predicted_ms=predicted_ms, step_stats=stepstats)
         if prof.iter_times_ms

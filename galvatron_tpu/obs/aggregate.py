@@ -1,0 +1,395 @@
+"""Prometheus exposition parsing, fleet roll-up, and lint.
+
+Three consumers share the text-format knowledge in this module so it lives
+in exactly one place:
+
+- ``parse_exposition``: the v0.0.4 text format back into families/samples —
+  what the roll-up, the lint, and the tests all read.
+- ``merge_expositions``: roll N replica expositions into one document with
+  a ``replica`` label on every sample plus ``_fleet`` sum families for
+  counters and histograms (bucket counts add; quantile gauges don't and are
+  deliberately NOT summed). The fleet router's /metrics does its roll-up
+  from replica /healthz JSON (cheaper, already probed); this text-level
+  merge exists for offline aggregation of scraped files and as the
+  reference semantics the router's roll-up is tested against.
+- ``exposition_lint``: the CI gate (``python -m galvatron_tpu.obs.aggregate
+  lint URL_OR_FILE ...``) — one HELP/TYPE per family, valid names/labels/
+  escapes, histogram bucket monotonicity ending at ``+Inf`` with
+  ``_count`` == the ``+Inf`` bucket. A malformed family silently breaks
+  the WHOLE scrape for real collectors, so CI fails loudly instead.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>[^\s]+)"
+    r"(?:\s+(?P<ts>-?\d+))?$"
+)
+_LABEL_PAIR_RE = re.compile(
+    r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\]|\\.)*)"'
+)
+
+#: histogram/summary suffixes that belong to the base family name
+_FAMILY_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+class Sample:
+    __slots__ = ("name", "labels", "value")
+
+    def __init__(self, name: str, labels: Dict[str, str], value: float):
+        self.name = name
+        self.labels = labels
+        self.value = value
+
+    def __repr__(self):
+        return f"Sample({self.name}, {self.labels}, {self.value})"
+
+
+class Family:
+    __slots__ = ("name", "mtype", "help", "samples")
+
+    def __init__(self, name: str, mtype: str = "untyped", help_: str = ""):
+        self.name = name
+        self.mtype = mtype
+        self.help = help_
+        self.samples: List[Sample] = []
+
+
+def _unescape(v: str) -> str:
+    return v.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+
+
+def _parse_value(s: str) -> float:
+    if s == "+Inf":
+        return float("inf")
+    if s == "-Inf":
+        return float("-inf")
+    return float(s)  # "NaN" parses to nan
+
+
+def base_family(sample_name: str, typed: Dict[str, str]) -> str:
+    """Map a sample name to its family: histogram/summary samples carry
+    ``_bucket``/``_sum``/``_count`` suffixes on the declared family name."""
+    for suf in _FAMILY_SUFFIXES:
+        if sample_name.endswith(suf):
+            base = sample_name[: -len(suf)]
+            if typed.get(base) in ("histogram", "summary"):
+                return base
+    return sample_name
+
+
+def parse_exposition(text: str) -> Dict[str, Family]:
+    """Parse v0.0.4 text into ``{family_name: Family}`` (insertion-ordered).
+    Raises ValueError on a line that is neither comment, blank, nor valid
+    sample — parse errors ARE lint errors."""
+    families: Dict[str, Family] = {}
+    typed: Dict[str, str] = {}
+
+    def fam(name: str) -> Family:
+        if name not in families:
+            families[name] = Family(name, typed.get(name, "untyped"))
+        return families[name]
+
+    for i, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) < 4:
+                raise ValueError(f"line {i}: malformed TYPE comment: {line!r}")
+            name, mtype = parts[2], parts[3].strip()
+            typed[name] = mtype
+            fam(name).mtype = mtype
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) < 3:
+                raise ValueError(f"line {i}: malformed HELP comment: {line!r}")
+            name = parts[2]
+            fam(name).help = parts[3] if len(parts) > 3 else ""
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {i}: unparseable sample: {line!r}")
+        labels: Dict[str, str] = {}
+        raw = m.group("labels")
+        if raw is not None:
+            consumed = 0
+            for lm in _LABEL_PAIR_RE.finditer(raw):
+                labels[lm.group("k")] = _unescape(lm.group("v"))
+                consumed = lm.end()
+            leftover = raw[consumed:].strip().strip(",")
+            if leftover:
+                raise ValueError(
+                    f"line {i}: malformed label section {raw!r}"
+                )
+        name = m.group("name")
+        try:
+            value = _parse_value(m.group("value"))
+        except ValueError as e:
+            raise ValueError(f"line {i}: bad sample value: {line!r}") from e
+        fam(base_family(name, typed)).samples.append(
+            Sample(name, labels, value)
+        )
+    return families
+
+
+# ---------------------------------------------------------------------------
+# roll-up
+# ---------------------------------------------------------------------------
+
+
+def merge_expositions(
+    texts: Dict[str, str], label: str = "replica"
+) -> str:
+    """Merge per-replica exposition texts into one document.
+
+    ``texts`` maps a replica key (e.g. ``"0"``) to its /metrics body. Every
+    sample is re-emitted with ``{label}="<key>"`` added; counter and
+    histogram families additionally get an unlabeled ``_fleet`` sum family
+    (bucket counts sum per ``le``). Gauges are labeled but not summed —
+    a sum of occupancies is meaningful, a sum of p95s is not, and the
+    caller can always ``sum by ()`` the labeled gauges it trusts.
+    """
+    from galvatron_tpu.obs.prom import PromText
+
+    out = PromText(prefix="")
+    merged: Dict[str, List[Tuple[str, Family]]] = {}
+    order: List[str] = []
+    for key, text in texts.items():
+        for name, f in parse_exposition(text).items():
+            if name not in merged:
+                merged[name] = []
+                order.append(name)
+            merged[name].append((key, f))
+    for name in order:
+        variants = merged[name]
+        mtype = variants[0][1].mtype
+        help_ = next((f.help for _, f in variants if f.help), "")
+        if mtype == "histogram":
+            from galvatron_tpu.utils.metrics import Histogram
+
+            snaps = []
+            for key, f in variants:
+                snap = _exposition_histogram_snapshot(f)
+                if snap is None:
+                    continue
+                out.add_histogram(name, snap, labels={label: key},
+                                  help_=help_)
+                snaps.append(snap)
+            if snaps:
+                out.add_histogram(f"{name}_fleet",
+                                  Histogram.merge_snapshots(snaps))
+            continue
+        totals: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        for key, f in variants:
+            for s in f.samples:
+                out.add(s.name, s.value, labels={**s.labels, label: key},
+                        mtype=mtype, help_=help_)
+                if mtype == "counter":
+                    lk = tuple(sorted(s.labels.items()))
+                    totals[lk] = totals.get(lk, 0.0) + s.value
+        if mtype == "counter":
+            for lk, v in totals.items():
+                out.add(f"{name}_fleet", v, labels=dict(lk) or None,
+                        mtype="counter")
+    return out.render()
+
+
+def _exposition_histogram_snapshot(f: Family) -> Optional[Dict[str, Any]]:
+    """A parsed histogram family back into the ``Histogram.snapshot()``
+    shape (single-series families only — labeled sub-series would need a
+    per-series split the fleet roll-up doesn't produce)."""
+    buckets: Dict[str, int] = {}
+    total = None
+    s = None
+    for smp in f.samples:
+        if smp.name.endswith("_bucket"):
+            le = smp.labels.get("le")
+            if le is None:
+                return None
+            key = "+Inf" if le == "+Inf" else repr(float(le))
+            buckets[key] = int(smp.value)
+        elif smp.name.endswith("_sum"):
+            s = smp.value
+        elif smp.name.endswith("_count"):
+            total = int(smp.value)
+    if not buckets or total is None or s is None:
+        return None
+    return {"sum": s, "count": total, "buckets": buckets}
+
+
+# ---------------------------------------------------------------------------
+# lint
+# ---------------------------------------------------------------------------
+
+
+def exposition_lint(text: str) -> List[str]:
+    """Validate an exposition; returns a list of human-readable errors
+    (empty = clean). Checks: parseability, HELP/TYPE at most once per
+    family and before its samples, metric/label name syntax, duplicate
+    series, histogram bucket monotonicity ending at ``+Inf`` with
+    ``_count`` equal to it."""
+    errors: List[str] = []
+    try:
+        families = parse_exposition(text)
+    except ValueError as e:
+        errors.append(str(e))
+        return errors
+    typed: Dict[str, str] = {f.name: f.mtype for f in families.values()}
+    help_seen: Dict[str, int] = {}
+    type_seen: Dict[str, int] = {}
+    sampled: Dict[str, int] = {}  # family → first sample line
+    seen_keys: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], int] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        header = False
+        for kind, store in (("HELP", help_seen), ("TYPE", type_seen)):
+            if line.startswith(f"# {kind} "):
+                header = True
+                parts = line.split(None, 3)
+                name = parts[2] if len(parts) > 2 else ""
+                if name in store:
+                    errors.append(
+                        f"line {i}: second {kind} for family {name!r} "
+                        f"(first at line {store[name]})"
+                    )
+                else:
+                    store[name] = i
+                if name in sampled:
+                    errors.append(
+                        f"line {i}: {kind} for {name!r} appears after its "
+                        f"samples (line {sampled[name]})"
+                    )
+        if header or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        sampled.setdefault(base_family(m.group("name"), typed), i)
+        raw = m.group("labels")
+        labels = tuple(sorted(
+            (lm.group("k"), lm.group("v"))
+            for lm in _LABEL_PAIR_RE.finditer(raw or "")
+        ))
+        key = (m.group("name"), labels)
+        if key in seen_keys:
+            errors.append(
+                f"line {i}: duplicate series {m.group('name')}"
+                f"{dict(labels)} (first at line {seen_keys[key]})"
+            )
+        else:
+            seen_keys[key] = i
+    for name, f in families.items():
+        if not _NAME_RE.match(name):
+            errors.append(f"invalid family name {name!r}")
+        for s in f.samples:
+            for k in s.labels:
+                if not _LABEL_RE.match(k):
+                    errors.append(
+                        f"family {name!r}: invalid label name {k!r}"
+                    )
+        if f.mtype == "histogram":
+            errors.extend(_lint_histogram(f))
+    return errors
+
+
+def _lint_histogram(f: Family) -> List[str]:
+    """Bucket checks per labeled sub-series (grouped on the non-``le``
+    labels): cumulative counts non-decreasing with ``le``, ``+Inf`` bucket
+    present, ``_count`` == ``+Inf`` bucket."""
+    errors: List[str] = []
+    series: Dict[Tuple[Tuple[str, str], ...], Dict[str, float]] = {}
+    counts: Dict[Tuple[Tuple[str, str], ...], float] = {}
+    for s in f.samples:
+        if s.name.endswith("_bucket"):
+            le = s.labels.get("le")
+            if le is None:
+                errors.append(f"{s.name}: bucket sample missing le label")
+                continue
+            key = tuple(sorted(
+                (k, v) for k, v in s.labels.items() if k != "le"
+            ))
+            series.setdefault(key, {})[le] = s.value
+        elif s.name.endswith("_count"):
+            key = tuple(sorted(s.labels.items()))
+            counts[key] = s.value
+    for key, buckets in series.items():
+        where = f"{f.name}{dict(key) or ''}"
+        if "+Inf" not in buckets:
+            errors.append(f"{where}: histogram missing le=\"+Inf\" bucket")
+        finite = sorted(
+            ((float(le), v) for le, v in buckets.items() if le != "+Inf")
+        )
+        prev = 0.0
+        for le, v in finite:
+            if v < prev:
+                errors.append(
+                    f"{where}: bucket counts not monotone at le={le} "
+                    f"({v} < {prev})"
+                )
+            prev = v
+        inf = buckets.get("+Inf")
+        if inf is not None and inf < prev:
+            errors.append(
+                f"{where}: +Inf bucket {inf} below last finite bucket {prev}"
+            )
+        if key in counts and inf is not None and counts[key] != inf:
+            errors.append(
+                f"{where}: _count {counts[key]} != +Inf bucket {inf}"
+            )
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m galvatron_tpu.obs.aggregate lint URL_OR_FILE ...
+# ---------------------------------------------------------------------------
+
+
+def _fetch(target: str) -> str:
+    if target.startswith(("http://", "https://")):
+        with urllib.request.urlopen(target, timeout=10) as resp:
+            return resp.read().decode()
+    with open(target) as f:
+        return f.read()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] != "lint" or len(argv) < 2:
+        print("usage: python -m galvatron_tpu.obs.aggregate lint "
+              "<url-or-file> [...]", file=sys.stderr)
+        return 2
+    rc = 0
+    for target in argv[1:]:
+        try:
+            text = _fetch(target)
+        except OSError as e:
+            print(f"{target}: fetch failed: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        errs = exposition_lint(text)
+        if errs:
+            rc = 1
+            for e in errs:
+                print(f"{target}: {e}", file=sys.stderr)
+        else:
+            n = len(parse_exposition(text))
+            print(f"{target}: OK ({n} families)")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

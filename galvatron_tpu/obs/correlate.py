@@ -1,0 +1,185 @@
+"""Cross-process trace correlation: trace ids + multi-dump timeline merge.
+
+PR 6's tracer is strictly per-process: each router/replica/supervisor owns
+a ring, dumps its own ``flight_<ts>_<pid>.json``, and exports its own
+Perfetto file — so a request that fails over router→replica-A→replica-B
+tells its story in three files with three unrelated clocks. This module is
+the correlation layer:
+
+- **trace ids** (:func:`mint_trace_id` / :data:`TRACE_HEADER`): the fleet
+  router mints one id per admitted request — only when tracing is armed;
+  tracing off adds zero work and no header — and propagates it via the
+  ``X-Galvatron-Trace-Id`` HTTP header. The replica threads it through
+  scheduler → prefill span → every lifecycle instant, so one grep (or one
+  Perfetto query on ``args.trace_id``) follows the request across every
+  process it touched.
+
+- **merge export** (:func:`merge_flight_dumps`, ``cli trace-export
+  --merge DIR``): fuse every flight dump under a directory into ONE
+  Chrome-trace document. Each dump becomes its own pid track group
+  (Perfetto renders per-process lanes); timestamps are aligned onto a
+  shared clock using each dump's ``epoch_wall`` anchor — every tracer
+  stamps spans with a *monotonic* clock whose zero point it records in
+  wall time, so ``offset_us = (epoch_wall - min(epoch_wall)) * 1e6``
+  places all processes on the earliest dump's timeline. Wall-clock anchors
+  are NTP-grade, not perf-counter-grade: good to ~ms on one host, which is
+  exactly what "see the failover hop on one screen" needs.
+
+Torn dumps (a process crashed mid-write before the atomic rename, or an
+operator copied a partial file) are SKIPPED with a line-numbered warning —
+the same contract as ``read_metrics``' torn-tail handling: forensics tools
+must degrade, never refuse, on the exact artifacts crashes produce.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import uuid
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from galvatron_tpu.obs.flight import FLIGHT_SCHEMA
+from galvatron_tpu.obs.tracing import chrome_trace
+
+#: the propagation header: router → replica. Mint/attach ONLY when tracing
+#: is enabled — with tracing off the header must be absent (pinned by test).
+TRACE_HEADER = "X-Galvatron-Trace-Id"
+
+_PID_FROM_NAME = re.compile(r"flight_\d{8}_\d{6}_(\d+)\.json$")
+
+
+def mint_trace_id() -> str:
+    """A fresh 16-hex-char trace id (uuid4-derived: no coordination, no
+    clock reads beyond what uuid already does)."""
+    return uuid.uuid4().hex[:16]
+
+
+def load_dump(path: str) -> Optional[Dict[str, Any]]:
+    """Read one flight dump; returns None (with a warning naming the file
+    and the torn line/column) instead of raising on a torn/partial file.
+    A well-formed JSON document that is not a flight dump also warns —
+    a merge directory may hold unrelated .json files."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        warnings.warn(f"{path}: unreadable flight dump, skipping: {e}")
+        return None
+    except ValueError as e:
+        lineno = getattr(e, "lineno", "?")
+        warnings.warn(
+            f"{path}: torn/partial flight dump (crash mid-write?), "
+            f"skipping — JSON parse failed at line {lineno}: {e}"
+        )
+        return None
+    if not isinstance(doc, dict) or doc.get("schema") != FLIGHT_SCHEMA:
+        warnings.warn(f"{path}: not a {FLIGHT_SCHEMA} dump, skipping")
+        return None
+    return doc
+
+
+def dump_pid(doc: Dict[str, Any], path: str, fallback: int) -> int:
+    """The pid that keys this dump's track group: the dump's own ``pid``
+    field (new dumps), the filename's trailing ``_<pid>`` (old dumps), or a
+    synthetic fallback index (merge must not collapse two dumps onto one
+    track group just because provenance is missing)."""
+    pid = doc.get("pid")
+    if isinstance(pid, int):
+        return pid
+    m = _PID_FROM_NAME.search(os.path.basename(path))
+    if m:
+        return int(m.group(1))
+    return fallback
+
+
+def find_dumps(root: str) -> List[str]:
+    """Every ``flight_*.json`` under ``root``, recursively, sorted — the
+    fleet writes per-process dumps into per-replica subdirectories."""
+    pats = [os.path.join(root, "flight_*.json"),
+            os.path.join(root, "**", "flight_*.json")]
+    out: List[str] = []
+    seen = set()
+    for p in pats:
+        for path in glob.glob(p, recursive=True):
+            if path not in seen:
+                seen.add(path)
+                out.append(path)
+    return sorted(out)
+
+
+def merge_flight_dumps(
+    paths: Sequence[str],
+    process_names: Optional[Dict[str, str]] = None,
+) -> Tuple[Dict[str, Any], List[str]]:
+    """Fuse flight dumps into one Chrome-trace document.
+
+    Returns ``(doc, used_paths)``; torn/foreign files are skipped with a
+    warning and excluded from ``used_paths``. Raises ValueError only when
+    NO dump survives — an empty merge is an operator error worth a loud rc.
+
+    Clock alignment: each tracer's span timestamps are microseconds since
+    its own monotonic epoch; the dump records that epoch's wall time
+    (``epoch_wall``). The earliest epoch becomes ts=0 of the merged
+    timeline and every other dump shifts right by its wall-clock delta.
+    """
+    docs: List[Tuple[str, Dict[str, Any]]] = []
+    for p in paths:
+        doc = load_dump(p)
+        if doc is not None:
+            docs.append((p, doc))
+    if not docs:
+        raise ValueError(
+            f"no readable flight dumps among {len(paths)} file(s)"
+        )
+    ref = min(float(d.get("epoch_wall", d.get("wall_time", 0.0)))
+              for _, d in docs)
+    events: List[Dict[str, Any]] = []
+    used: List[str] = []
+    for i, (path, doc) in enumerate(docs):
+        epoch = float(doc.get("epoch_wall", doc.get("wall_time", ref)))
+        offset_us = (epoch - ref) * 1e6
+        pid = dump_pid(doc, path, fallback=100_000 + i)
+        name = None
+        if process_names:
+            name = process_names.get(path)
+        if not name:
+            reason = str(doc.get("reason", ""))[:60]
+            name = f"pid {pid}" + (f" — {reason}" if reason else "")
+        sub = chrome_trace(
+            doc.get("spans", []), pid=pid, ts_offset_us=offset_us,
+            process_name=name,
+        )
+        events.extend(sub["traceEvents"])
+        used.append(path)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}, used
+
+
+def merge_directory(root: str, out_path: Optional[str] = None) -> Tuple[str, List[str]]:
+    """``cli trace-export --merge DIR`` backend: find, merge, write.
+    Returns ``(output_path, used_paths)``. Raises ValueError when the
+    directory holds no usable dump."""
+    paths = find_dumps(root)
+    if not paths:
+        raise ValueError(f"{root}: no flight_*.json dumps found")
+    doc, used = merge_flight_dumps(paths)
+    out = out_path or os.path.join(root, "merged.trace.json")
+    d = os.path.dirname(os.path.abspath(out))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    return out, used
+
+
+def trace_ids_in(doc: Dict[str, Any]) -> Dict[str, List[int]]:
+    """``trace_id → sorted pids it appears on`` for a merged document —
+    the assertion the chaos harness makes ("this id hopped 3 processes")."""
+    out: Dict[str, set] = {}
+    for ev in doc.get("traceEvents", []):
+        tid = (ev.get("args") or {}).get("trace_id")
+        if tid:
+            out.setdefault(tid, set()).add(int(ev.get("pid", 0)))
+    return {k: sorted(v) for k, v in out.items()}

@@ -44,6 +44,7 @@ def dump_flight(
             "schema": FLIGHT_SCHEMA,
             "wall_time": time.time(),
             "epoch_wall": trc.epoch_wall,  # wall clock at span ts=0
+            "pid": os.getpid(),  # merge-export keys each dump's track group
             "reason": reason,
             "spans": spans,
         }

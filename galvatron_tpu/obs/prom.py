@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import re
 import threading
 import time
@@ -84,6 +85,50 @@ class PromText:
             )
         self._lines.append(f"{full}{label_s} {fv}")
 
+    def add_histogram(self, name: str, snap: Optional[Dict[str, Any]], *,
+                      labels: Optional[Dict[str, Any]] = None,
+                      help_: str = "") -> None:
+        """Emit one Prometheus histogram from a ``utils.metrics.Histogram``
+        snapshot: ``<name>_bucket{le=...}`` (cumulative, ending ``+Inf``),
+        ``<name>_sum``, ``<name>_count``. Skipped entirely when ``snap`` is
+        None/empty — an absent histogram must not emit a torn family."""
+        if not snap or not snap.get("buckets"):
+            return
+        full = self.prefix + name
+        if not _NAME_RE.match(full):
+            raise ValueError(f"invalid metric name {full!r}")
+        if full not in self._declared:
+            self._declared.add(full)
+            if help_:
+                self._lines.append(f"# HELP {full} {help_}")
+            self._lines.append(f"# TYPE {full} histogram")
+        base = dict(labels or {})
+        bounds = sorted(
+            (k for k in snap["buckets"] if k != "+Inf"), key=float
+        )
+        for b in bounds:
+            le = _fmt_value(float(b))
+            self._emit_sample(f"{full}_bucket", {**base, "le": le},
+                              snap["buckets"][b])
+        self._emit_sample(f"{full}_bucket", {**base, "le": "+Inf"},
+                          snap["buckets"]["+Inf"])
+        self._emit_sample(f"{full}_sum", base, snap.get("sum", 0.0))
+        self._emit_sample(f"{full}_count", base, snap.get("count", 0))
+
+    def _emit_sample(self, full: str, labels: Dict[str, Any], value: Any) -> None:
+        fv = _fmt_value(value)
+        if fv is None:
+            return
+        label_s = ""
+        if labels:
+            for k in labels:
+                if not _LABEL_RE.match(k):
+                    raise ValueError(f"invalid label name {k!r}")
+            label_s = (
+                "{" + ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels.items()) + "}"
+            )
+        self._lines.append(f"{full}{label_s} {fv}")
+
     def render(self) -> str:
         return "\n".join(self._lines) + "\n"
 
@@ -95,6 +140,27 @@ def render_hbm(out: PromText) -> None:
         dev, _, kind = key.partition("_")
         out.add("hbm_bytes", v, labels={"device": dev, "kind": kind},
                 help_="per-device HBM usage where the backend reports it")
+
+
+def render_slo(out: PromText, slo) -> None:
+    """Emit the SLO engine's per-rule gauges (obs/slo.py): burn rates,
+    breach state and totals, one row per rule. No-op when no engine is
+    armed — the families simply don't exist then."""
+    if slo is None:
+        return
+    for row in slo.gauges():
+        labels = {"rule": row["rule"]}
+        out.add("slo_burn_rate_fast", row.get("burn_fast"), labels=labels,
+                help_="error-budget burn rate over the fast window")
+        out.add("slo_burn_rate_slow", row.get("burn_slow"), labels=labels,
+                help_="error-budget burn rate over the slow window")
+        out.add("slo_breached", 1 if row.get("breached") else 0, labels=labels,
+                help_="1 while the rule's fast AND slow burn rates exceed "
+                "their thresholds")
+        out.add("slo_breaches_total", row.get("breaches_total"), labels=labels,
+                mtype="counter", help_="breach events raised by this rule")
+        out.add("slo_value", row.get("value"), labels=labels,
+                help_="rule-specific observed value (ratio or seconds)")
 
 
 def server_metrics_text(service) -> str:
@@ -138,6 +204,15 @@ def server_metrics_text(service) -> str:
         for q, key in (("0.5", "ttft_p50_s"), ("0.95", "ttft_p95_s")):
             out.add("serving_ttft_seconds", s[key], labels={"quantile": q},
                     help_="time-to-first-token over the recent-request window")
+        # cumulative histograms beside the quantile gauges: quantiles are a
+        # single-process readout; buckets aggregate across replicas (the
+        # fleet router sums them — fleet_metrics_text)
+        out.add_histogram("serving_ttft_hist_seconds", s.get("ttft_hist"),
+                          help_="time-to-first-token (cumulative buckets)")
+        out.add_histogram("serving_latency_hist_seconds", s.get("latency_hist"),
+                          help_="request e2e latency, submit to completion "
+                          "(cumulative buckets)")
+    render_slo(out, getattr(service, "slo", None))
     c = service.cfg
     out.add("model_info", 1, labels={
         "vocab_size": c.vocab_size, "hidden_size": c.hidden_size,
@@ -190,6 +265,58 @@ def fleet_metrics_text(router) -> str:
         out.add("fleet_replica_completed_total", s.get("completed"),
                 labels=labels, mtype="counter",
                 help_="completions of the replica's CURRENT incarnation")
+    # --- aggregation: the router is the fleet's single scrape target -------
+    # per-replica labeled serving families (label scheme: replica="<idx>")
+    # plus fleet-level sums; TTFT/latency aggregate as HISTOGRAMS because
+    # bucket counts sum across replicas — quantiles don't.
+    replica_stats = [
+        (r, (r.last_health.get("serving") or {})) for r in router.replicas
+    ]
+    for name in ("tokens_generated", "completed", "failed", "expired"):
+        total = 0
+        seen = False
+        for r, s in replica_stats:
+            v = s.get(name)
+            if v is None:
+                continue
+            seen = True
+            total += v
+            out.add(f"fleet_serving_{name}_total", v,
+                    labels={"replica": r.idx}, mtype="counter",
+                    help_="per-replica engine counter (replica label); the "
+                    "unlabeled-sum lives in fleet_serving_*_sum_total"
+                    if name == "tokens_generated" else "")
+        if seen:
+            out.add(f"fleet_serving_{name}_sum_total", total, mtype="counter",
+                    help_="sum over currently-reachable replicas")
+    for name in ("queue_depth", "active_slots", "tokens_per_s"):
+        total = 0.0
+        seen = False
+        for r, s in replica_stats:
+            v = s.get(name)
+            if v is None:
+                continue
+            seen = True
+            total += v
+            out.add(f"fleet_serving_{name}", v, labels={"replica": r.idx})
+        if seen:
+            out.add(f"fleet_serving_{name}_sum", total)
+    from galvatron_tpu.utils.metrics import Histogram
+
+    for hist_key, fam in (("ttft_hist", "fleet_ttft_hist_seconds"),
+                          ("latency_hist", "fleet_latency_hist_seconds")):
+        snaps = [s[hist_key] for _, s in replica_stats if s.get(hist_key)]
+        for r, s in replica_stats:
+            if s.get(hist_key):
+                out.add_histogram(fam, s[hist_key], labels={"replica": r.idx})
+        if snaps:
+            out.add_histogram(
+                f"{fam}_fleet",
+                Histogram.merge_snapshots(snaps),
+                help_="fleet-level distribution: per-replica bucket counts "
+                "summed (the reason histograms exist beside the quantile "
+                "gauges)")
+    render_slo(out, getattr(router, "slo", None))
     return out.render()
 
 
@@ -219,6 +346,12 @@ class TrainStats:
         self.compile_cache_hits: Optional[int] = None
         self.compile_cache_misses: Optional[int] = None
         self.startup_compile_ms: Optional[float] = None
+        # predicted-vs-observed step time (obs/slo.py step_time_drift rule):
+        # the quantitative signal ROADMAP item 2's online re-planner triggers
+        # on — positive means the plan is running slower than the cost model
+        # promised
+        self.predicted_iter_ms: Optional[float] = None
+        self.step_time_drift: Optional[float] = None
 
     def render(self) -> str:
         out = PromText()
@@ -257,6 +390,11 @@ class TrainStats:
         out.add("train_startup_compile_ms", self.startup_compile_ms,
                 help_="wall ms the startup AOT warmup spent compiling "
                 "(deserialization only on a warm start)")
+        out.add("train_predicted_iter_ms", self.predicted_iter_ms,
+                help_="cost model's predicted step time for the active plan")
+        out.add("train_step_time_drift", self.step_time_drift,
+                help_="(iter_ms - predicted_ms) / predicted_ms — the re-plan "
+                "trigger signal (ROADMAP item 2)")
         render_hbm(out)
         return out.render()
 
@@ -280,6 +418,38 @@ class ElasticStats:
         self.current_plan_hash: Optional[str] = None
         self.world_size: Optional[int] = None
         self.last_step: Optional[int] = None
+        # fleet-wide aggregation: the supervisor owns the ONLY sidecar port
+        # of a supervised run, so the child's train gauges must surface here
+        # — the supervisor injects --metrics_path into the child and tails
+        # the newest train_iter record at scrape time (no IPC, no second
+        # port; the JSONL file is already the cross-restart contract)
+        self.child_metrics_path: Optional[str] = None
+
+    def child_train_gauges(self) -> Dict[str, Any]:
+        """Newest ``train_iter`` record from the child's metrics JSONL —
+        read on scrape (tail ~64KB), tolerant of a torn tail and of future
+        schema fields. Empty dict before the child's first iteration."""
+        path = self.child_metrics_path
+        if not path:
+            return {}
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(max(0, size - 65536))
+                lines = f.read().split(b"\n")
+        except OSError:
+            return {}
+        for raw in reversed(lines):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue  # torn tail mid-write: walk back one record
+            if rec.get("event") == "train_iter":
+                return rec
+        return {}
 
     def health(self) -> Dict[str, Any]:
         """The ``/healthz`` JSON body — the same supervisor state, scrapeless."""
@@ -318,6 +488,20 @@ class ElasticStats:
         out.add("elastic_world_size", self.world_size)
         out.add("elastic_last_step", self.last_step,
                 help_="newest committed checkpoint step")
+        # child train gauges, aggregated through the JSONL metrics file so a
+        # pod dashboard needs ONE port for the whole supervised run
+        rec = self.child_train_gauges()
+        out.add("elastic_child_step", rec.get("step"),
+                help_="child trainer's newest logged iteration")
+        out.add("elastic_child_loss", rec.get("loss"))
+        out.add("elastic_child_iter_ms", rec.get("iter_ms"))
+        out.add("elastic_child_mfu", rec.get("mfu"),
+                help_="child trainer's model FLOPs utilization")
+        out.add("elastic_child_bubble_fraction", rec.get("bubble_fraction"))
+        out.add("elastic_child_tokens_per_s", rec.get("tokens_per_s"))
+        out.add("elastic_child_step_time_drift", rec.get("step_time_drift"),
+                help_="child's predicted-vs-observed step-time drift (the "
+                "re-plan trigger, surfaced at the supervisor)")
         return out.render()
 
 

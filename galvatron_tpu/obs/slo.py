@@ -1,0 +1,305 @@
+"""Declarative SLOs with multi-window burn-rate alerting.
+
+Counters and gauges say what the system *did*; an SLO says whether that is
+*acceptable* — and the standard way to alert on one (Google SRE workbook
+ch. 5) is the error-budget burn rate over TWO sliding windows: a fast
+window that reacts in seconds and a slow window that filters blips. A rule
+breaches only when BOTH windows burn faster than their thresholds, so a
+single slow request never pages but a sustained regression pages quickly.
+
+The rule table (:data:`RULES`) is the declarative contract — DESIGN.md's
+SLO table renders these exact rules and a doc-sync test keeps them matched:
+
+- ``availability``        — fraction of finished requests that did not fail
+- ``ttft_p99``            — time-to-first-token against a latency target
+- ``deadline_miss_ratio`` — requests that expired (queue or mid-decode)
+- ``step_time_drift``     — trainer iteration time vs the cost model's
+  predicted step time; the drift gauge this rule watches is the explicit
+  hook ROADMAP item 2's online re-planner will consume.
+
+Breaches fan out everywhere the system already looks: a tracer instant
+(``slo_breach``), a versioned ``slo_events.jsonl`` record, per-rule
+/metrics gauges (``prom.render_slo``), and a ``degraded_reasons`` list on
+/healthz so a load balancer's probe sees degradation without scraping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from galvatron_tpu.obs.tracing import tracer
+from galvatron_tpu.utils.metrics import SCHEMA_VERSION, MetricsLogger
+
+#: schema name stamped on every slo_events.jsonl record (with the shared
+#: ``schema`` version from utils.metrics — readers tolerate newer fields)
+EVENT_NAME = "slo_breach"
+
+
+@dataclass(frozen=True)
+class SLORule:
+    """One declarative SLO. ``kind`` picks the evaluation:
+
+    - ``ratio``: observations are good/bad booleans; ``target`` is the
+      minimum good fraction (error budget = 1 - target).
+    - ``latency``: observations are seconds; a sample is "bad" when it
+      exceeds ``threshold_s``; ``target`` is the fraction that must be fast
+      (e.g. 0.99 for a p99 objective).
+    - ``drift``: observations are signed ratios ((observed-predicted)/
+      predicted); a sample is "bad" when it exceeds ``threshold_s`` (here a
+      unitless ratio, e.g. 0.25 = 25% slower than predicted).
+    """
+
+    name: str
+    kind: str                      # "ratio" | "latency" | "drift"
+    target: float                  # required good fraction (error budget = 1-target)
+    description: str
+    threshold_s: Optional[float] = None   # latency/drift cut line
+    window_fast_s: float = 30.0
+    window_slow_s: float = 300.0
+    burn_fast: float = 14.0        # fast-window burn-rate threshold
+    burn_slow: float = 6.0         # slow-window burn-rate threshold
+
+
+#: the fleet's rule table. Thresholds/windows are defaults — serve flags
+#: (--slo_*) override targets and window lengths at wiring time
+#: (``build_serving_rules`` / ``build_training_rules``).
+RULES: Tuple[SLORule, ...] = (
+    SLORule(
+        name="availability",
+        kind="ratio",
+        target=0.99,
+        description="fraction of finished requests that did not fail "
+                    "(completed / (completed + failed))",
+    ),
+    SLORule(
+        name="ttft_p99",
+        kind="latency",
+        target=0.99,
+        threshold_s=2.0,
+        description="99% of requests must see their first token within "
+                    "the TTFT target",
+    ),
+    SLORule(
+        name="deadline_miss_ratio",
+        kind="ratio",
+        target=0.95,
+        description="fraction of finished requests that did not expire "
+                    "against their end-to-end deadline",
+    ),
+    SLORule(
+        name="step_time_drift",
+        kind="drift",
+        target=0.95,
+        threshold_s=0.25,
+        description="trainer step time vs the cost model's predicted step "
+                    "time; sustained drift is the online re-plan trigger "
+                    "(ROADMAP item 2)",
+    ),
+)
+
+
+def get_rule(name: str) -> SLORule:
+    for r in RULES:
+        if r.name == name:
+            return r
+    raise KeyError(f"unknown SLO rule {name!r}")
+
+
+class _RuleState:
+    """Sliding-window good/bad sample store for one rule. Samples are
+    ``(ts, bad)`` pairs in a deque; eviction happens lazily at read time
+    against the SLOW window (the fast window is a suffix of it)."""
+
+    def __init__(self, rule: SLORule):
+        self.rule = rule
+        self.samples: deque = deque()
+        self.breached = False
+        self.breaches_total = 0
+        self.last_value: Optional[float] = None
+        self.last_breach_ts: Optional[float] = None
+
+    def observe(self, bad: bool, now: float, value: Optional[float] = None) -> None:
+        self.samples.append((now, bad))
+        if value is not None:
+            self.last_value = float(value)
+        self._evict(now)
+
+    def _evict(self, now: float) -> None:
+        horizon = now - self.rule.window_slow_s
+        while self.samples and self.samples[0][0] < horizon:
+            self.samples.popleft()
+
+    def burn_rates(self, now: float) -> Tuple[Optional[float], Optional[float]]:
+        """(fast, slow) burn rates: (bad fraction in window) / error budget.
+        None when the window holds no samples — no data is not a breach."""
+        self._evict(now)
+        budget = max(1e-9, 1.0 - self.rule.target)
+        fast_cut = now - self.rule.window_fast_s
+        n_fast = bad_fast = n_slow = bad_slow = 0
+        for ts, bad in self.samples:
+            n_slow += 1
+            bad_slow += bad
+            if ts >= fast_cut:
+                n_fast += 1
+                bad_fast += bad
+        fast = (bad_fast / n_fast) / budget if n_fast else None
+        slow = (bad_slow / n_slow) / budget if n_slow else None
+        return fast, slow
+
+
+class SLOEngine:
+    """Evaluates a rule set over sliding windows; fans breaches out to the
+    tracer, a versioned JSONL event log, /metrics gauges and /healthz.
+
+    Thread-safe: serving handler threads and the engine loop both observe.
+    Evaluation happens inline on observe (amortized O(window)) — the rule
+    windows are small and the serving path already pays a counter lock.
+    """
+
+    def __init__(self, rules: Optional[List[SLORule]] = None,
+                 events_path: Optional[str] = None,
+                 source: str = "server"):
+        self.rules = list(rules if rules is not None else RULES)
+        self._state = {r.name: _RuleState(r) for r in self.rules}
+        self._events = MetricsLogger(events_path)
+        self.source = source
+        self._lock = threading.Lock()
+
+    # -- observation entry points ------------------------------------------
+
+    def observe(self, rule_name: str, bad: bool,
+                value: Optional[float] = None,
+                now: Optional[float] = None, **info) -> bool:
+        """Record one sample for ``rule_name``; returns True when this
+        observation RAISED a breach (edge, not level — the event fires once
+        per excursion; the ``slo_breached`` gauge holds the level)."""
+        st = self._state.get(rule_name)
+        if st is None:
+            return False
+        now = time.time() if now is None else now
+        with self._lock:
+            st.observe(bad, now, value)
+            fast, slow = st.burn_rates(now)
+            r = st.rule
+            breaching = (
+                fast is not None and slow is not None
+                and fast >= r.burn_fast and slow >= r.burn_slow
+            )
+            raised = breaching and not st.breached
+            cleared = st.breached and not breaching
+            st.breached = breaching
+            if raised:
+                st.breaches_total += 1
+                st.last_breach_ts = now
+        if raised:
+            tracer.instant(
+                "slo_breach", rule=rule_name, burn_fast=round(fast, 3),
+                burn_slow=round(slow, 3), value=value, source=self.source,
+                **info,
+            )
+            self._events.log(
+                EVENT_NAME, schema=SCHEMA_VERSION, rule=rule_name,
+                source=self.source, burn_fast=round(fast, 4),
+                burn_slow=round(slow, 4), value=value,
+                target=st.rule.target, threshold_s=st.rule.threshold_s,
+                **info,
+            )
+        elif cleared:
+            tracer.instant("slo_clear", rule=rule_name, source=self.source)
+            self._events.log(
+                "slo_clear", schema=SCHEMA_VERSION, rule=rule_name,
+                source=self.source,
+            )
+        return raised
+
+    def observe_latency(self, rule_name: str, seconds: float, **info) -> bool:
+        r = get_rule_from(self.rules, rule_name)
+        if r is None:
+            return False
+        return self.observe(
+            rule_name, bad=seconds > float(r.threshold_s or float("inf")),
+            value=seconds, **info,
+        )
+
+    def observe_drift(self, rule_name: str, drift: float, **info) -> bool:
+        r = get_rule_from(self.rules, rule_name)
+        if r is None:
+            return False
+        return self.observe(
+            rule_name, bad=drift > float(r.threshold_s or float("inf")),
+            value=drift, **info,
+        )
+
+    # -- readouts -----------------------------------------------------------
+
+    def gauges(self) -> List[Dict[str, Any]]:
+        """One row per rule for ``prom.render_slo``."""
+        now = time.time()
+        rows = []
+        with self._lock:
+            for name, st in self._state.items():
+                fast, slow = st.burn_rates(now)
+                rows.append({
+                    "rule": name,
+                    "burn_fast": fast,
+                    "burn_slow": slow,
+                    "breached": st.breached,
+                    "breaches_total": st.breaches_total,
+                    "value": st.last_value,
+                })
+        return rows
+
+    def degraded_reasons(self) -> List[str]:
+        """Rules currently in breach, as ``"slo:<rule>"`` strings — the
+        /healthz ``degraded_reasons`` list (empty = healthy)."""
+        with self._lock:
+            return [f"slo:{n}" for n, st in self._state.items() if st.breached]
+
+    def close(self) -> None:
+        self._events.close()
+
+
+def get_rule_from(rules, name: str) -> Optional[SLORule]:
+    for r in rules:
+        if r.name == name:
+            return r
+    return None
+
+
+def _override(rule: SLORule, **kw) -> SLORule:
+    from dataclasses import replace
+
+    return replace(rule, **{k: v for k, v in kw.items() if v is not None})
+
+
+def build_serving_rules(ns) -> List[SLORule]:
+    """The serving rule set with ``--slo_*`` flag overrides applied. The
+    trainer-only drift rule is excluded — a replica never observes it."""
+    fast = getattr(ns, "slo_window_fast_s", None)
+    slow = getattr(ns, "slo_window_slow_s", None)
+    return [
+        _override(get_rule("availability"),
+                  target=getattr(ns, "slo_availability", None),
+                  window_fast_s=fast, window_slow_s=slow),
+        _override(get_rule("ttft_p99"),
+                  threshold_s=getattr(ns, "slo_ttft_p99_s", None),
+                  window_fast_s=fast, window_slow_s=slow),
+        _override(get_rule("deadline_miss_ratio"),
+                  target=getattr(ns, "slo_deadline_miss_ratio", None),
+                  window_fast_s=fast, window_slow_s=slow),
+    ]
+
+
+def build_training_rules(ns) -> List[SLORule]:
+    """The trainer's drift rule with the ``--slo_step_time_drift`` override
+    (the flag doubles as the arm switch: 0/absent keeps the table default —
+    the trainer only builds this set at all when the flag is truthy)."""
+    thr = getattr(ns, "slo_step_time_drift", None)
+    return [
+        _override(get_rule("step_time_drift"),
+                  threshold_s=float(thr) if thr else None),
+    ]

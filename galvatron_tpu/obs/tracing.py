@@ -222,10 +222,21 @@ tracer = Tracer()
 # ---------------------------------------------------------------------------
 
 
-def chrome_trace(spans: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+def chrome_trace(
+    spans: Sequence[Dict[str, Any]],
+    pid: Optional[int] = None,
+    ts_offset_us: float = 0.0,
+    process_name: Optional[str] = None,
+) -> Dict[str, Any]:
     """Render recorded spans as a Chrome trace-event JSON object (Perfetto /
     chrome://tracing load this directly). Span records are the tracer's ring
-    schema; thread/track names become thread_name metadata events."""
+    schema; thread/track names become thread_name metadata events.
+
+    ``pid``/``ts_offset_us``/``process_name`` exist for the multi-process
+    merge (obs/correlate.py): each source dump renders under its own pid
+    (its own track group) with its timestamps shifted onto the shared
+    reference clock. Defaults reproduce the single-process export exactly."""
+    use_pid = _PID if pid is None else int(pid)
     events: List[Dict[str, Any]] = []
     named: Dict[Tuple[int, int], str] = {}
     for rec in spans:
@@ -233,9 +244,9 @@ def chrome_trace(spans: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         ev: Dict[str, Any] = {
             "name": rec["name"],
             "ph": rec.get("ph", "X"),
-            "pid": _PID,
+            "pid": use_pid,
             "tid": tid,
-            "ts": round(float(rec["ts"]), 3),
+            "ts": round(float(rec["ts"]) + ts_offset_us, 3),
             "args": dict(rec.get("args", {})),
         }
         if ev["ph"] == "X":
@@ -244,16 +255,26 @@ def chrome_trace(spans: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
             ev["s"] = "t"
         events.append(ev)
         tname = rec.get("tname")
-        if tname and named.get((_PID, tid)) != tname:
-            named[(_PID, tid)] = tname
-    for (pid, tid), tname in named.items():
+        if tname and named.get((use_pid, tid)) != tname:
+            named[(use_pid, tid)] = tname
+    for (epid, tid), tname in named.items():
         events.append(
             {
                 "name": "thread_name",
                 "ph": "M",
-                "pid": pid,
+                "pid": epid,
                 "tid": tid,
                 "args": {"name": tname},
+            }
+        )
+    if process_name:
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": use_pid,
+                "tid": 0,
+                "args": {"name": process_name},
             }
         )
     return {"traceEvents": events, "displayTimeUnit": "ms"}

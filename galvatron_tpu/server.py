@@ -137,6 +137,9 @@ class GenerationService:
         self.gate: Optional[_Gate] = None  # set by run_server (legacy path)
         # one capture at a time: jax.profiler state is process-global
         self._profile_lock = threading.Lock()
+        # SLO burn-rate engine (obs/slo.py), armed by cli serve wiring; when
+        # None the service runs SLO-less with zero added work
+        self.slo = None
         # graceful drain state (begin_drain): admission closes, /readyz goes
         # unready immediately, in-flight work completes under the deadline
         self.draining = False
@@ -223,6 +226,11 @@ class GenerationService:
             out["gate"] = self.gate.snapshot()
         if self.engine is not None:
             out["serving"] = self.engine.stats()
+        # SLO degradation is part of health, not just /metrics: a load
+        # balancer's probe sees WHY the replica is degraded without scraping
+        # (empty list = no rule in breach; absent only when no SLO is armed)
+        if self.slo is not None:
+            out["degraded_reasons"] = self.slo.degraded_reasons()
         return out
 
     def _validate(self, body: dict):
@@ -239,12 +247,13 @@ class GenerationService:
         return prompts, n_new
 
     def generate(self, body: dict,
-                 disconnect_check: Optional[Callable[[], bool]] = None) -> dict:
+                 disconnect_check: Optional[Callable[[], bool]] = None,
+                 trace_id: Optional[str] = None) -> dict:
         prompts, n_new = self._validate(body)
         tok_prompts = [self.tok.encode(p) for p in prompts]
         if self.engine is not None:
             outs, truncated = self._generate_engine(
-                body, tok_prompts, n_new, disconnect_check
+                body, tok_prompts, n_new, disconnect_check, trace_id=trace_id
             )
         else:
             outs = self._generate_serialized(body, tok_prompts, n_new)
@@ -258,7 +267,8 @@ class GenerationService:
         return resp
 
     def _generate_engine(self, body: dict, tok_prompts, n_new: int,
-                         disconnect_check: Optional[Callable[[], bool]] = None):
+                         disconnect_check: Optional[Callable[[], bool]] = None,
+                         trace_id: Optional[str] = None):
         """Continuous-batching path: one engine request per prompt, futures
         resolved as slots retire. Prompts of one HTTP request overlap with
         each other AND with every other in-flight connection. While the
@@ -289,6 +299,7 @@ class GenerationService:
                     top_k=int(body.get("top_k", 0)),
                     top_p=float(body.get("top_p", 0.0)),
                     ttl_s=float(ttl) if ttl is not None else None,
+                    trace_id=trace_id,
                 ))
             deadline = time.monotonic() + self.engine.result_timeout_s
             pending = {r.future for r in reqs}
@@ -313,10 +324,25 @@ class GenerationService:
                     for r in reqs]
             truncated = [r.finish_reason if r.finish_reason == "deadline"
                          else None for r in reqs]
+            if self.slo is not None:
+                # per-request SLO samples (obs/slo.py): success is an
+                # availability "good"; a deadline-truncated row is a miss;
+                # TTFT is the observed first-token latency
+                for r in reqs:
+                    self.slo.observe("availability", bad=False)
+                    self.slo.observe("deadline_miss_ratio",
+                                     bad=r.finish_reason == "deadline",
+                                     rid=r.rid)
+                    if r.first_token_at is not None:
+                        self.slo.observe_latency(
+                            "ttft_p99", r.first_token_at - r.submitted_at,
+                            rid=r.rid)
             return outs, truncated
         except QueueFull as e:
             raise ServiceBusy(str(e), detail="queue_full") from e
         except (RequestExpired, DeadlineExceeded) as e:
+            if self.slo is not None:
+                self.slo.observe("deadline_miss_ratio", bad=True)
             raise ServiceBusy(str(e), detail="expired") from e
         except RequestShed as e:
             raise ServiceBusy(str(e), detail="shed") from e
@@ -326,15 +352,23 @@ class GenerationService:
         except EngineRestarted as e:
             # Retry-After like draining 503s: the supervisor's own backoff
             # delay says when the recovered engine will be looping again
+            if self.slo is not None:
+                self.slo.observe("availability", bad=True,
+                                 reason="engine_restarted")
             raise ServiceBusy(str(e), detail="engine_restarted",
                               retry_after_s=e.retry_after_s) from e
         except EngineClosed as e:
+            if self.slo is not None:
+                self.slo.observe("availability", bad=True,
+                                 reason="engine_closed")
             raise ServiceBusy(str(e), detail="engine_closed") from e
         except FuturesTimeout as e:
             # distinct from the socket-read TimeoutError the handler treats
             # as a dead client: this request must get a real 500 and count
             # as failed (on 3.11+ FuturesTimeout aliases TimeoutError, which
             # the handler's stalled-client branch would silently swallow)
+            if self.slo is not None:
+                self.slo.observe("availability", bad=True, reason="timeout")
             raise RuntimeError(
                 f"generation timed out after {self.engine.result_timeout_s}s"
             ) from e
@@ -495,8 +529,14 @@ def _make_handler(service: GenerationService, request_timeout_s: float):
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
+                # the fleet router's correlation id (obs/correlate.py):
+                # present only when the router runs with tracing armed —
+                # absent header ⇒ trace_id None ⇒ zero extra work
+                from galvatron_tpu.obs.correlate import TRACE_HEADER
+
                 resp = service.generate(
-                    body, disconnect_check=self._client_disconnected
+                    body, disconnect_check=self._client_disconnected,
+                    trace_id=self.headers.get(TRACE_HEADER),
                 )
                 service.counters.inc("succeeded")
                 return self._reply(200, resp)

@@ -45,7 +45,7 @@ from galvatron_tpu.obs.tracing import tracer as _obs_tracer
 from galvatron_tpu.serving import resilience as rz
 from galvatron_tpu.serving.kv_slots import SlotKVCache
 from galvatron_tpu.serving.scheduler import Request, Scheduler
-from galvatron_tpu.utils.metrics import Counters, QuantileWindow
+from galvatron_tpu.utils.metrics import Counters, Histogram, QuantileWindow
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
@@ -160,6 +160,11 @@ class Engine:
             "engine_restarts",
         )
         self.ttft = QuantileWindow(512)
+        # cumulative-bucket twins of the quantile windows: quantiles are the
+        # single-process readout; bucket counts SUM across replicas, so the
+        # fleet router aggregates these (snapshots ride /healthz → probe)
+        self.ttft_hist = Histogram()
+        self.latency_hist = Histogram()
         # AOT artifact store for crash warm-rebuilds (set by warm_start);
         # summary of the most recent restart's warm-up, for tests/probes
         self._store = None
@@ -208,12 +213,16 @@ class Engine:
     def submit_request(self, tokens: Sequence[int], max_new_tokens: int,
                        temperature: float = 0.0, top_k: int = 0,
                        top_p: float = 0.0,
-                       ttl_s: Optional[float] = None) -> Request:
+                       ttl_s: Optional[float] = None,
+                       trace_id: Optional[str] = None) -> Request:
         """Like :meth:`submit` but returns the :class:`Request`, which
         carries the lifecycle state, ``finish_reason`` (deadline
         truncation), and the ``cancel()`` handle the server's disconnect
         poll uses. Refuses immediately — instead of parking a future that
-        can never resolve — when the engine is draining or closed."""
+        can never resolve — when the engine is draining or closed.
+        ``trace_id`` is the fleet router's correlation id (propagated via
+        the X-Galvatron-Trace-Id header, obs/correlate.py); it rides every
+        lifecycle instant and the prefill span."""
         if self._closed:
             raise rz.EngineClosed(
                 "engine is closed"
@@ -239,9 +248,13 @@ class Engine:
         req = Request(
             tokens=tokens, max_new_tokens=max_new_tokens,
             temperature=float(temperature), top_k=int(top_k),
-            top_p=float(top_p),
+            top_p=float(top_p), trace_id=trace_id,
         )
-        _obs_tracer.instant("req_queued", rid=req.rid, tokens=len(tokens))
+        if trace_id is not None:
+            _obs_tracer.instant("req_queued", rid=req.rid, tokens=len(tokens),
+                                trace_id=trace_id)
+        else:
+            _obs_tracer.instant("req_queued", rid=req.rid, tokens=len(tokens))
         if max_new_tokens == 0:
             # counted as submitted too: terminal outcomes must partition the
             # submitted total or /metrics shows completed > submitted
@@ -294,6 +307,11 @@ class Engine:
             "ttft_p95_s": ttft["p95"],
             # the fleet bench reads the served tail per replica over HTTP
             "ttft_p99_s": self.ttft.quantile(0.99),
+            # serializable cumulative-bucket snapshots: they ride /healthz
+            # JSON to the fleet router, which sums them into the fleet-level
+            # histograms (quantiles can't aggregate; buckets do)
+            "ttft_hist": self.ttft_hist.snapshot(),
+            "latency_hist": self.latency_hist.snapshot(),
             "submitted": sc["submitted"],
             "admitted": sc["admitted"],
             "completed": sc["completed"],
@@ -328,6 +346,8 @@ class Engine:
         # never register as progress and the restart budget burns early
         self.supervisor.note_counter_reset()
         self.ttft = QuantileWindow(512)
+        self.ttft_hist = Histogram()
+        self.latency_hist = Histogram()
         self._busy_s = 0.0
         self._last_step_tps = 0.0
 
@@ -508,8 +528,13 @@ class Engine:
 
     def _prefill(self, req: Request) -> None:
         # engine iteration spans (prefill/decode/sample) land on the same
-        # process timeline as everything else; tracing off = no-op singleton
-        with _obs_tracer.span("prefill", rid=req.rid, tokens=len(req.tokens)):
+        # process timeline as everything else; tracing off = no-op singleton.
+        # The prefill span is per-request, so the fleet trace_id rides it
+        # (batch-wide sample/decode spans cover many requests and don't).
+        attrs = {"rid": req.rid, "tokens": len(req.tokens)}
+        if req.trace_id is not None:
+            attrs["trace_id"] = req.trace_id
+        with _obs_tracer.span("prefill", **attrs):
             self._prefill_impl(req)
 
     def _prefill_impl(self, req: Request) -> None:
@@ -601,6 +626,7 @@ class Engine:
                 if req.first_token_at is None:
                     req.first_token_at = now
                     self.ttft.add(now - req.submitted_at)
+                    self.ttft_hist.observe(now - req.submitted_at)
                 if self.eos_id >= 0 and tok == self.eos_id:
                     req.finish_reason = "eos"
                     retired.append(slot)
@@ -683,6 +709,7 @@ class Engine:
 
     def _retire(self, slot: int) -> None:
         req = self._release_slot(slot)
+        self.latency_hist.observe(time.time() - req.submitted_at)
         rz.advance(req, rz.COMPLETED, self.scheduler.counters,
                    reason=req.finish_reason)
         if not req.future.done():

@@ -406,6 +406,11 @@ class FleetRouter:
             "client_error", "replica_restarts",
         )
         self.started_at = time.time()
+        # SLO burn-rate engine (obs/slo.py), armed by serve_fleet_main; the
+        # router observes availability + deadline misses from its dispatch
+        # outcomes (TTFT is a replica-side observation — each replica runs
+        # its own engine and /healthz unions their degraded_reasons here)
+        self.slo = None
         self.draining = False
         self._drain_lock = threading.Lock()
         self._rolling_lock = threading.Lock()
@@ -610,6 +615,21 @@ class FleetRouter:
             self.gate.release()
 
     def _dispatch_loop(self, body: Dict[str, Any], deadline: Optional[float]):
+        # distributed tracing (obs/correlate.py): the router is where a
+        # request's fleet-wide story starts, so the trace id is minted HERE
+        # — and ONLY when tracing is armed. Tracing off ⇒ no id, no header,
+        # no clock reads (the replica-side zero-host-sync pin covers this).
+        trace_id = None
+        if tracer.enabled:
+            from galvatron_tpu.obs.correlate import mint_trace_id
+
+            trace_id = mint_trace_id()
+            with tracer.span("fleet_request", trace_id=trace_id) as sp:
+                return self._dispatch_impl(body, deadline, trace_id, sp)
+        return self._dispatch_impl(body, deadline, None, None)
+
+    def _dispatch_impl(self, body: Dict[str, Any], deadline: Optional[float],
+                       trace_id: Optional[str], sp):
         attempts = 0  # re-dispatches so far (retried_from in the response)
         excluded: Set[int] = set()
         last_err = None
@@ -619,6 +639,9 @@ class FleetRouter:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     self.counters.inc("expired")
+                    self._slo_observe("deadline_miss_ratio", bad=True)
+                    if sp is not None:
+                        sp.set(outcome="expired", attempts=attempts)
                     return 503, {
                         "error": "end-to-end deadline exhausted "
                                  f"(after {attempts} retr"
@@ -651,11 +674,16 @@ class FleetRouter:
                     target=lambda: (time.sleep(0.2), r.kill()),
                     name="fleet-chaos-kill", daemon=True,
                 ).start()
-            ok, result = self._proxy(r, body, remaining)
+            ok, result = self._proxy(r, body, remaining, trace_id=trace_id)
             if ok:
                 code, payload, headers = result
                 if code == 200 and isinstance(payload, dict):
                     self.counters.inc("served")
+                    self._slo_observe("availability", bad=False)
+                    self._slo_observe("deadline_miss_ratio", bad=False)
+                    if sp is not None:
+                        sp.set(outcome="served", replica=r.idx,
+                               attempts=attempts)
                     payload["retried_from"] = attempts
                     return code, payload, headers
                 detail = payload.get("detail") if isinstance(payload, dict) else None
@@ -672,8 +700,11 @@ class FleetRouter:
                     # is exactly the cascade the budget exists to prevent
                     if detail == "expired":
                         self.counters.inc("expired")
+                        self._slo_observe("deadline_miss_ratio", bad=True)
                     elif code >= 500:
                         self.counters.inc("failed")
+                        self._slo_observe("availability", bad=True,
+                                          detail=str(detail))
                     elif code >= 400:
                         # replica-side validation rejections (bad prompts,
                         # out-of-range budgets): part of the partition too
@@ -688,6 +719,11 @@ class FleetRouter:
                 r.reachable = False
             if attempts >= self.retry_budget:
                 self.counters.inc("failed")
+                self._slo_observe("availability", bad=True,
+                                  detail="retry_budget_exhausted")
+                if sp is not None:
+                    sp.set(outcome="retry_budget_exhausted",
+                           attempts=attempts)
                 return 503, {
                     "error": f"request failed after {attempts + 1} "
                              f"dispatch(es): {last_err}",
@@ -696,23 +732,45 @@ class FleetRouter:
             attempts += 1
             excluded.add(r.idx)
             self.counters.inc("retried")
-            tracer.instant("fleet_failover", replica=r.idx,
-                           attempts=attempts, error=str(last_err)[:200])
+            if trace_id is not None:
+                # the failover hop carries the request's trace id so the
+                # merged timeline shows the router handing THIS request from
+                # the dead replica to its sibling
+                tracer.instant("fleet_failover", replica=r.idx,
+                               attempts=attempts, trace_id=trace_id,
+                               error=str(last_err)[:200])
+            else:
+                tracer.instant("fleet_failover", replica=r.idx,
+                               attempts=attempts, error=str(last_err)[:200])
+
+    def _slo_observe(self, rule: str, bad: bool, **info) -> None:
+        """One router-level SLO sample (obs/slo.py); no-op when no SLO
+        engine is armed."""
+        if self.slo is not None:
+            self.slo.observe(rule, bad=bad, **info)
 
     def _proxy(self, r: Replica, body: Dict[str, Any],
-               remaining: Optional[float]):
+               remaining: Optional[float],
+               trace_id: Optional[str] = None):
         """Forward one attempt to one replica. Returns ``(True, (code,
         payload, headers))`` for any HTTP response, ``(False, error_str)``
-        for transport-level loss."""
+        for transport-level loss. ``trace_id`` (tracing armed only) rides
+        the X-Galvatron-Trace-Id header so the replica's spans and
+        lifecycle instants join this request's fleet-wide trace."""
         fwd = dict(body)
         fwd.pop("session", None)  # router-level concern, not the engine's
         if remaining is not None:
             fwd["ttl_s"] = max(0.05, remaining)
         data = json.dumps(fwd).encode()
         timeout = (remaining + 10.0) if remaining is not None else 600.0
+        hdrs = {"Content-Type": "application/json"}
+        if trace_id is not None:
+            from galvatron_tpu.obs.correlate import TRACE_HEADER
+
+            hdrs[TRACE_HEADER] = trace_id
         req = urllib.request.Request(
             f"http://127.0.0.1:{r.port}/api", data=data,
-            headers={"Content-Type": "application/json"}, method="POST",
+            headers=hdrs, method="POST",
         )
         r.begin_dispatch()
         try:
@@ -892,7 +950,7 @@ class FleetRouter:
     # -- probes -------------------------------------------------------------
 
     def health(self) -> Dict[str, Any]:
-        return {
+        out = {
             "status": "draining" if self.draining else "ok",
             "ready": self.ready,
             "uptime_s": round(time.time() - self.started_at, 3),
@@ -905,6 +963,19 @@ class FleetRouter:
             "requests": self.counters.snapshot(),
             "replica": [r.snapshot() for r in self.replicas],
         }
+        if self.slo is not None:
+            # the fleet's degradation view: the router's own SLO breaches
+            # plus every replica's (probed /healthz carries them) — one
+            # probe of the router answers "is anything in the fleet burning
+            # its error budget, and which rule"
+            reasons = list(self.slo.degraded_reasons())
+            for r in self.replicas:
+                for why in (r.last_health.get("degraded_reasons") or []):
+                    tag = f"replica{r.idx}:{why}"
+                    if tag not in reasons:
+                        reasons.append(tag)
+            out["degraded_reasons"] = reasons
+        return out
 
 
 def _make_handler(router: FleetRouter):
@@ -1048,6 +1119,14 @@ def serve_fleet_main(ns, raw_argv: Sequence[str]) -> int:
         rolling_shutdown=bool(ns.rolling_drain),
         num_slots_hint=ns.num_slots,
     )
+    if getattr(ns, "slo", 0):
+        from galvatron_tpu.obs.slo import SLOEngine, build_serving_rules
+
+        router.slo = SLOEngine(
+            rules=build_serving_rules(ns),
+            events_path=os.path.join(router.fleet_dir, "slo_events.jsonl"),
+            source="fleet",
+        )
     # install the handler BEFORE spawning replicas: a SIGTERM landing in
     # the startup window would otherwise kill the router with the default
     # action and orphan every child it had already spawned

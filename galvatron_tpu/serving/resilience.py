@@ -98,7 +98,15 @@ def advance(req, state: str, counters=None, **info) -> None:
             f"request {req.rid}: illegal lifecycle transition {cur} → {state}"
         )
     req.state = state
-    tracer.instant(f"req_{state.lower()}", rid=req.rid, **info)
+    trace_id = getattr(req, "trace_id", None)
+    if trace_id is not None:
+        # the fleet-minted correlation id (obs/correlate.py) rides every
+        # lifecycle instant so the merged timeline links this process's
+        # events to the router's dispatch spans
+        tracer.instant(f"req_{state.lower()}", rid=req.rid,
+                       trace_id=trace_id, **info)
+    else:
+        tracer.instant(f"req_{state.lower()}", rid=req.rid, **info)
     if counters is not None:
         name = _STATE_COUNTER.get(state)
         if name:

@@ -60,6 +60,11 @@ class Request:
     state: str = rz.QUEUED
     cancel_requested: bool = False
     cancel_reason: Optional[str] = None
+    # fleet-minted correlation id (obs/correlate.py): set only when the
+    # router propagated X-Galvatron-Trace-Id (tracing armed); rides every
+    # lifecycle instant + the prefill span so one id follows the request
+    # across router → replica → failover replica
+    trace_id: Optional[str] = None
     # terminal detail: "eos" | "length" | "deadline" (partial-policy
     # truncation — the server surfaces it as ``"truncated": "deadline"``)
     finish_reason: Optional[str] = None

@@ -17,6 +17,13 @@ import warnings
 from typing import Any, Dict, Optional
 
 
+#: version stamped as a ``schema`` field on versioned JSONL records
+#: (``train_iter``, ``slo_events``). Readers must tolerate records with a
+#: HIGHER version and unknown extra fields (forward compatibility —
+#: ``read_metrics`` parses without validation; a test pins the contract).
+SCHEMA_VERSION = 1
+
+
 class MetricsLogger:
     """Append-only JSONL metrics writer; no-op when ``path`` is None.
 
@@ -162,6 +169,110 @@ class Counters:
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._c)
+
+
+#: default latency bucket bounds (seconds) shared by the serving TTFT and
+#: e2e-latency histograms — fixed at construction so bucket counts from
+#: every replica are mergeable by straight addition (quantiles are not)
+DEFAULT_LATENCY_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class Histogram:
+    """Fixed-bucket cumulative histogram (Prometheus semantics).
+
+    Unlike :class:`QuantileWindow` this is *aggregatable*: two replicas'
+    snapshots merge by adding per-bucket counts, so the fleet router can
+    expose one true fleet-level distribution. observe() is O(buckets) with
+    one lock — cheap enough for the engine hot loop."""
+
+    def __init__(self, buckets=DEFAULT_LATENCY_BUCKETS):
+        bs = sorted(float(b) for b in buckets)
+        if not bs:
+            raise ValueError("Histogram needs at least one bucket bound")
+        self.buckets = tuple(bs)
+        self._counts = [0] * len(bs)  # per-bucket (non-cumulative) counts
+        self._overflow = 0            # observations above the last bound
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, x: float) -> None:
+        x = float(x)
+        with self._lock:
+            self._sum += x
+            self._count += 1
+            for i, b in enumerate(self.buckets):
+                if x <= b:
+                    self._counts[i] += 1
+                    return
+            self._overflow += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Serializable state: ``buckets`` maps each upper bound (as str,
+        JSON keys must be strings) to its CUMULATIVE count; ``+Inf`` always
+        present and equal to ``count``. This dict rides /healthz JSON from
+        replica to router, where snapshots from N replicas merge."""
+        with self._lock:
+            counts = list(self._counts)
+            overflow = self._overflow
+            total = self._count
+            s = self._sum
+        out: Dict[str, Any] = {"sum": s, "count": total, "buckets": {}}
+        cum = 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            out["buckets"][repr(b)] = cum
+        out["buckets"]["+Inf"] = cum + overflow
+        return out
+
+    @staticmethod
+    def merge_snapshots(snaps):
+        """Sum histogram snapshots (e.g. one per replica) into one. Bucket
+        bounds are unioned; mismatched bounds still merge correctly because
+        counts are cumulative only per-snapshot — we re-accumulate from the
+        union. Empty input → empty histogram snapshot."""
+        merged_bounds = set()
+        for s in snaps:
+            merged_bounds.update(
+                k for k in s.get("buckets", {}) if k != "+Inf"
+            )
+        bounds = sorted(merged_bounds, key=float)
+        out: Dict[str, Any] = {"sum": 0.0, "count": 0, "buckets": {}}
+        for b in bounds:
+            out["buckets"][b] = 0
+        out["buckets"]["+Inf"] = 0
+        for s in snaps:
+            out["sum"] += float(s.get("sum", 0.0))
+            out["count"] += int(s.get("count", 0))
+            sb = s.get("buckets", {})
+            # de-cumulate this snapshot, then add into the union grid
+            prev = 0
+            items = sorted(
+                ((float(k), int(v)) for k, v in sb.items() if k != "+Inf"),
+            )
+            per = []
+            for bound, cumv in items:
+                per.append((bound, cumv - prev))
+                prev = cumv
+            inf_extra = int(sb.get("+Inf", prev)) - prev
+            for bound, delta in per:
+                for ob in bounds:
+                    if float(ob) >= bound:
+                        # lands in the first union bucket that covers it
+                        out["buckets"][ob] += delta
+                        break
+                else:
+                    out["buckets"]["+Inf"] += delta
+            out["buckets"]["+Inf"] += inf_extra
+        # re-cumulate the union grid
+        cum = 0
+        for b in bounds:
+            cum += out["buckets"][b]
+            out["buckets"][b] = cum
+        out["buckets"]["+Inf"] += cum
+        return out
 
 
 class QuantileWindow:

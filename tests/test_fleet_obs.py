@@ -1,0 +1,464 @@
+"""Fleet-wide observability (ISSUE 15): cross-process trace correlation +
+merge export, Prometheus histogram aggregation, exposition linting, SLO
+burn-rate engine, and the schema forward-compatibility contract.
+
+The acceptance pins: a merged export places each process on its own pid
+track group with clocks aligned via ``epoch_wall`` and a shared trace id
+linking tracks; torn dumps are skipped with a line-numbered warning, never
+a traceback; tracing OFF means no trace id is minted and no propagation
+header is sent (the zero-host-sync contract extends across the wire); an
+induced TTFT burn raises exactly one edge-triggered breach event carrying
+the versioned schema; DESIGN.md's SLO table matches ``slo.RULES``.
+"""
+
+import json
+import os
+import re
+import urllib.request
+
+import pytest
+
+from galvatron_tpu.obs import correlate, flight, prom, slo, tracing
+from galvatron_tpu.obs.aggregate import (
+    exposition_lint,
+    merge_expositions,
+    parse_exposition,
+)
+from galvatron_tpu.utils.metrics import (
+    SCHEMA_VERSION,
+    Histogram,
+    MetricsLogger,
+    read_metrics,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# merged multi-process timeline
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_dump(path, *, pid, epoch_wall, spans, reason="test"):
+    doc = {
+        "schema": flight.FLIGHT_SCHEMA,
+        "wall_time": epoch_wall,
+        "epoch_wall": epoch_wall,
+        "pid": pid,
+        "reason": reason,
+        "spans": spans,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def _span(name, ts, dur=100.0, **args):
+    return {"ph": "X", "name": name, "ts": ts, "dur": dur, "tid": 1,
+            "tname": "main", "depth": 0, "args": args}
+
+
+def test_merge_aligns_clocks_and_links_trace_id(tmp_path):
+    """Two synthetic dumps with different wall-clock epochs and pids: the
+    merge renders distinct pid track groups, shifts the later process by the
+    epoch delta, and ``trace_ids_in`` maps the shared id to BOTH pids — the
+    'see the failover hop on one screen' contract."""
+    tid = "deadbeefcafe0001"
+    # router dispatched at its local ts=500us; replica (epoch 2.5s later)
+    # served at its local ts=100us
+    a = _synthetic_dump(
+        str(tmp_path / "flight_20260101_000000_100.json"), pid=100,
+        epoch_wall=1000.0,
+        spans=[_span("fleet_request", 500.0, trace_id=tid)],
+        reason="router drain")
+    b = _synthetic_dump(
+        str(tmp_path / "flight_20260101_000002_200.json"), pid=200,
+        epoch_wall=1002.5,
+        spans=[_span("prefill", 100.0, trace_id=tid),
+               _span("unrelated", 900.0)])
+    doc, used = correlate.merge_flight_dumps([a, b])
+    assert used == [a, b]
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    by_name = {e["name"]: e for e in evs}
+    # distinct track groups, one per source process
+    assert {e["pid"] for e in evs} == {100, 200}
+    # clock alignment: dump A is the reference (earliest epoch, offset 0);
+    # dump B shifts right by 2.5s
+    assert by_name["fleet_request"]["ts"] == pytest.approx(500.0)
+    assert by_name["prefill"]["ts"] == pytest.approx(2.5e6 + 100.0)
+    # the shared trace id links both process tracks
+    ids = correlate.trace_ids_in(doc)
+    assert ids[tid] == [100, 200]
+    # process_name metadata names each track group
+    pnames = {e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert set(pnames) == {100, 200}
+    assert "router drain" in pnames[100]
+
+
+def test_merge_skips_torn_dump_with_line_numbered_warning(tmp_path):
+    """A dump truncated mid-write (the exact artifact a SIGKILL produces)
+    is SKIPPED with a warning naming the file and parse line — the merge
+    still succeeds on the surviving dumps. Nothing usable → ValueError."""
+    good = _synthetic_dump(str(tmp_path / "flight_a.json"), pid=1,
+                           epoch_wall=1.0, spans=[_span("s", 0.0)])
+    full = json.dumps({"schema": flight.FLIGHT_SCHEMA, "epoch_wall": 2.0,
+                       "pid": 2, "spans": [_span("t", 0.0)]}, indent=1)
+    torn = str(tmp_path / "flight_torn.json")
+    with open(torn, "w") as f:
+        f.write(full[: len(full) // 2])  # cut mid-document
+    with pytest.warns(UserWarning, match=r"torn/partial.*line \d+"):
+        doc, used = correlate.merge_flight_dumps([good, torn])
+    assert used == [good]
+    assert {e["pid"] for e in doc["traceEvents"]} == {1}
+    # a well-formed but foreign JSON file is skipped too (merge directories
+    # hold merged outputs, configs, ...)
+    foreign = str(tmp_path / "flight_foreign.json")
+    json.dump({"hello": 1}, open(foreign, "w"))
+    with pytest.warns(UserWarning, match="not a galvatron-flight"):
+        _, used = correlate.merge_flight_dumps([good, foreign])
+    assert used == [good]
+    # every input torn → loud ValueError (an empty merge is operator error)
+    with pytest.warns(UserWarning):
+        with pytest.raises(ValueError, match="no readable flight dumps"):
+            correlate.merge_flight_dumps([torn])
+
+
+def test_trace_export_merge_cli(tmp_path):
+    """``cli trace-export --merge DIR`` walks per-replica subdirectories,
+    writes one merged document, and returns rc 0; an empty directory is rc
+    2 with a message, not a traceback."""
+    from galvatron_tpu.cli import main as cli_main
+
+    root = tmp_path / "fleet"
+    (root / "replica-0" / "flight").mkdir(parents=True)
+    _synthetic_dump(str(root / "flight_router.json"), pid=10, epoch_wall=5.0,
+                    spans=[_span("fleet_request", 0.0, trace_id="aa")])
+    _synthetic_dump(str(root / "replica-0" / "flight" / "flight_r0.json"),
+                    pid=20, epoch_wall=5.1,
+                    spans=[_span("prefill", 0.0, trace_id="aa")])
+    out = str(tmp_path / "merged.trace.json")
+    assert cli_main(["trace-export", str(root), "--merge", "-o", out]) == 0
+    doc = json.load(open(out))
+    assert correlate.trace_ids_in(doc)["aa"] == [10, 20]
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert cli_main(["trace-export", str(empty), "--merge"]) == 2
+
+
+def test_trace_export_torn_single_dump_is_rc2_not_traceback(tmp_path, capsys):
+    """Single-file export of a torn dump: rc 2 and a line-numbered message
+    pointing at the parse failure — forensics tooling must degrade on the
+    exact files crashes produce."""
+    from galvatron_tpu.cli import main as cli_main
+
+    torn = str(tmp_path / "flight_x.json")
+    with open(torn, "w") as f:
+        f.write('{\n "schema": "galvatron-flight-v1",\n "spans": [\n  {"na')
+    assert cli_main(["trace-export", torn]) == 2
+    out = capsys.readouterr().out
+    assert "torn/partial flight dump" in out
+    assert re.search(r"line \d+", out)
+
+
+# ---------------------------------------------------------------------------
+# trace-id propagation: off ⇒ no id, no header
+# ---------------------------------------------------------------------------
+
+
+class _FakeReplica:
+    port = 1
+    idx = 0
+
+    def begin_dispatch(self):
+        pass
+
+    def end_dispatch(self):
+        pass
+
+
+def test_router_mints_trace_id_only_when_tracing_armed():
+    """The router-side half of the zero-overhead pin: with the tracer
+    disabled ``_dispatch_loop`` passes trace_id=None downstream (no uuid
+    mint, no span); armed, it mints a 16-hex id and records the
+    fleet_request span carrying it."""
+    from galvatron_tpu.serving.fleet import FleetRouter
+
+    router = object.__new__(FleetRouter)  # wiring-free: only _dispatch_impl
+    seen = []
+    router._dispatch_impl = lambda body, deadline, tid, sp: seen.append(tid)
+    t = tracing.tracer
+    assert not t.enabled
+    FleetRouter._dispatch_loop(router, {"prompt": "x"}, None)
+    assert seen == [None]
+    t.enable(capacity=32)
+    try:
+        FleetRouter._dispatch_loop(router, {"prompt": "x"}, None)
+    finally:
+        t.disable()
+    assert re.fullmatch(r"[0-9a-f]{16}", seen[1])
+    spans = [s for s in t.snapshot() if s["name"] == "fleet_request"]
+    t.clear()
+    assert spans and spans[-1]["args"]["trace_id"] == seen[1]
+
+
+def test_proxy_header_present_iff_trace_id(monkeypatch):
+    """The wire half: X-Galvatron-Trace-Id rides the forwarded request
+    exactly when a trace id exists (tracing armed); tracing off sends no
+    correlation header at all."""
+    from galvatron_tpu.serving import fleet
+
+    captured = []
+
+    class _Resp:
+        status = 200
+
+        def read(self):
+            return b"{}"
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    monkeypatch.setattr(urllib.request, "urlopen",
+                        lambda req, timeout=None: captured.append(req) or _Resp())
+    fleet.FleetRouter._proxy(None, _FakeReplica(), {"prompt": "x"}, None,
+                             trace_id=None)
+    fleet.FleetRouter._proxy(None, _FakeReplica(), {"prompt": "x"}, None,
+                             trace_id="deadbeefcafe0002")
+    hdr = correlate.TRACE_HEADER
+    assert captured[0].get_header(hdr.capitalize()) is None
+    assert captured[1].get_header(hdr.capitalize()) == "deadbeefcafe0002"
+
+
+def test_lifecycle_instants_carry_trace_id_only_when_set():
+    """Replica side: a request admitted with the propagated id stamps it on
+    every lifecycle instant; an untraced request's instants carry no
+    trace_id key (exports stay byte-identical to the pre-correlation era)."""
+    from galvatron_tpu.serving.resilience import PREFILLING, advance
+    from galvatron_tpu.serving.scheduler import Request
+
+    t = tracing.tracer
+    t.enable(capacity=32)
+    try:
+        plain = Request(tokens=[1], max_new_tokens=1)
+        traced = Request(tokens=[1], max_new_tokens=1,
+                         trace_id="deadbeefcafe0003")
+        advance(plain, PREFILLING)
+        advance(traced, PREFILLING)
+    finally:
+        t.disable()
+    inst = [s for s in t.snapshot() if s["name"] == "req_prefilling"]
+    t.clear()
+    assert len(inst) == 2
+    assert "trace_id" not in inst[0]["args"]
+    assert inst[1]["args"]["trace_id"] == "deadbeefcafe0003"
+
+
+# ---------------------------------------------------------------------------
+# histogram aggregation + exposition lint
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_snapshot_merge_and_exposition():
+    """Fixed-bucket histograms aggregate by bucket addition (quantiles do
+    not): two replicas' snapshots merge into one fleet distribution whose
+    rendered exposition passes the CI linter."""
+    a, b = Histogram(buckets=(0.1, 1.0)), Histogram(buckets=(0.1, 1.0))
+    for v in (0.05, 0.5):
+        a.observe(v)
+    for v in (0.5, 5.0):
+        b.observe(v)
+    snap = Histogram.merge_snapshots([a.snapshot(), b.snapshot()])
+    assert snap["count"] == 4
+    assert snap["buckets"]["0.1"] == 1
+    assert snap["buckets"]["1.0"] == 3
+    assert snap["buckets"]["+Inf"] == 4
+    assert snap["sum"] == pytest.approx(6.05)
+    out = prom.PromText()
+    out.add_histogram("ttft_seconds", snap, help_="fleet TTFT")
+    text = out.render()
+    assert 'galvatron_ttft_seconds_bucket{le="+Inf"} 4' in text
+    assert "galvatron_ttft_seconds_count 4" in text
+    assert exposition_lint(text) == []
+    # the linter catches the failure modes aggregation bugs produce
+    bad = ("# TYPE x histogram\n"
+           'x_bucket{le="0.1"} 5\nx_bucket{le="1"} 3\n'
+           'x_bucket{le="+Inf"} 5\nx_sum 1\nx_count 5\n')
+    assert any("monoton" in e for e in exposition_lint(bad))
+    assert any("second TYPE" in e
+               for e in exposition_lint("# TYPE y gauge\n# TYPE y gauge\ny 1\n"))
+
+
+def test_merge_expositions_labels_and_fleet_sums():
+    """Router-side aggregation: per-replica scrapes gain a ``replica``
+    label; counters and histogram buckets sum into ``_fleet`` families,
+    gauges are labeled but never summed."""
+    r0 = ("# TYPE galvatron_serving_completed_total counter\n"
+          "galvatron_serving_completed_total 3\n"
+          "# TYPE galvatron_serving_queue_depth gauge\n"
+          "galvatron_serving_queue_depth 1\n")
+    r1 = ("# TYPE galvatron_serving_completed_total counter\n"
+          "galvatron_serving_completed_total 4\n"
+          "# TYPE galvatron_serving_queue_depth gauge\n"
+          "galvatron_serving_queue_depth 2\n")
+    text = merge_expositions({"0": r0, "1": r1})
+    assert 'galvatron_serving_completed_total{replica="0"} 3' in text
+    assert 'galvatron_serving_completed_total{replica="1"} 4' in text
+    assert re.search(
+        r"galvatron_serving_completed_total_fleet 7(\.0)?$", text, re.M)
+    # gauges keep per-replica identity; no meaningless fleet sum family
+    assert 'galvatron_serving_queue_depth{replica="1"} 2' in text
+    assert "queue_depth_fleet" not in text
+    assert exposition_lint(text) == []
+    # round-trip: the merged document still parses family-by-family
+    fams = parse_exposition(text)
+    assert any(f == "galvatron_serving_completed_total_fleet" for f in fams)
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate engine
+# ---------------------------------------------------------------------------
+
+
+def test_slo_ttft_breach_is_edge_triggered_and_fans_out(tmp_path):
+    """An induced TTFT burn: sustained slow samples raise ONE breach event
+    (edge, not level) carrying the schema version; gauges expose the level;
+    degraded_reasons surfaces it for /healthz; recovery emits slo_clear."""
+    events = str(tmp_path / "slo_events.jsonl")
+    rule = slo._override(slo.get_rule("ttft_p99"), threshold_s=0.1,
+                         window_fast_s=5.0, window_slow_s=30.0)
+    eng = slo.SLOEngine(rules=[rule], events_path=events, source="test")
+    t0 = 1000.0
+    raised = [eng.observe_latency("ttft_p99", 0.5, now=t0 + i * 0.1)
+              for i in range(20)]
+    # every sample bad → burn = 1/0.01 = 100 ≫ both thresholds; the FIRST
+    # breaching evaluation raises, the rest hold the level silently
+    assert sum(raised) == 1
+    gauges = {g["rule"]: g for g in eng.gauges()}
+    assert gauges["ttft_p99"]["breached"]
+    assert gauges["ttft_p99"]["breaches_total"] == 1
+    assert gauges["ttft_p99"]["value"] == pytest.approx(0.5)
+    assert eng.degraded_reasons() == ["slo:ttft_p99"]
+    # /metrics rendering (the same path server/fleet /metrics takes)
+    out = prom.PromText()
+    prom.render_slo(out, eng)
+    text = out.render()
+    assert 'galvatron_slo_breached{rule="ttft_p99"} 1' in text
+    assert exposition_lint(text) == []
+    # recovery: fast window fills with good samples → slo_clear fires
+    for i in range(200):
+        eng.observe_latency("ttft_p99", 0.01, now=t0 + 40.0 + i * 0.1)
+    assert eng.degraded_reasons() == []
+    eng.close()
+    recs = read_metrics(events)
+    breaches = [r for r in recs if r["event"] == slo.EVENT_NAME]
+    clears = [r for r in recs if r["event"] == "slo_clear"]
+    assert len(breaches) == 1 and len(clears) == 1
+    assert breaches[0]["schema"] == SCHEMA_VERSION
+    assert breaches[0]["rule"] == "ttft_p99"
+    assert breaches[0]["burn_fast"] >= rule.burn_fast
+    assert breaches[0]["source"] == "test"
+
+
+def test_slo_no_data_and_blip_do_not_breach():
+    """No samples → no burn rate → no breach; a single slow request inside
+    an otherwise-healthy window must never page (the slow window filters
+    blips — the whole point of multi-window burn rates)."""
+    rule = slo._override(slo.get_rule("ttft_p99"), threshold_s=0.1,
+                         window_fast_s=5.0, window_slow_s=60.0)
+    eng = slo.SLOEngine(rules=[rule])
+    assert eng.degraded_reasons() == []
+    t0 = 2000.0
+    for i in range(100):
+        eng.observe_latency("ttft_p99", 0.01, now=t0 + i * 0.5)
+    assert not eng.observe_latency("ttft_p99", 9.0, now=t0 + 50.0)
+    assert eng.degraded_reasons() == []
+    # unknown rule names are ignored, not errors (rule sets differ by role)
+    assert eng.observe("step_time_drift", bad=True) is False
+
+
+def test_build_rules_apply_flag_overrides():
+    """serve ``--slo_*`` flags override targets/thresholds/windows; the
+    trainer's drift flag doubles as arm switch so 0 must keep the table
+    default threshold, not install 0.0."""
+    from galvatron_tpu.core.arguments import build_parser
+
+    ns = build_parser("serve").parse_args(
+        ["--slo", "1", "--slo_ttft_p99_s", "0.5",
+         "--slo_availability", "0.9", "--slo_window_fast_s", "10"])
+    rules = {r.name: r for r in slo.build_serving_rules(ns)}
+    assert set(rules) == {"availability", "ttft_p99", "deadline_miss_ratio"}
+    assert rules["ttft_p99"].threshold_s == 0.5
+    assert rules["availability"].target == 0.9
+    assert rules["ttft_p99"].window_fast_s == 10.0
+    assert rules["deadline_miss_ratio"].target == 0.95  # table default holds
+
+    class _NS:
+        slo_step_time_drift = 0.0
+
+    (drift,) = slo.build_training_rules(_NS())
+    assert drift.threshold_s == 0.25  # 0 = off, never a 0.0 threshold
+    _NS.slo_step_time_drift = 0.4
+    (drift,) = slo.build_training_rules(_NS())
+    assert drift.threshold_s == 0.4
+
+
+# ---------------------------------------------------------------------------
+# schema forward compatibility
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_schema_forward_compat(tmp_path):
+    """A reader at schema N must accept records stamped with a HIGHER
+    version and unknown extra fields — rolling upgrades scrape old and new
+    processes through one aggregation path."""
+    p = str(tmp_path / "m.jsonl")
+    with MetricsLogger(p) as m:
+        m.log("train_iter", schema=SCHEMA_VERSION, step=1, loss=2.5)
+    with open(p, "a") as f:
+        f.write(json.dumps({
+            "event": "train_iter", "ts": 1.0, "schema": SCHEMA_VERSION + 7,
+            "step": 2, "loss": 2.4, "a_future_field": {"nested": [1, 2]},
+        }) + "\n")
+        f.write(json.dumps({
+            "event": "slo_breach", "ts": 2.0, "schema": SCHEMA_VERSION + 7,
+            "rule": "brand_new_rule", "novel": True,
+        }) + "\n")
+    recs = read_metrics(p)
+    assert len(recs) == 3
+    assert recs[0]["schema"] == SCHEMA_VERSION
+    assert recs[1]["a_future_field"] == {"nested": [1, 2]}
+    assert recs[2]["event"] == "slo_breach"
+    # and the current writers actually stamp the version they claim
+    assert recs[0]["event"] == "train_iter" and "schema" in recs[0]
+
+
+# ---------------------------------------------------------------------------
+# doc sync: DESIGN.md's SLO table IS slo.RULES
+# ---------------------------------------------------------------------------
+
+
+def test_design_doc_slo_table_matches_rules():
+    """DESIGN.md renders the declarative rule table; drift between doc and
+    code is a test failure, not a doc rot. Each rule's row must carry its
+    kind, target, and (when set) threshold."""
+    text = open(os.path.join(REPO, "docs", "DESIGN.md")).read()
+    rows = {}
+    for line in text.splitlines():
+        m = re.match(r"\|\s*`(\w+)`\s*\|", line)
+        if m and m.group(1) in {r.name for r in slo.RULES}:
+            rows[m.group(1)] = line
+    assert set(rows) == {r.name for r in slo.RULES}, (
+        "DESIGN.md SLO table out of sync with slo.RULES")
+    for r in slo.RULES:
+        row = rows[r.name]
+        assert r.kind in row, f"{r.name}: kind {r.kind!r} missing from doc"
+        assert f"{r.target:g}" in row, f"{r.name}: target not documented"
+        if r.threshold_s is not None:
+            assert f"{r.threshold_s:g}" in row, (
+                f"{r.name}: threshold not documented")
+    # the propagation header is documented by its exact wire name
+    assert correlate.TRACE_HEADER in text
