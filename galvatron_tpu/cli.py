@@ -10,7 +10,18 @@ models/<name>/{train_dist,search_dist,profiler}.py + profile_hardware):
                     crash) into restart-with-jittered-backoff or give-up
                     decisions, a topology change (pod shrink) triggers an
                     automatic re-search + portable resume under the new
-                    plan, and --step_timeout_s arms a hang watchdog
+                    plan, and --step_timeout_s arms a hang watchdog;
+                    --peer_replicate N keeps an in-memory peer replica of
+                    every interval save (core/peer_store.py) so a host
+                    killed without grace restores from RAM, a preemption
+                    NOTICE (--preempt_notice_file / SIGTERM) drains within
+                    --preempt_grace_s, a shrink continues at degraded DP
+                    width down to --degraded_min_dp, and
+                    --heartbeat_timeout_s kills+restarts a child whose
+                    per-step heartbeat goes stale
+  peer-store        run one in-memory peer checkpoint store daemon
+                    (core/peer_store.py serve; the elastic supervisor
+                    spawns these itself under --peer_replicate)
   search            parallelism optimization → galvatron_config JSON
   profile           model computation/memory profiling → JSON
   profile-hardware  ICI bandwidth + overlap sweep → JSON
@@ -79,6 +90,13 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         from galvatron_tpu.core.elastic import run_elastic
 
         return run_elastic(rest, model_default)
+
+    if mode == "peer-store":
+        # standalone daemon entry (multi-host deployments run one per host;
+        # the sim supervisor spawns its own): `cli peer-store serve ...`
+        from galvatron_tpu.core.peer_store import main as peer_store_main
+
+        return peer_store_main(rest)
 
     if mode == "search":
         ns = initialize_galvatron("search", rest, model_default)
@@ -469,8 +487,8 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
 
     print(
         f"unknown mode {mode!r}; expected "
-        "train|run-elastic|search|profile|profile-hardware|check-plan|warmup|"
-        "trace-export|generate|serve|serve-fleet|export-hf"
+        "train|run-elastic|peer-store|search|profile|profile-hardware|"
+        "check-plan|warmup|trace-export|generate|serve|serve-fleet|export-hf"
     )
     return 2
 

@@ -275,6 +275,40 @@ def _add_training_args(p: argparse.ArgumentParser):
                    help="topology-change re-plan: per-device memory budget "
                    "for the re-search (no profile exists for a mesh that "
                    "appeared mid-run; analytic costs are used)")
+    # preemption-aware recovery (core/peer_store.py + core/preemption.py;
+    # docs/DESIGN.md § Recovery paths)
+    g.add_argument("--peer_replicate", type=int, default=0,
+                   help="run-elastic: in-memory peer checkpoint replication "
+                   "— spawn this many peer-store host processes and have the "
+                   "child ring-replicate its state to a neighbor's RAM after "
+                   "every interval save; a killed host resumes from the "
+                   "newest surviving replica without touching storage, and a "
+                   "storage outage degrades to the RAM tier instead of "
+                   "failing the save. 0 = off")
+    g.add_argument("--preempt_grace_s", type=float, default=30.0,
+                   help="grace window after a preemption notice (SIGTERM or "
+                   "the notice file): the trainer drains — finishes the "
+                   "in-flight step, pushes the peer replica, commits an "
+                   "expedited save — and exits preempted (75) before it "
+                   "expires")
+    g.add_argument("--preempt_notice_file", type=str, default=None,
+                   help="pollable preemption-notice path (stands in for the "
+                   "cloud metadata server): its existence is the eviction "
+                   "notice; also settable via GALVATRON_PREEMPT_NOTICE")
+    g.add_argument("--degraded_min_dp", type=int, default=1,
+                   help="degraded-mesh continuation floor: after a peer "
+                   "loss, continue at reduced DP width (global batch "
+                   "preserved via grad accumulation) only while the width "
+                   "stays >= this; below it the re-plan is infeasible and "
+                   "the supervisor gives up (waiting beats limping)")
+    g.add_argument("--heartbeat_timeout_s", type=float, default=0.0,
+                   help="run-elastic: supervisor-side heartbeat watchdog — "
+                   "the child touches a heartbeat file every step; no beat "
+                   "for this many seconds and the supervisor SIGKILLs the "
+                   "child and restarts it as a hang (the last line of "
+                   "defense when the child is too wedged for its own "
+                   "--step_timeout_s watchdog). First beat gets a "
+                   "compile-length grace (20x, min 120s). 0 = off")
 
 
 def _add_search_args(p: argparse.ArgumentParser):

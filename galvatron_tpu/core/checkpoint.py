@@ -465,6 +465,10 @@ def _save_checkpoint_impl(
     ckpt_dir: str, state: Any, step: int, keep_last_n: int = 0,
     meta: Optional[Dict[str, Any]] = None,
 ) -> str:
+    # injection point: a storage outage fails the whole save (per-save, not
+    # per-attempt — see faults.storage_outage_gate). Raised before any
+    # staging I/O so the directory is left exactly as it was.
+    faults.storage_outage_gate()
     ocp = _ocp()
     base = os.path.abspath(ckpt_dir)
     final = os.path.join(base, f"step_{step}")
@@ -666,11 +670,20 @@ def save_checkpoint_portable(
     at any (pp, vpp, schedule, division) restores into any other — the
     cross-layout resume the reference cannot express (its trainer never
     saves at all, SURVEY §5)."""
+    flat = portable_flat_state(state, runtime)
+    return save_checkpoint(
+        ckpt_dir, flat, step, keep_last_n=keep_last_n, meta=meta
+    )
+
+
+def portable_flat_state(state: Any, runtime) -> Any:
+    """The PORTABLE (flat-layers) view of a train state — the tree the disk
+    checkpoint and the in-memory peer replica (core/peer_store.py) both
+    serialize, so the two recovery tiers share one schema. Identity when
+    the runtime has no stage stacks to unstack."""
     f = runtime.flatten_params
     if f is None:
-        return save_checkpoint(
-            ckpt_dir, state, step, keep_last_n=keep_last_n, meta=meta
-        )
+        return state
 
     def flatten_state(st):
         out = dict(st)
@@ -679,10 +692,74 @@ def save_checkpoint_portable(
         return out
 
     # one compiled program instead of per-leaf eager slice dispatches
-    flat = jax.jit(flatten_state)(state)
-    return save_checkpoint(
-        ckpt_dir, flat, step, keep_last_n=keep_last_n, meta=meta
+    return jax.jit(flatten_state)(state)
+
+
+def restore_from_flat_leaves(runtime, leaves: Dict[str, np.ndarray]) -> Any:
+    """Seat a ``{keypath: ndarray}`` map (a deserialized peer replica — the
+    portable flat layout on the wire) onto this runtime's live state.
+
+    Structure and shardings come from the runtime's own abstract flat tree
+    (exactly like a flat disk restore); only content comes from the
+    replica. Keypath/shape/dtype mismatches raise
+    :class:`CheckpointCorruptError` — the caller's signal to fall back to
+    the disk tier — never a silent partial resume."""
+    flat_abstract = (
+        flat_abstract_state_of(runtime)
+        if runtime.restack_params is not None
+        else abstract_state_of(runtime)
     )
+    paths, treedef = jax.tree_util.tree_flatten_with_path(flat_abstract)
+    want = {jax.tree_util.keystr(kp): s for kp, s in paths}
+    missing = sorted(set(want) - set(leaves))
+    extra = sorted(set(leaves) - set(want))
+    if missing or extra:
+        raise CheckpointCorruptError(
+            f"peer replica structure mismatch: {len(missing)} leaves missing "
+            f"(e.g. {missing[:3]}), {len(extra)} unexpected (e.g. {extra[:3]})"
+        )
+    seated = []
+    for kp, s in paths:
+        k = jax.tree_util.keystr(kp)
+        arr = leaves[k]
+        if tuple(arr.shape) != tuple(s.shape) or np.dtype(arr.dtype) != np.dtype(s.dtype):
+            raise CheckpointCorruptError(
+                f"peer replica leaf {k} is {arr.shape}/{arr.dtype}, runtime "
+                f"expects {tuple(s.shape)}/{np.dtype(s.dtype)}"
+            )
+        # seat every shard through its OWN device_put: a whole-array
+        # device_put of a replicated host array can hand multiple devices
+        # the SAME underlying CPU buffer, and the trainer's donating
+        # dispatch then applies the in-place update once per device to that
+        # shared buffer — observed as step counters flakily advancing by
+        # the replica count (and params double-applying updates) after a
+        # peer-replica resume. Distinct per-shard buffers keep donation
+        # sound.
+        imap = s.sharding.addressable_devices_indices_map(tuple(arr.shape))
+        shards = [
+            jax.device_put(np.asarray(arr[idx], dtype=arr.dtype), d)
+            for d, idx in imap.items()
+        ]
+        seated.append(
+            jax.make_array_from_single_device_arrays(
+                tuple(arr.shape), s.sharding, shards
+            )
+        )
+    flat = jax.tree_util.tree_unflatten(treedef, seated)
+    r = runtime.restack_params
+    if r is None:
+        jax.block_until_ready(flat)
+        return flat
+
+    def restack_state(st):
+        out = dict(st)
+        out["params"] = r(st["params"])
+        out["opt"] = {**st["opt"], "mu": r(st["opt"]["mu"]), "nu": r(st["opt"]["nu"])}
+        return out
+
+    restored = jax.jit(restack_state, out_shardings=runtime.state_shardings)(flat)
+    jax.block_until_ready(restored)
+    return restored
 
 
 def _tree_keypaths(tree) -> set:

@@ -51,6 +51,22 @@ Chaos simulation: ``GALVATRON_FAULTS`` is handed to the FIRST child only
 ``GALVATRON_FAULTS_WORLD="8,4"`` runs child k on a virtual CPU platform of
 the k-th width (clamped to the last entry) — a reproducible 8→4 shrink on
 any host, across real process restarts.
+
+Preemption-aware extensions (this supervisor side):
+
+- ``--peer_replicate N`` spawns N in-memory peer-store daemons
+  (`core/peer_store.py`) standing in for surviving hosts' RAM; every child
+  gets their addresses (``GALVATRON_PEER_STORE``) and ring-replicates each
+  interval save. A child killed without grace then restores from the
+  replica — newer than anything disk holds when storage was out.
+- ``--heartbeat_timeout_s T`` makes the default spawn a monitored
+  ``Popen``: the child beats ``<save>/heartbeat`` every step
+  (``GALVATRON_HEARTBEAT_FILE``) and a stale beat gets the child SIGKILLed
+  and accounted as a hang — the last line of defense when the child is too
+  wedged for its own in-process watchdog.
+- a graceful preemption WITH progress is a *free* restart
+  (`core/restart_policy.py`): spot capacity can be evicted more than
+  ``--max_restarts`` times in a healthy week.
 """
 
 from __future__ import annotations
@@ -434,10 +450,13 @@ def run_elastic(
         # survive on the supervisor's scrape target across child restarts
         # with no IPC and no second port. A user-passed --metrics_path is
         # honored; otherwise one is injected beside the checkpoints.
-        if getattr(ns, "metrics_path", None):
-            stats.child_metrics_path = ns.metrics_path
-        elif ns.save:
-            stats.child_metrics_path = os.path.join(ns.save, "train_metrics.jsonl")
+    # the child metrics JSONL is always placed (sidecar or not): the
+    # supervisor's recovery accounting below tails it for the child's
+    # `recovery` events, which is how MTTR becomes a supervisor-side fact
+    if getattr(ns, "metrics_path", None):
+        stats.child_metrics_path = ns.metrics_path
+    elif ns.save:
+        stats.child_metrics_path = os.path.join(ns.save, "train_metrics.jsonl")
     worlds = faults.world_schedule()
     # the shared supervisor decision table (core/restart_policy.py):
     # consecutive-no-progress budget, progress-resets-streak, full-jitter
@@ -448,6 +467,7 @@ def run_elastic(
         backoff_s=ns.restart_backoff_s,
         backoff_cap_s=ns.restart_backoff_cap_s,
     )
+    user_spawn = spawn is not None
     if spawn is None:
         spawn = lambda c, env: subprocess.call(c, env=env)  # noqa: E731
 
@@ -472,8 +492,102 @@ def run_elastic(
         events.log(event, **fields)
         tracer.instant(f"elastic_{event}", **fields)
 
+    # --- in-memory peer replica tier (--peer_replicate N) ---------------
+    # N peer-store daemons stand in for the OTHER hosts of the slice: their
+    # RAM outlives any one child, so a child killed without grace restores
+    # from its ring neighbor instead of the last disk commit. Best-effort
+    # by contract — a daemon that fails to come up degrades the run to
+    # disk-only, it never blocks training.
+    from galvatron_tpu.core import peer_store as peer_store_mod
+
+    peer_n = int(getattr(ns, "peer_replicate", 0) or 0)
+    peer_procs: List[subprocess.Popen] = []
+    peer_addrs: List[str] = []
+    if peer_n > 0:
+        import tempfile
+
+        ann_dir = tempfile.mkdtemp(prefix="galvatron_peers_")
+        try:
+            for i in range(peer_n):
+                ann = os.path.join(ann_dir, f"peer{i}.addr")
+                peer_procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "galvatron_tpu.core.peer_store",
+                     "serve", "--announce", ann],
+                    env=child_pythonpath_env(os.environ),
+                ))
+                deadline = time.monotonic() + 30.0
+                while not (os.path.exists(ann) and os.path.getsize(ann)):
+                    if peer_procs[-1].poll() is not None:
+                        raise RuntimeError(
+                            f"peer store {i} exited rc={peer_procs[-1].returncode}"
+                        )
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(f"peer store {i} never announced")
+                    time.sleep(0.05)
+                with open(ann) as f:
+                    peer_addrs.append(f.read().strip())
+            note("peer_store_start", count=peer_n,
+                 addrs=",".join(peer_addrs))
+        except Exception as e:  # noqa: BLE001 — RAM tier is optional
+            print(f"run-elastic: peer stores unavailable ({e}); "
+                  f"continuing disk-only", file=sys.stderr, flush=True)
+            for p in peer_procs:
+                p.kill()
+            peer_procs, peer_addrs = [], []
+
+    # --- supervisor-side heartbeat watchdog (--heartbeat_timeout_s) -----
+    from galvatron_tpu.core.watchdog import HEARTBEAT_ENV, HeartbeatMonitor
+
+    hb_timeout = float(getattr(ns, "heartbeat_timeout_s", 0) or 0)
+    hb_path = None
+    if hb_timeout > 0:
+        hb_path = (
+            os.path.join(ns.save, "heartbeat") if ns.save
+            else os.path.join(
+                __import__("tempfile").gettempdir(),
+                f"galvatron_hb_{os.getpid()}",
+            )
+        )
+        stats.watchdog_armed = True
+    if hb_timeout > 0 and not user_spawn:
+        def spawn(cmd, env, _hb=hb_path):  # noqa: F811 — monitored default
+            # fresh file per child: a stale beat from the previous
+            # incarnation must not vouch for this one
+            try:
+                os.remove(_hb)
+            except OSError:
+                pass
+            mon = HeartbeatMonitor(
+                _hb,
+                # the first beat waits out XLA compilation — same
+                # compile-length grace reasoning as HangWatchdog's warmup
+                first_beat_grace_s=max(20.0 * hb_timeout, 120.0),
+            )
+            proc = subprocess.Popen(cmd, env=env)
+            poll_s = max(0.05, min(0.5, hb_timeout / 4.0))
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    return rc
+                if mon.stale(hb_timeout):
+                    age = mon.last_beat_age_s()
+                    note("watchdog_kill", reason="heartbeat_stale",
+                         age_s=None if age is None else round(age, 2),
+                         timeout_s=hb_timeout)
+                    print(
+                        f"run-elastic: child heartbeat stale "
+                        f"(> {hb_timeout}s); killing child",
+                        file=sys.stderr, flush=True,
+                    )
+                    proc.kill()
+                    proc.wait()
+                    return EXIT_HANG
+                time.sleep(poll_s)
+
     attempt = 0  # children launched so far
     rc_final = 1
+    prev_exit_ts: Optional[float] = None  # wall time the last child died
+    recovery_seen_ts = 0.0  # newest child `recovery` event already counted
     note("supervisor_start", max_restarts=ns.max_restarts,
          step_timeout_s=float(getattr(ns, "step_timeout_s", 0) or 0),
          sim_worlds=",".join(map(str, worlds)) if worlds else None)
@@ -481,12 +595,37 @@ def run_elastic(
         while True:
             prev_step = _last_step(ns.save)
             env = _child_env(os.environ, attempt, worlds)
+            if peer_addrs:
+                env[peer_store_mod.ADDRS_ENV] = ",".join(peer_addrs)
+                env[peer_store_mod.RANK_ENV] = "0"
+            if hb_path:
+                env[HEARTBEAT_ENV] = hb_path
             stats.child_alive = True
             stats.world_size = int(env[SIM_WORLD_ENV]) if SIM_WORLD_ENV in env else None
             note("child_start", attempt=attempt,
                  world=stats.world_size, resumed_from=prev_step)
             rc = spawn(_child_cmd(), env)
             stats.child_alive = False
+            exit_ts = time.time()
+            # recovery accounting: the child logs a `recovery` event when it
+            # restored (peer replica or disk); MTTR is that event's wall
+            # time minus the PREVIOUS child's death — the operator's "how
+            # long was the run actually down".
+            for ev in _scan_recoveries(stats.child_metrics_path,
+                                       recovery_seen_ts):
+                recovery_seen_ts = max(recovery_seen_ts, float(ev.get("ts") or 0.0))
+                stats.recoveries_total += 1
+                stats.last_recovery_source = ev.get("source")
+                mttr_ms = None
+                if prev_exit_ts is not None and isinstance(
+                    ev.get("ts"), (int, float)
+                ):
+                    mttr_ms = max(0.0, (ev["ts"] - prev_exit_ts) * 1000.0)
+                    stats.last_recovery_ms = mttr_ms
+                note("recovery_observed", source=ev.get("source"),
+                     step=ev.get("step"),
+                     mttr_ms=None if mttr_ms is None else round(mttr_ms, 1))
+            prev_exit_ts = exit_ts
             mode = classify_exit(rc)
             new_step = _last_step(ns.save)
             progressed = new_step is not None and (
@@ -533,7 +672,14 @@ def run_elastic(
             # backoff here only donates pod-hours to the void (the failure
             # still counts against the no-progress budget)
             decision = policy.on_failure(
-                progressed, immediate=(mode == "preempted")
+                progressed, immediate=(mode == "preempted"),
+                # a graceful preemption that made progress is the platform's
+                # EXPECTED lifecycle, not a failure of the run: it costs no
+                # restart budget (spot capacity can be evicted more than
+                # --max_restarts times in a healthy week). Preemptions
+                # WITHOUT progress still count — a preempt-loop that never
+                # advances must exhaust the budget.
+                free=(mode == "preempted" and progressed),
             )
             if decision.give_up:
                 print(f"run-elastic: giving up — {decision.consecutive} "
@@ -543,6 +689,21 @@ def run_elastic(
                 note("give_up", reason="restart_budget", attempts=attempt,
                      consecutive=decision.consecutive)
                 break
+            # the eviction notice belongs to the OLD placement: a real
+            # rescheduled host starts with a clean metadata flag, so the
+            # supervisor clears the simulated one — a stale notice would
+            # make every restarted child drain immediately, a preempt loop
+            # that never advances
+            notice_path = getattr(ns, "preempt_notice_file", None) or \
+                os.environ.get("GALVATRON_PREEMPT_NOTICE")
+            if mode == "preempted" and notice_path:
+                try:
+                    os.remove(notice_path)
+                    note("preempt_notice_cleared", path=notice_path)
+                except FileNotFoundError:
+                    pass
+                except OSError:
+                    pass
             delay = decision.backoff_s
             stats.restarts_total += 1
             note("restart", attempt=attempt, mode=mode,
@@ -561,6 +722,15 @@ def run_elastic(
                        f"(last child: {stats.last_exit_mode})",
                 extra={"restarts_total": stats.restarts_total},
             )
+        # peer-store daemons die with their supervisor: their whole point is
+        # RAM that outlives any one CHILD — an orphaned daemon after the
+        # run would just hold a stale replica nobody can restore
+        for p in peer_procs:
+            try:
+                p.terminate()
+                p.wait(timeout=5)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                p.kill()
         events.close()
         if obs_server is not None:
             obs_server.close()
@@ -568,6 +738,26 @@ def run_elastic(
             tracer.disable()
             tracer.clear()
     return rc_final
+
+
+def _scan_recoveries(metrics_path: Optional[str],
+                     since_ts: float) -> List[Dict[str, Any]]:
+    """Child ``recovery`` events newer than ``since_ts`` from the child's
+    train-metrics JSONL. Pure file read, tolerant of a missing/torn file —
+    recovery accounting must never take down the supervisor."""
+    if not metrics_path or not os.path.exists(metrics_path):
+        return []
+    from galvatron_tpu.utils.metrics import read_metrics
+
+    try:
+        recs = read_metrics(metrics_path)
+    except Exception:  # noqa: BLE001 — accounting is best-effort
+        return []
+    return [
+        r for r in recs
+        if r.get("event") == "recovery"
+        and float(r.get("ts") or 0.0) > since_ts
+    ]
 
 
 def _last_step(save_dir: Optional[str]) -> Optional[int]:

@@ -32,6 +32,22 @@ Supported faults:
   batch index N sleeps S seconds before dispatch (the stalled-collective
   scenario ``core/signals.py`` documents), tripping ``--step_timeout_s``'s
   hang watchdog.
+- ``kill_host_mid_step=N`` — SIGKILL to the process itself mid-step at
+  global batch index N (once): the host-loss scenario. Nothing runs after
+  it — no emergency save, no graceful exit — so recovery must come from a
+  committed checkpoint or the in-memory peer replica
+  (``core/peer_store.py``).
+- ``preempt_with_grace=N`` — at global batch index N, write the
+  preemption *notice file* (``GALVATRON_PREEMPT_NOTICE`` /
+  ``--preempt_notice_file``) instead of a signal — the metadata-server
+  eviction-notice scenario; the trainer's PreemptionListener must drain
+  (expedited replicated save) within ``--preempt_grace_s`` and exit
+  preempted.
+- ``storage_outage=N`` — the next N checkpoint *save operations* fail
+  wholesale with ``OSError`` (consumed per save, not per attempt — the
+  outage outlasts any retry budget). With peer replication armed the
+  trainer degrades to the RAM replica and keeps training; without it the
+  save failure surfaces.
 
 Serving faults (the serving chaos harness — injected at the engine's
 iteration seam, so recovery exercises exactly the crash-supervision /
@@ -148,6 +164,45 @@ def maybe_preempt(step: int) -> None:
     if k is not None and step == int(k):
         del _active["preempt_at_step"]
         os.kill(os.getpid(), _signal.SIGTERM)
+
+
+def maybe_kill_host(step: int) -> None:
+    """Armed ``kill_host_mid_step=N``: SIGKILL this process at batch index
+    N — once. Unlike :func:`maybe_preempt` nothing downstream runs: the
+    kernel reaps the process before any handler, exactly what a host loss
+    looks like to the survivors. Delivered mid-step (after the batch
+    fetch, before the update), the worst window: the batch is fetched but
+    its work is lost."""
+    k = _active.get("kill_host_mid_step")
+    if k is not None and step == int(k):
+        del _active["kill_host_mid_step"]
+        os.kill(os.getpid(), _signal.SIGKILL)
+
+
+def maybe_preempt_notice(step: int, notice_file: Optional[str] = None) -> None:
+    """Armed ``preempt_with_grace=N``: at batch index N, create the
+    preemption notice file — once. The path comes from the argument or
+    ``GALVATRON_PREEMPT_NOTICE``; unarmed or pathless, a no-op."""
+    k = _active.get("preempt_with_grace")
+    if k is None or step != int(k):
+        return
+    path = notice_file or os.environ.get("GALVATRON_PREEMPT_NOTICE")
+    if not path:
+        return
+    del _active["preempt_with_grace"]
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"preempt notice injected at step {step}\n")
+    os.replace(tmp, path)
+
+
+def storage_outage_gate() -> None:
+    """Armed ``storage_outage=N``: the next N checkpoint saves fail with
+    ``OSError`` at the top of the save path — one consume per SAVE (not
+    per retry attempt, unlike ``fail_io``), so the outage outlasts the
+    retry budget and the caller's degraded path is what gets proven."""
+    if _consume("storage_outage"):
+        raise OSError("injected storage outage (checkpoint save)")
 
 
 def maybe_hang(step: int) -> None:

@@ -1,0 +1,406 @@
+"""In-memory peer checkpoint replication (the Gemini-style recovery tier).
+
+Disk checkpoints (core/checkpoint.py) bound the loss of a failure to one
+save interval *plus* a full storage round-trip. Production trainers (Gemini
+SOSP '23, Varuna EuroSys '22) add a faster tier: after every interval save,
+each DP peer serializes its shard and ships it to a *neighbor host's RAM*,
+so losing one host reconstructs state from the survivors without touching
+storage at all — and a storage outage no longer means losing work, because
+the replica is the floor.
+
+This module is that tier's transport and store:
+
+- :class:`PeerStoreServer` — a tiny length-prefixed-frame TCP server
+  holding the newest replica per peer rank in process RAM. In the sim
+  world it runs as a separate OS process (``python -m
+  galvatron_tpu.core.peer_store serve``) so a SIGKILL of the training
+  child genuinely proves recovery from *surviving* host memory; on a
+  real fleet the same framing would ride DCN between hosts.
+- :class:`PeerStoreClient` — ``put`` to the ring neighbor, ``get_newest``
+  across all reachable stores (restart does not know which neighbor held
+  its replica), ``ping``/``stats``.
+- :func:`serialize_state` / :func:`deserialize_state` — the wire payload:
+  an ``.npz`` archive of host-gathered leaves keyed by their pytree
+  keypaths, plus a JSON header (step / batches / samples / fingerprint)
+  and a sha256 content digest verified end-to-end on restore. A replica
+  whose digest does not match is *corrupt* and the restore path falls
+  back to disk with a ``ckpt_fallback`` event — never a silent bad
+  resume.
+
+The store is deliberately dumb: newest-wins per peer rank, no persistence,
+no replication of its own. Durability past simultaneous host loss is the
+disk tier's job; this tier only has to beat it on the common case (one
+host lost, N-1 survive).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import socket
+import socketserver
+import struct
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: child-side env vars set by the elastic supervisor under --peer_replicate
+ADDRS_ENV = "GALVATRON_PEER_STORE"
+RANK_ENV = "GALVATRON_PEER_RANK"
+
+_LEN = struct.Struct(">I")
+_MAX_HEADER = 1 << 20  # headers are small JSON; 1 MB is a framing-error guard
+
+
+class PeerStoreError(RuntimeError):
+    """Transport or protocol failure talking to a peer store."""
+
+
+class ReplicaCorruptError(PeerStoreError):
+    """A fetched replica failed its content-digest check — the restore path
+    must fall back to the disk tier (``ckpt_fallback``), never use it."""
+
+
+def ring_neighbor(rank: int, world: int) -> int:
+    """The ring-replication target of ``rank`` in a ``world``-peer ring."""
+    if world < 1:
+        raise ValueError(f"ring needs at least one peer, got world={world}")
+    return (rank + 1) % world
+
+
+# ---------------------------------------------------------------------------
+# payload (de)serialization
+# ---------------------------------------------------------------------------
+
+
+def _digest(payload: bytes) -> str:
+    return "sha256:" + hashlib.sha256(payload).hexdigest()
+
+
+def serialize_state(state: Any, step: int, meta: Optional[Dict[str, Any]] = None,
+                    ) -> Tuple[bytes, Dict[str, Any]]:
+    """Host-gather a (flat portable) state pytree into one ``.npz`` payload.
+
+    Returns ``(payload, header)`` where ``header`` carries the step, the
+    caller's meta (batches/samples/fingerprint — the same dict the disk
+    manifest records) and the payload's sha256 digest. Leaves are stored
+    under their ``jax.tree_util.keystr`` keypaths so the restore side can
+    re-seat them onto *its own* abstract tree — structure always comes
+    from the live runtime, only content crosses the wire."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    arrays: Dict[str, np.ndarray] = {}
+    keys: List[str] = []
+    for i, (kp, leaf) in enumerate(flat):
+        keys.append(jax.tree_util.keystr(kp))
+        arr = np.asarray(leaf)
+        if arr.ndim:
+            # NOT on 0-d leaves: ascontiguousarray promotes () to (1,),
+            # and the restore side shape-checks against the runtime's
+            # abstract tree (opt step counters are genuine scalars)
+            arr = np.ascontiguousarray(arr)
+        arrays[f"a{i}"] = arr
+    buf = io.BytesIO()
+    np.savez(buf, __keys__=np.array(json.dumps(keys)), **arrays)
+    payload = buf.getvalue()
+    header = {
+        "step": int(step),
+        "digest": _digest(payload),
+        "nbytes": len(payload),
+        "meta": dict(meta or {}),
+    }
+    return payload, header
+
+
+def deserialize_state(payload: bytes, header: Optional[Dict[str, Any]] = None,
+                      ) -> Dict[str, np.ndarray]:
+    """Payload → ``{keypath: ndarray}``. When ``header`` is given, the
+    payload digest is verified FIRST — corrupt compressed bytes must never
+    reach the array decoder (same rule as checkpoint.verify_files)."""
+    if header is not None:
+        want = header.get("digest")
+        if want and _digest(payload) != want:
+            raise ReplicaCorruptError(
+                f"replica step {header.get('step')} digest mismatch "
+                f"(expected {want})"
+            )
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+            keys = json.loads(str(z["__keys__"]))
+            return {k: z[f"a{i}"] for i, k in enumerate(keys)}
+    except ReplicaCorruptError:
+        raise
+    except Exception as e:
+        raise ReplicaCorruptError(
+            f"replica payload undecodable: {type(e).__name__}: {str(e)[:200]}"
+        ) from e
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def _send_frame(sock: socket.socket, header: Dict[str, Any],
+                payload: bytes = b"") -> None:
+    h = json.dumps(header).encode()
+    sock.sendall(_LEN.pack(len(h)) + h + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        c = sock.recv(min(n, 1 << 20))
+        if not c:
+            raise PeerStoreError("peer store connection closed mid-frame")
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[Dict[str, Any], bytes]:
+    hlen = _LEN.unpack(_recv_exact(sock, _LEN.size))[0]
+    if hlen > _MAX_HEADER:
+        raise PeerStoreError(f"peer store header too large ({hlen} bytes)")
+    header = json.loads(_recv_exact(sock, hlen).decode())
+    payload = _recv_exact(sock, int(header.get("nbytes", 0)))
+    return header, payload
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):  # noqa: D102 — protocol dispatch
+        store: "PeerStoreServer" = self.server.peer_store  # type: ignore[attr-defined]
+        try:
+            header, payload = _recv_frame(self.request)
+        except (PeerStoreError, ValueError, OSError):
+            return  # torn/garbage frame: drop the connection
+        op = header.get("op")
+        if op == "put":
+            store._put(int(header.get("peer", 0)), header, payload)
+            _send_frame(self.request, {"ok": True})
+        elif op == "get":
+            rec = store._newest(header.get("peer"))
+            if rec is None:
+                _send_frame(self.request, {"ok": False, "error": "empty"})
+            else:
+                h, p = rec
+                _send_frame(self.request, {**h, "ok": True}, p)
+        elif op == "list":
+            _send_frame(self.request, {"ok": True, "replicas": store.stats()})
+        elif op == "ping":
+            _send_frame(self.request, {"ok": True, "replicas": len(store._replicas)})
+        else:
+            _send_frame(self.request, {"ok": False, "error": f"bad op {op!r}"})
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PeerStoreServer:
+    """One simulated peer host's RAM: newest replica per peer rank.
+
+    ``start()`` binds an ephemeral (or given) loopback port and serves on a
+    daemon thread; tests construct it in-process, the chaos harness runs it
+    as its own OS process via the module CLI so the training child's death
+    cannot take the replicas with it."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = _Server((host, port), _Handler)
+        self._srv.peer_store = self  # type: ignore[attr-defined]
+        self.host, self.port = self._srv.server_address[:2]
+        self._lock = threading.Lock()
+        self._replicas: Dict[int, Tuple[Dict[str, Any], bytes]] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "PeerStoreServer":
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="peer-store", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _put(self, peer: int, header: Dict[str, Any], payload: bytes) -> None:
+        with self._lock:
+            old = self._replicas.get(peer)
+            # newest-wins: a late/duplicate push of an older step must not
+            # roll the survivable state backwards
+            if old is None or int(header.get("step", -1)) >= int(old[0].get("step", -1)):
+                self._replicas[peer] = (dict(header), payload)
+
+    def _newest(self, peer: Optional[int] = None):
+        with self._lock:
+            if peer is not None:
+                return self._replicas.get(int(peer))
+            best = None
+            for rec in self._replicas.values():
+                if best is None or int(rec[0].get("step", -1)) > int(best[0].get("step", -1)):
+                    best = rec
+            return best
+
+    def stats(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [
+                {"peer": p, "step": h.get("step"), "nbytes": h.get("nbytes"),
+                 "digest": h.get("digest")}
+                for p, (h, _) in sorted(self._replicas.items())
+            ]
+
+    # test hook: tamper with a held replica in place (storage-corruption
+    # analog for the RAM tier) without reaching into private state from tests
+    def corrupt_replica(self, peer: int) -> None:
+        with self._lock:
+            h, p = self._replicas[peer]
+            flipped = bytearray(p)
+            mid = len(flipped) // 2
+            for i in range(mid, min(mid + 64, len(flipped))):
+                flipped[i] ^= 0xFF
+            self._replicas[peer] = (h, bytes(flipped))
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class PeerStoreClient:
+    """Ring-replication client over one or more peer stores.
+
+    ``addrs`` is the full ring (every store's ``host:port``); ``rank`` is
+    this peer's position. ``put`` targets the ring neighbor only — that is
+    the replication cost model being simulated (one extra copy per peer,
+    not N) — while ``get_newest`` asks every reachable store, because a
+    restarted host does not know which survivor holds its replica."""
+
+    def __init__(self, addrs: List[str], rank: int = 0,
+                 timeout_s: float = 10.0):
+        if not addrs:
+            raise ValueError("PeerStoreClient needs at least one store address")
+        self.addrs = list(addrs)
+        self.rank = int(rank)
+        self.timeout_s = float(timeout_s)
+
+    @property
+    def neighbor_addr(self) -> str:
+        return self.addrs[ring_neighbor(self.rank, len(self.addrs))
+                          % len(self.addrs)]
+
+    def _rpc(self, addr: str, header: Dict[str, Any], payload: bytes = b""):
+        host, _, port = addr.rpartition(":")
+        try:
+            with socket.create_connection(
+                (host or "127.0.0.1", int(port)), timeout=self.timeout_s
+            ) as s:
+                _send_frame(s, header, payload)
+                return _recv_frame(s)
+        except (OSError, ValueError) as e:
+            raise PeerStoreError(f"peer store {addr}: {e}") from e
+
+    def ping(self, addr: Optional[str] = None) -> Dict[str, Any]:
+        h, _ = self._rpc(addr or self.addrs[0], {"op": "ping"})
+        return h
+
+    def put(self, payload: bytes, header: Dict[str, Any]) -> None:
+        """Replicate to the ring neighbor (newest-wins server-side)."""
+        h = {**header, "op": "put", "peer": self.rank, "nbytes": len(payload)}
+        resp, _ = self._rpc(self.neighbor_addr, h, payload)
+        if not resp.get("ok"):
+            raise PeerStoreError(f"put rejected: {resp}")
+
+    def get_newest(self) -> Optional[Tuple[Dict[str, Any], bytes]]:
+        """Newest replica across every REACHABLE store (unreachable stores
+        are skipped — they are the dead hosts this tier exists to survive);
+        None when no store holds anything."""
+        best = None
+        for addr in self.addrs:
+            try:
+                h, p = self._rpc(addr, {"op": "get"})
+            except PeerStoreError:
+                continue
+            if not h.get("ok"):
+                continue
+            if best is None or int(h.get("step", -1)) > int(best[0].get("step", -1)):
+                best = (h, p)
+        return best
+
+
+def client_from_env(env=None) -> Optional[PeerStoreClient]:
+    """The training child's client, from the supervisor-set env
+    (``GALVATRON_PEER_STORE`` = comma list of ``host:port``,
+    ``GALVATRON_PEER_RANK`` = this peer's ring position). None when peer
+    replication is not armed."""
+    e = os.environ if env is None else env
+    spec = e.get(ADDRS_ENV, "").strip()
+    if not spec:
+        return None
+    addrs = [a.strip() for a in spec.split(",") if a.strip()]
+    if not addrs:
+        return None
+    return PeerStoreClient(addrs, rank=int(e.get(RANK_ENV, "0")))
+
+
+# ---------------------------------------------------------------------------
+# module CLI: one simulated peer host as its own OS process
+# ---------------------------------------------------------------------------
+
+
+def serve_main(argv: Optional[List[str]] = None) -> int:
+    """``python -m galvatron_tpu.core.peer_store serve [--port N]
+    [--announce FILE]`` — run one store until killed. ``--announce`` writes
+    ``host:port\\n`` (atomically) once bound, so the spawner can discover
+    the ephemeral port without parsing stdout."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="peer_store serve")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--announce", default=None)
+    ns = p.parse_args(argv)
+    srv = PeerStoreServer(ns.host, ns.port).start()
+    print(f"peer store serving on {srv.addr}", flush=True)
+    if ns.announce:
+        tmp = ns.announce + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(srv.addr + "\n")
+        os.replace(tmp, ns.announce)
+    try:
+        threading.Event().wait()  # serve until killed (SIGTERM from spawner)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.close()
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "serve":
+        return serve_main(argv[1:])
+    print("usage: python -m galvatron_tpu.core.peer_store serve "
+          "[--port N] [--announce FILE]", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

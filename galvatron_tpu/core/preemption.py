@@ -1,0 +1,168 @@
+"""Preemption notices and degraded-mesh continuation arithmetic.
+
+A spot/preemptible TPU slice does not just die — the platform delivers an
+eviction *notice* with a grace window (SIGTERM plus a metadata-server flag
+on GCE; here a pollable notice file stands in for the metadata server so
+the sim world and tests can drive it). The trainer's job inside that
+window is an *expedited replicated save* and a coordinated drain: finish
+the in-flight step, push the replica to the peer store, commit to disk if
+storage allows, and exit with the preempted code — the elastic supervisor
+then restarts onto whatever capacity remains.
+
+When the remaining capacity is SMALLER (a peer host was the thing
+preempted), the run continues at reduced DP width through the existing
+GTA017 re-plan + exact-cursor resume path instead of aborting. The one
+invariant that must survive the shrink is the *global batch size* — the
+optimizer trajectory is calibrated to it — so the lost data parallelism is
+paid back in gradient accumulation: :func:`degraded_continuation` computes
+the chunk (micro-batch) adjustment and enforces the ``--degraded_min_dp``
+floor below which continuing is worse than waiting for capacity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Optional
+
+#: child-side env var: the supervisor's notice-file path (the trainer also
+#: honors --preempt_notice_file; env lets the chaos harness arm it without
+#: touching argv)
+NOTICE_ENV = "GALVATRON_PREEMPT_NOTICE"
+
+
+class PreemptionListener:
+    """Latches a preemption notice from either delivery channel.
+
+    - **SIGTERM** — observed through the trainer's existing
+      :class:`~galvatron_tpu.core.signals.GracefulExitHandler` (passed in
+      as ``exit_handler``), so signal disposition stays owned by one
+      object.
+    - **notice file** — a pollable path (``--preempt_notice_file`` /
+      ``GALVATRON_PREEMPT_NOTICE``) standing in for the cloud metadata
+      server; its *existence* is the notice. Polled at most once per
+      ``poll_interval_s`` so the per-step cost is an ``os.path.exists``
+      amortized to ~zero.
+
+    Once noticed, ``deadline`` is ``notice_ts + grace_s``: the drain must
+    finish the current step, replicate, save, and exit before it."""
+
+    def __init__(self, exit_handler=None, notice_file: Optional[str] = None,
+                 grace_s: float = 30.0, poll_interval_s: float = 0.25):
+        self.exit_handler = exit_handler
+        self.notice_file = notice_file or os.environ.get(NOTICE_ENV) or None
+        self.grace_s = float(grace_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.notice_ts: Optional[float] = None
+        self.reason: Optional[str] = None
+        self._last_poll = 0.0
+
+    @property
+    def noticed(self) -> bool:
+        return self.notice_ts is not None
+
+    @property
+    def deadline(self) -> Optional[float]:
+        return None if self.notice_ts is None else self.notice_ts + self.grace_s
+
+    def remaining_s(self) -> Optional[float]:
+        d = self.deadline
+        return None if d is None else max(0.0, d - time.monotonic())
+
+    def check(self) -> Optional[str]:
+        """Poll both channels; returns the latched reason (``"sigterm"`` |
+        ``"notice"``) once a notice exists, else None. Idempotent after the
+        first latch — the drain is triggered once."""
+        if self.notice_ts is not None:
+            return self.reason
+        if self.exit_handler is not None and getattr(
+            self.exit_handler, "signaled", None
+        ) is not None:
+            self.notice_ts = time.monotonic()
+            self.reason = "sigterm"
+            return self.reason
+        if self.notice_file:
+            now = time.monotonic()
+            if now - self._last_poll >= self.poll_interval_s:
+                self._last_poll = now
+                if os.path.exists(self.notice_file):
+                    self.notice_ts = now
+                    self.reason = "notice"
+                    return self.reason
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class DegradedPlan:
+    """Outcome of the shrink arithmetic. ``feasible`` False carries the
+    human-readable ``reason`` the supervisor's give-up message surfaces."""
+
+    feasible: bool
+    reason: str
+    old_dp: int
+    new_dp: int
+    global_bsz: int
+    #: per-step samples each surviving replica now owns
+    per_replica_bsz: int = 0
+    #: gradient-accumulation chunks after the adjustment
+    new_chunks: int = 0
+    #: micro-batch each chunk processes (per replica)
+    micro_bsz: int = 0
+
+    @property
+    def accum_scale(self) -> float:
+        """How much more sequential work each survivor does per step."""
+        return self.old_dp / self.new_dp if self.new_dp else float("inf")
+
+
+def degraded_continuation(old_dp: int, new_dp: int, global_bsz: int,
+                          chunks: int = 1, min_dp: int = 1) -> DegradedPlan:
+    """Shrink DP width ``old_dp → new_dp`` while PRESERVING the global
+    batch (the optimizer trajectory's calibration) via gradient
+    accumulation.
+
+    Each surviving replica's per-step share grows from
+    ``global_bsz/old_dp`` to ``global_bsz/new_dp``; the extra samples are
+    taken as additional accumulation chunks, starting from the smallest
+    chunk count ≥ the proportional scale-up that divides the new
+    per-replica batch evenly (micro-batches must stay integral — XLA
+    programs are shape-specialized). Infeasible when ``new_dp`` is below
+    the ``min_dp`` floor (``--degraded_min_dp``: the operator's judgment
+    that below this width waiting beats limping) or when ``global_bsz``
+    does not divide over the survivors."""
+    old_dp, new_dp = int(old_dp), int(new_dp)
+    global_bsz, chunks, min_dp = int(global_bsz), max(1, int(chunks)), int(min_dp)
+    if new_dp < 1:
+        return DegradedPlan(False, "no surviving data-parallel replicas",
+                            old_dp, new_dp, global_bsz)
+    if new_dp < min_dp:
+        return DegradedPlan(
+            False,
+            f"degraded DP width {new_dp} below --degraded_min_dp {min_dp}",
+            old_dp, new_dp, global_bsz,
+        )
+    if global_bsz % new_dp:
+        return DegradedPlan(
+            False,
+            f"global batch {global_bsz} not divisible by degraded DP width "
+            f"{new_dp}",
+            old_dp, new_dp, global_bsz,
+        )
+    per_replica = global_bsz // new_dp
+    # proportional accumulation scale-up, then walk up to the next chunk
+    # count that divides the per-replica batch evenly
+    want = max(1, -(-chunks * old_dp // new_dp))  # ceil
+    new_chunks = None
+    for c in range(min(want, per_replica), per_replica + 1):
+        if per_replica % c == 0:
+            new_chunks = c
+            break
+    if new_chunks is None:  # per_replica >= 1 ⇒ c == per_replica always divides
+        new_chunks = per_replica
+    return DegradedPlan(
+        True, "", old_dp, new_dp, global_bsz,
+        per_replica_bsz=per_replica,
+        new_chunks=new_chunks,
+        micro_bsz=per_replica // new_chunks,
+    )

@@ -70,12 +70,23 @@ class RestartPolicy:
         )
         self.consecutive = 0  # failures since the last progressed failure
 
-    def on_failure(self, progressed: bool,
-                   immediate: bool = False) -> RestartDecision:
+    def on_failure(self, progressed: bool, immediate: bool = False,
+                   free: bool = False) -> RestartDecision:
         """Record one failure and decide. ``progressed`` = supervisor-level
         progress happened since the previous failure (resets the streak to
         1); ``immediate`` skips the backoff sleep but still counts the
-        failure against the budget."""
+        failure against the budget.
+
+        ``free`` (preemption-aware supervisors): a failure that is the
+        platform's EXPECTED lifecycle — a graceful preemption whose child
+        checkpointed and made progress — does not consume budget at all
+        (streak resets to 0, no backoff). A fleet living on spot capacity
+        can be preempted more than ``max_restarts`` times in a healthy
+        week; only preemptions WITHOUT progress keep counting, so a
+        preempt-loop that never advances still exhausts the budget."""
+        if free and progressed:
+            self.consecutive = 0
+            return RestartDecision(False, 0, 0.0)
         self.consecutive = 1 if progressed else self.consecutive + 1
         if self.consecutive > self.max_restarts:
             return RestartDecision(True, self.consecutive, 0.0)

@@ -22,6 +22,19 @@ Every attempt first passes through :func:`faults.maybe_fail_io`, so any
 retry-protected site is automatically a fault-injection point for the
 ``fail_io=N`` fault (tests/test_resilience.py proves the ride-through and
 pins the jitter bounds).
+
+Two observability/bounding layers ride every call:
+
+- **retry budget** — ``max_elapsed_s`` caps the *wall-clock* a single call
+  may spend retrying (attempt count alone is a poor bound once backoff
+  grows: 5 attempts at a 10s cap can hold a preemption drain hostage for
+  40s). When the budget cannot cover the next backoff, the call gives up
+  early with the elapsed time noted.
+- **counters** — module-level :data:`RETRY_COUNTERS` (utils/metrics.py
+  ``Counters``) accumulate ``io_retry`` (every retried attempt) and
+  ``io_give_up`` (every exhausted call) process-wide; the /metrics
+  endpoint (obs/prom.py) exports them, so storage flakiness is visible as
+  a rising retry rate *before* it becomes an outage.
 """
 
 from __future__ import annotations
@@ -32,6 +45,10 @@ import time
 from typing import Any, Callable, Optional, Tuple, Type
 
 from galvatron_tpu.core import faults
+from galvatron_tpu.utils.metrics import Counters
+
+#: process-wide transient-I/O retry telemetry, exported on /metrics
+RETRY_COUNTERS = Counters("io_retry", "io_give_up")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,6 +75,10 @@ class RetryPolicy:
     # the hosts of a pod retrying the same shared-storage fault; "none":
     # the old deterministic schedule (reproducible-timing callers only)
     jitter: str = "full"
+    # per-call wall-clock retry budget (seconds); None = bounded by attempt
+    # count only. A preemption drain with 30s of grace cannot afford a
+    # retry loop whose backoff alone can exceed it.
+    max_elapsed_s: Optional[float] = None
 
     def __post_init__(self):
         if self.jitter not in ("full", "none"):
@@ -91,6 +112,8 @@ def with_retries(
     via exception note (non-retryable exceptions propagate immediately)."""
     policy = policy or RetryPolicy()
     last: Optional[BaseException] = None
+    start = time.monotonic()
+    attempts_made = 0
     for attempt in range(policy.attempts):
         try:
             faults.maybe_fail_io(describe)
@@ -99,14 +122,27 @@ def with_retries(
             if isinstance(e, policy.non_retryable):
                 raise
             last = e
-            if attempt + 1 >= policy.attempts:
+            attempts_made = attempt + 1
+            if attempts_made >= policy.attempts:
                 break
+            delay = policy.delay(attempt)
+            if policy.max_elapsed_s is not None and (
+                time.monotonic() - start + delay > policy.max_elapsed_s
+            ):
+                # the budget cannot cover the next backoff: give up now
+                # rather than blow the caller's deadline sleeping
+                break
+            RETRY_COUNTERS.inc("io_retry")
             if on_retry is not None:
                 on_retry(attempt, e)
-            sleep(policy.delay(attempt))
+            sleep(delay)
     assert last is not None
+    RETRY_COUNTERS.inc("io_give_up")
     if hasattr(last, "add_note"):  # 3.11+
         last.add_note(
-            f"({describe or 'operation'} failed after {policy.attempts} attempts)"
+            f"({describe or 'operation'} failed after {attempts_made} "
+            f"attempt(s) in {time.monotonic() - start:.2f}s"
+            + (f", retry budget {policy.max_elapsed_s}s" if policy.max_elapsed_s is not None else "")
+            + ")"
         )
     raise last

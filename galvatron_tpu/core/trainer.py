@@ -21,15 +21,20 @@ import jax
 import numpy as np
 
 from galvatron_tpu.core import faults
+from galvatron_tpu.core import peer_store as peer_store_mod
 from galvatron_tpu.core.arguments import hybrid_config_from_args, model_config_from_args
 from galvatron_tpu.core.checkpoint import (
+    CheckpointCorruptError,
     latest_step,
+    portable_flat_state,
     read_manifest,
     restore_checkpoint_portable,
+    restore_from_flat_leaves,
     save_checkpoint_portable,
     step_path,
     uncommitted_steps,
 )
+from galvatron_tpu.core.preemption import PreemptionListener
 from galvatron_tpu.core.dataloader import build_dataloader
 from galvatron_tpu.core.resilience import AnomalyAbort, AnomalySentinel
 from galvatron_tpu.parallel.hybrid import build_runtime
@@ -243,6 +248,11 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
     if metrics_path and jax.process_index() != 0:
         metrics_path = None
     metrics = MetricsLogger(metrics_path)
+    # in-memory peer replication client (core/peer_store.py): armed by the
+    # elastic supervisor under --peer_replicate (env carries the store
+    # addresses + this peer's ring rank). None = the RAM tier is off and
+    # every recovery path below degrades to disk-only exactly as before.
+    peer_client = peer_store_mod.client_from_env()
     # topology + plan fingerprint: rides every manifest so a restart can
     # tell "same world, same plan" from "the pod shrank under me" (GTA017)
     # and from a legal cross-plan resume. mesh_shape/axes are forensic;
@@ -345,16 +355,55 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
     start_step = 0
     batch_offset = 0
     saved_data_state = None  # checkpoint's data-pipeline cursor (if any)
-    if ns.load and latest_step(ns.load) is not None:
+    # two-tier restore: the in-memory peer replica (core/peer_store.py) is
+    # consulted FIRST and used when it is NEWER than the newest committed
+    # disk step — host-loss recovery must not round-trip through storage,
+    # and after a storage outage the replica may be the only record of the
+    # last interval. A replica that fails its digest/structure check falls
+    # back to the disk tier with a ckpt_fallback event, exactly as a
+    # corrupt disk step falls back to an older one.
+    restored_from = None
+    meta: dict = {}
+    disk_latest = latest_step(ns.load) if ns.load else None
+    if peer_client is not None:
+        rec = None
+        try:
+            rec = peer_client.get_newest()
+        except Exception as peer_err:  # noqa: BLE001 — the RAM tier is optional
+            print(f"peer store unreachable, using disk tier: {peer_err!r}")
+        if rec is not None and (
+            disk_latest is None or int(rec[0].get("step", -1)) > disk_latest
+        ):
+            h, payload = rec
+            try:
+                leaves = peer_store_mod.deserialize_state(payload, h)
+                state = restore_from_flat_leaves(rt, leaves)
+                start_step = int(h.get("step", 0))
+                meta = dict(h.get("meta") or {})
+                restored_from = "peer"
+                if verbose:
+                    print(f"restored step {start_step} from the in-memory "
+                          f"peer replica ({int(h.get('nbytes', 0))} bytes)")
+            except (peer_store_mod.ReplicaCorruptError,
+                    CheckpointCorruptError) as e:
+                metrics.log("ckpt_fallback", step=int(h.get("step", -1)),
+                            error=str(e)[:300], source="peer")
+                tracer.instant("ckpt_fallback", step=int(h.get("step", -1)),
+                               source="peer")
+                print(f"peer replica corrupt, falling back to disk: "
+                      f"{str(e)[:200]}")
+                meta = {}
+    if restored_from is None and ns.load and disk_latest is not None:
         state = restore_checkpoint_portable(ns.load, rt, metrics=metrics)
         start_step = int(np.asarray(state["step"]))
-        # stream position ≠ optimizer step once anomaly skips happened: a
-        # skipped batch was consumed but produced no update. The save path
-        # records batches-consumed in the manifest (dir name == actual step,
-        # so the restored step's manifest is addressable here).
-        batch_offset = start_step
         m = read_manifest(step_path(ns.load, start_step))
         meta = m.get("meta") if m and isinstance(m.get("meta"), dict) else {}
+        restored_from = "disk"
+    if restored_from is not None:
+        # stream position ≠ optimizer step once anomaly skips happened: a
+        # skipped batch was consumed but produced no update. Both tiers
+        # record batches-consumed in their meta (manifest / replica header).
+        batch_offset = start_step
         if meta:
             batch_offset = int(meta.get("batches_consumed", start_step))
         if isinstance(meta.get("data_state"), dict):
@@ -436,8 +485,16 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                     f"at bsz {rec_bsz} → batch cursor {batch_offset} at "
                     f"bsz {ns.global_train_batch_size}"
                 )
+        # recovery provenance: which tier restored and where the cursor
+        # landed. The chaos harness derives MTTR (supervisor child_exit ts →
+        # this record's ts) and steps-lost (fault step − resume_batches)
+        # from it, so it fires on every resume, not only post-failure ones.
+        metrics.log("recovery", step=start_step, source=restored_from,
+                    resume_batches=batch_offset,
+                    resume_samples=meta.get("samples_consumed"))
         if verbose:
-            print(f"resumed from {ns.load} at step {start_step}")
+            src = ns.load if restored_from == "disk" else "peer replica"
+            print(f"resumed from {src} at step {start_step}")
     elif ns.load and uncommitted_steps(ns.load):
         # pre-manifest legacy dirs must not silently restart from scratch
         raise FileNotFoundError(
@@ -677,6 +734,29 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
             meta["data_state"] = data_pipe.state(samples)
         return meta
 
+    def _push_replica(st, step) -> bool:
+        # RAM tier of the two-tier checkpoint: serialize the SAME portable
+        # flat state the disk checkpoint would hold and hand it to a peer
+        # host's in-memory store (ring neighbor). Best-effort by contract —
+        # a dead peer degrades to disk-only, never fails the step.
+        if peer_client is None:
+            return False
+        try:
+            flat = portable_flat_state(st, rt)
+            payload, header = peer_store_mod.serialize_state(
+                flat, step, meta=_save_meta()
+            )
+            peer_client.put(payload, header)
+            metrics.log("peer_replicate", step=step, nbytes=header["nbytes"])
+            return True
+        except Exception as e:  # noqa: BLE001 — replication is best-effort
+            metrics.log(
+                "peer_replicate_failed", step=step, error=str(e)[:300]
+            )
+            if verbose:
+                print(f"peer replication failed at step {step}: {e!r}")
+            return False
+
     # hang watchdog (--step_timeout_s; core/watchdog.py): armed around each
     # step, fires on a stalled collective — stacks + flight dump + a
     # best-effort emergency save of the last BOUND state (the holder is
@@ -784,12 +864,45 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
             return contextlib.nullcontext()
 
     train_exc = None
+    # preemption notice listener (core/preemption.py): the notice FILE
+    # stands in for the cloud metadata server's eviction flag; SIGTERM
+    # keeps riding the GracefulExitHandler branch below. Either way the
+    # loop drains at the next step boundary and the exit path's replicated
+    # save is the grace window's "expedited save".
+    preempt_listener = PreemptionListener(
+        None,
+        notice_file=getattr(ns, "preempt_notice_file", None),
+        grace_s=getattr(ns, "preempt_grace_s", 30.0) or 30.0,
+        # poll every step: one os.path.exists is noise next to a dispatch,
+        # and any throttle longer than a step can miss the notice entirely
+        # on a fast (or simulated) mesh
+        poll_interval_s=0.0,
+    )
+    # supervisor-side heartbeat (core/watchdog.py): one beat per step so a
+    # child too wedged for its own in-process watchdog is still detectable
+    from galvatron_tpu.core.watchdog import HEARTBEAT_ENV, beat_heartbeat
+
+    hb_file = os.environ.get(HEARTBEAT_ENV)
     try:
         with GracefulExitHandler() as exit_handler:
             for it in range(batch_offset, ns.train_iters):
+                if hb_file:
+                    beat_heartbeat(hb_file, it)
                 if exit_handler.signaled is not None:
                     if verbose:
                         print(f"signal {exit_handler.signaled} received; stopping at iter {it}")
+                    break
+                notice = preempt_listener.check()
+                if notice is not None:
+                    if verbose:
+                        print(
+                            f"preemption notice ({notice}) received; draining "
+                            f"at iter {it} (grace "
+                            f"{preempt_listener.grace_s:.0f}s)"
+                        )
+                    metrics.log("preempt_notice", step=it, reason=notice,
+                                grace_s=float(preempt_listener.grace_s))
+                    tracer.instant("preempt_notice", step=it, reason=notice)
                     break
                 # start after the warmup/compile iteration so the timeline
                 # shows steady-state steps, not one giant compile span (a
@@ -843,6 +956,15 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                     # counted but untrained, exactly a real preemption's
                     # window, and the watchdog's holder is still valid.
                     faults.maybe_preempt(it)
+                    # harsher chaos tiers: kill_host_mid_step SIGKILLs this
+                    # process with no grace at all (recovery must come from
+                    # the peer replica or the last committed checkpoint);
+                    # preempt_with_grace writes the NOTICE file a real cloud
+                    # eviction would, exercising the drain path above
+                    faults.maybe_kill_host(it)
+                    faults.maybe_preempt_notice(
+                        it, getattr(ns, "preempt_notice_file", None)
+                    )
                     faults.maybe_hang(it)
                     # rollback copy — the train step donates its input buffers,
                     # so a discarded update is unrecoverable without it (None
@@ -991,17 +1113,39 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                             # until the restart budget ran out — same
                             # stand-down the exit save gets
                             wd.disarm()
-                        save_checkpoint_portable(
-                            ns.save, state, actual_step, rt, keep_last_n=keep_n,
-                            meta=_save_meta(),
-                        )
-                        if train_obs is not None:
-                            train_obs.checkpoints_saved += 1
+                        # RAM tier first: the replica must exist BEFORE the
+                        # disk commit so a storage outage (or a kill during
+                        # the commit) still leaves this step recoverable
+                        replicated = _push_replica(state, actual_step)
+                        try:
+                            save_checkpoint_portable(
+                                ns.save, state, actual_step, rt,
+                                keep_last_n=keep_n,
+                                meta=_save_meta(),
+                            )
+                        except OSError as save_err:
+                            if not replicated:
+                                raise
+                            # storage down but the peer replica landed: the
+                            # run keeps training on the RAM tier alone and
+                            # retries disk at the next due save / exit save
+                            metrics.log(
+                                "save_degraded_to_peer", step=actual_step,
+                                error=str(save_err)[:300],
+                            )
+                            print(
+                                f"warning: disk save at step {actual_step} "
+                                f"failed ({save_err}); continuing on peer "
+                                f"replica", flush=True,
+                            )
+                        else:
+                            if train_obs is not None:
+                                train_obs.checkpoints_saved += 1
+                            if verbose:
+                                print(f"saved step {actual_step} → {ns.save}")
                         next_save_at = (
                             (it + 1) // ns.save_interval + 1
                         ) * ns.save_interval
-                        if verbose:
-                            print(f"saved step {actual_step} → {ns.save}")
         prof.finish(loss if iters_run else None)
     except BaseException as e:
         train_exc = e
@@ -1077,6 +1221,11 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
                         meta.get("batches_consumed", -1)
                     ) == batches_now
                 if not already_committed:
+                    # push the RAM tier before the disk commit: if the disk
+                    # exit save raises (storage still out during a drain),
+                    # the peer replica carries the final step into the next
+                    # incarnation
+                    _push_replica(state, final_step)
                     save_checkpoint_portable(
                         ns.save, state, final_step, rt, keep_last_n=keep_n,
                         meta=_save_meta(),
@@ -1137,6 +1286,12 @@ def _train_impl(ns: argparse.Namespace, verbose: bool, tracer,
         "iter_ms": prof.avg_iter_ms if prof.iter_times_ms else None,
         "state": state,
         # the elastic child maps this to EXIT_PREEMPTED: a signal-stop run
-        # completed nothing abnormal, but the supervisor must restart it
-        "signaled": exit_handler.signaled,
+        # completed nothing abnormal, but the supervisor must restart it.
+        # A notice-file drain (no signal delivered) reports its reason in
+        # the same slot — the supervisor treats both as a preemption.
+        "signaled": (
+            exit_handler.signaled
+            if exit_handler.signaled is not None
+            else preempt_listener.reason
+        ),
     }

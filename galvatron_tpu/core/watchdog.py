@@ -26,6 +26,14 @@ This module converts that silence into a supervised restart:
 The first armed step of a process gets its deadline scaled by
 ``warmup_scale`` (default 10x): it carries XLA compilation, and declaring a
 compile a hang would turn every cold start into a crash loop.
+
+:class:`HeartbeatMonitor` is the *supervisor-side* complement: the child
+touches a heartbeat file every step (``GALVATRON_HEARTBEAT_FILE``), and the
+elastic supervisor's spawn loop polls its mtime. A child so wedged that its
+own in-process watchdog cannot run (interpreter deadlock, a stuck runtime
+call before the watchdog arms, ``--step_timeout_s`` unset) stops
+heartbeating, and the supervisor kills + restarts it — the last line of
+defense against "a wedged child hangs the run forever".
 """
 
 from __future__ import annotations
@@ -40,6 +48,57 @@ from typing import Any, Callable, Dict, Optional
 #: child exit code the supervisor maps to "watchdog-declared hang"
 #: (the full contract lives in core/elastic.py)
 EXIT_HANG = 77
+
+#: child-side env var naming the heartbeat file the supervisor watches
+#: (set by core/elastic.py under --heartbeat_timeout_s; the trainer beats
+#: it once per step — see beat_heartbeat)
+HEARTBEAT_ENV = "GALVATRON_HEARTBEAT_FILE"
+
+
+def beat_heartbeat(path: str, step: int) -> None:
+    """One heartbeat: rewrite ``path`` with the current step (atomic
+    replace — the monitor reads mtime, a reader of the content never sees
+    a torn write). Best-effort: a heartbeat I/O error must never take down
+    the step that was proving its liveness."""
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{int(step)} {time.time()}\n")
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+class HeartbeatMonitor:
+    """Supervisor-side staleness check over a child's heartbeat file.
+
+    ``fresh_within(timeout_s)`` answers "has the child beaten within the
+    last ``timeout_s`` seconds?". Before the FIRST beat ever lands the
+    child is compiling/bootstrapping, so staleness is measured against
+    ``started_at`` with ``first_beat_grace_s`` (compile-length) instead of
+    ``timeout_s`` — the same blind-first-step reasoning as
+    :class:`HangWatchdog`'s ``warmup_scale``, at the process level."""
+
+    def __init__(self, path: str, first_beat_grace_s: float):
+        self.path = path
+        self.first_beat_grace_s = float(first_beat_grace_s)
+        self.started_at = time.monotonic()
+
+    def last_beat_age_s(self) -> Optional[float]:
+        """Seconds since the last beat, or None when no beat exists yet."""
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            return None
+        return max(0.0, time.time() - mtime)
+
+    def stale(self, timeout_s: float) -> bool:
+        """True when the child must be presumed wedged: no beat for
+        ``timeout_s`` seconds (or no first beat within the grace)."""
+        age = self.last_beat_age_s()
+        if age is None:
+            return time.monotonic() - self.started_at > self.first_beat_grace_s
+        return age > float(timeout_s)
 
 
 def dump_all_stacks() -> str:

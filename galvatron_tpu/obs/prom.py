@@ -418,6 +418,11 @@ class ElasticStats:
         self.current_plan_hash: Optional[str] = None
         self.world_size: Optional[int] = None
         self.last_step: Optional[int] = None
+        # preemption-aware recovery story (core/peer_store.py tier): how
+        # many times the run restored, from where, and how long it was down
+        self.recoveries_total = 0
+        self.last_recovery_source: Optional[str] = None  # "peer" | "disk"
+        self.last_recovery_ms: Optional[float] = None
         # fleet-wide aggregation: the supervisor owns the ONLY sidecar port
         # of a supervised run, so the child's train gauges must surface here
         # — the supervisor injects --metrics_path into the child and tails
@@ -464,6 +469,9 @@ class ElasticStats:
             "current_plan_hash": self.current_plan_hash,
             "world_size": self.world_size,
             "last_step": self.last_step,
+            "recoveries_total": self.recoveries_total,
+            "last_recovery_source": self.last_recovery_source,
+            "last_recovery_ms": self.last_recovery_ms,
         }
 
     def render(self) -> str:
@@ -502,6 +510,29 @@ class ElasticStats:
         out.add("elastic_child_step_time_drift", rec.get("step_time_drift"),
                 help_="child's predicted-vs-observed step-time drift (the "
                 "re-plan trigger, surfaced at the supervisor)")
+        # recovery story: restores observed across child restarts (source
+        # "peer" = in-memory replica beat disk; MTTR = child death → child
+        # `recovery` event, the operator's actual downtime)
+        out.add("elastic_recoveries_total", self.recoveries_total,
+                mtype="counter",
+                help_="child restores observed (peer replica or disk)")
+        if self.last_recovery_source is not None:
+            out.add("elastic_last_recovery_info", 1,
+                    labels={"source": self.last_recovery_source},
+                    help_="where the most recent restore came from")
+        out.add("elastic_last_recovery_ms", self.last_recovery_ms,
+                help_="most recent MTTR: previous child exit to this "
+                "child's recovery event, wall ms")
+        # transient-I/O retry telemetry (core/retry.py): a rising retry
+        # rate is storage flakiness BEFORE it becomes an outage
+        from galvatron_tpu.core.retry import RETRY_COUNTERS
+
+        out.add("galvatron_io_retries_total",
+                RETRY_COUNTERS.get("io_retry"), mtype="counter",
+                help_="transient-I/O attempts that were retried")
+        out.add("galvatron_io_retry_give_ups_total",
+                RETRY_COUNTERS.get("io_give_up"), mtype="counter",
+                help_="retry-protected calls that exhausted their budget")
         return out.render()
 
 
