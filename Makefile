@@ -51,6 +51,7 @@ serving-chaos:
 	$(PY) experiments/serving_chaos.py crash
 	$(PY) experiments/serving_chaos.py stall
 	$(PY) experiments/serving_chaos.py sigterm
+	$(PY) experiments/serving_chaos.py evict
 
 # fleet chaos harness (docs/DESIGN.md § Serving fleet): a real
 # `cli serve-fleet` router over 3 replica subprocesses — killing one

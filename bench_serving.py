@@ -31,7 +31,7 @@ import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 
 
-def _build(num_slots, max_seq_len):
+def _build(num_slots, max_seq_len, kv_num_blocks=0, kv_block_size=16):
     import jax
     import jax.numpy as jnp
 
@@ -56,7 +56,9 @@ def _build(num_slots, max_seq_len):
         # real deployment): decode attention spans the slot length every step
         engine = Engine(params, cfg, num_slots=num_slots, prefill_chunk=32,
                         max_seq_len=max_seq_len,
-                        eos_id=tok.eos_id, pad_id=tok.pad_id)
+                        eos_id=tok.eos_id, pad_id=tok.pad_id,
+                        kv_num_blocks=kv_num_blocks,
+                        kv_block_size=kv_block_size)
     return params, cfg, tok, engine
 
 
@@ -389,6 +391,147 @@ def run_fleet_overload(ns):
         shutil.rmtree(fleet_dir, ignore_errors=True)
 
 
+def run_prefix(ns):
+    """Prefix-sharing section (--prefix): N clients share one long system
+    prompt — the agent-serving shape (big static instructions, small unique
+    tails).  Two sub-probes:
+
+    - capacity: at the SLOT cache's exact HBM (num_slots × max_seq_len
+      cache tokens), how many sessions does each backend hold concurrently?
+      Slot is num_slots by construction (every session pins a full-length
+      slot); paged shares the system prompt's blocks copy-on-write, so the
+      number is *measured* by admitting sessions against a pool of
+      identical HBM until block headroom runs out.  session_ratio is the
+      headline (acceptance: ≥ 2×).
+    - latency: the same shared-prompt load through a real paged engine —
+      prefix-hit TTFT p50/p95 (admission attaches matched blocks instead of
+      re-prefilling them) and tokens/s, next to a slot-engine control.
+
+    Outcomes partition the request total (served + error == requests) so
+    the CI assertion is arithmetic, not an impression."""
+    import jax.numpy as jnp
+
+    from galvatron_tpu.models.modeling import ModelConfig
+    from galvatron_tpu.models.tokenizer import pad_vocab_size
+    from galvatron_tpu.serving import NoFreeBlocks, PagedKVCache
+
+    block_size = 16
+    # --- capacity probe: allocator arithmetic only, no model forward -------
+    cap_cfg = ModelConfig(
+        vocab_size=pad_vocab_size(259), hidden_size=128, num_layers=2,
+        num_heads=4, ffn_dim=256, max_seq_len=256, dtype=jnp.float32,
+    )
+    pool_tokens = ns.num_slots * cap_cfg.max_seq_len  # the slot cache's HBM
+    paged = PagedKVCache(
+        cap_cfg, num_slots=max(64, 4 * ns.num_slots),
+        block_size=block_size, num_blocks=pool_tokens // block_size + 1,
+    )
+    shared = list(range(2, 2 + ns.prefix_len))
+    paged_sessions = 0
+    while paged_sessions < paged.num_slots:
+        toks = shared + [2 + ns.prefix_len + paged_sessions]  # unique tail
+        if not paged.can_admit(toks, ns.tokens, chunk=32):
+            break
+        s = paged.alloc()
+        paged.attach_prefix(s, toks)
+        try:
+            paged.reserve(s, len(toks) + ns.tokens)
+        except NoFreeBlocks:  # can_admit is the gate; belt and suspenders
+            paged.free(s)
+            break
+        # a real engine registers after prefill; here registration is what
+        # lets session 1+ attach instead of re-reserving the shared span
+        paged.register_prefix(s, toks)
+        paged_sessions += 1
+    cap_audit = paged.audit()
+    capacity = {
+        "pool_tokens": pool_tokens,
+        "block_size": block_size,
+        "slot_sessions": ns.num_slots,
+        "paged_sessions": paged_sessions,
+        "session_ratio": round(paged_sessions / max(ns.num_slots, 1), 2),
+        "audit_ok": cap_audit["ok"] and cap_audit["blocks_ok"],
+    }
+
+    # --- latency probe: real engines over HTTP -----------------------------
+    system = "ab" * (ns.prefix_len // 2)
+    # +2: bos + the one-char unique tail; multiple of block_size so the
+    # paged backend's bit-parity precondition (block_size | max_seq_len)
+    # holds and both sides run the same effective capacity
+    need = len(system) + 2 + ns.tokens
+    max_seq = -(-need // block_size) * block_size
+
+    def drive(port):
+        outcomes = {"served": 0, "error": 0}
+        lock = threading.Lock()
+
+        def one(i):
+            body = json.dumps({
+                "prompts": [system + str(i % 10)],
+                "tokens_to_generate": ns.tokens,
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api", data=body,
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=600) as r:
+                    json.loads(r.read())
+                kind = "served"
+            except Exception:  # noqa: BLE001 — counted, not raised
+                kind = "error"
+            with lock:
+                outcomes[kind] += 1
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=ns.clients) as ex:
+            list(ex.map(one, range(ns.clients * ns.requests_per_client)))
+        return time.perf_counter() - t0, outcomes
+
+    sides = {}
+    for side, kv_num_blocks in (("paged", -1), ("slot", 0)):
+        params, cfg, tok, engine = _build(
+            ns.num_slots, max_seq, kv_num_blocks=kv_num_blocks,
+            kv_block_size=block_size,
+        )
+        svc, port = _start(params, cfg, tok, engine)
+        try:
+            drive(port)  # warmup: compiles + (paged) registers the prefix
+            engine.reset_metrics()
+            wall, outcomes = drive(port)
+            st = engine.stats()
+            sides[side] = {
+                "wall_s": round(wall, 3), **outcomes,
+                "ttft_p50_s": st["ttft_p50_s"],
+                "ttft_p95_s": st["ttft_p95_s"],
+                "tokens_per_s": round(
+                    outcomes["served"] * ns.tokens / wall, 3),
+            }
+            if side == "paged":
+                sides[side]["prefix_cache_hits"] = st["prefix_cache_hits"]
+                sides[side]["prefix_cache_misses"] = st["prefix_cache_misses"]
+                sides[side]["kv_blocks_cached"] = st["kv_blocks_cached"]
+        finally:
+            svc.httpd.shutdown()
+            engine.close()
+
+    requests = ns.clients * ns.requests_per_client
+    return {
+        "metric": "serving_prefix",
+        "prefix_len": ns.prefix_len,
+        "tokens": ns.tokens,
+        "clients": ns.clients,
+        "requests": requests,
+        "served": sides["paged"]["served"],
+        "error": sides["paged"]["error"],
+        "outcome_total": sides["paged"]["served"] + sides["paged"]["error"],
+        "capacity": capacity,
+        "paged": sides["paged"],
+        "slot": sides["slot"],
+        "prefix_cache_hits": sides["paged"]["prefix_cache_hits"],
+    }
+
+
 def run_side(num_slots, clients, requests_per_client, tokens, prompt_len):
     # +2: ByteTokenizer bos + the one-digit client suffix
     params, cfg, tok, engine = _build(num_slots, prompt_len + 2 + tokens)
@@ -447,6 +590,13 @@ def main(argv=None):
     ap.add_argument("--overload_clients", type=int, default=12)
     ap.add_argument("--overload_slots", type=int, default=2)
     ap.add_argument("--overload_ttl_s", type=float, default=2.0)
+    ap.add_argument("--prefix", action="store_true",
+                    help="also run the prefix-sharing section (N clients "
+                    "share one long system prompt): max concurrent sessions "
+                    "at fixed cache HBM paged-vs-slot, prefix-hit TTFT "
+                    "p50/p95, tokens/s — printed before the headline")
+    ap.add_argument("--prefix_len", type=int, default=192,
+                    help="shared system-prompt length in tokens for --prefix")
     ap.add_argument("--fleet", action="store_true",
                     help="run the fleet section instead of the single-"
                     "process bench: a FleetRouter over --fleet_replicas "
@@ -479,6 +629,14 @@ def main(argv=None):
             print(json.dumps(run_overload(ns)))
         except Exception as e:  # noqa: BLE001 — isolate, report, continue
             print(json.dumps({"metric": "serving_overload", "skipped": True,
+                              "error": f"{type(e).__name__}: {e}"}))
+
+    if ns.prefix:
+        # same isolation contract as --overload
+        try:
+            print(json.dumps(run_prefix(ns)))
+        except Exception as e:  # noqa: BLE001 — isolate, report, continue
+            print(json.dumps({"metric": "serving_prefix", "skipped": True,
                               "error": f"{type(e).__name__}: {e}"}))
 
     engine_side = run_side(ns.num_slots, ns.clients, ns.requests_per_client,

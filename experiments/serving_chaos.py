@@ -20,6 +20,10 @@ Scenarios::
              frees), then a clean drain.
     sigterm  SIGTERM mid-load: in-flight requests complete, the process
              exits 0 inside --drain_timeout_s (zero-downtime shutdown).
+    evict    paged backend under block-pool pressure: queue_full 503s
+             carry Retry-After, the LRU evicts cold prefix blocks, an
+             engine crash warm-restarts the paged programs from the
+             artifact store, and the drain leaks zero blocks.
 
 Fleet scenarios (``--fleet``, or the ``fleet-`` prefixed names) drive a
 real ``cli serve-fleet`` router over 3 replica subprocesses:
@@ -223,6 +227,147 @@ def scenario_sigterm(out_dir):
     assert served, f"in-flight requests did not complete: {results}"
     print(f"  {len(served)} in-flight completed through the drain, "
           f"exit in {elapsed:.1f}s")
+
+
+def scenario_evict(out_dir):
+    """Eviction-under-pressure on the PAGED backend: a block pool sized to
+    hold roughly one worst-case sequence, long decodes saturating it, and a
+    client burst behind a 2-deep queue.  The contract under pressure:
+
+    - overflow clients get 503 queue_full WITH a Retry-After hint (the
+      paged admission gate leaves a too-big head request queued, so
+      "busy" has a meaningful come-back time),
+    - distinct completed prompts pile refcount-0 prefix blocks into the
+      LRU until admission must EVICT (prefix_cache_evictions >= 1),
+    - an engine crash mid-load warm-restarts the PAGED program pair from
+      the artifact store (restart_warm cache hits over /healthz, plus the
+      startup warm-start log line),
+    - the paged metric families ride /metrics and pass the exposition
+      linter,
+    - the final drain leaks nothing: the server's leaked=False line now
+      includes the block-partition audit (free/owned/cached disjoint,
+      zero blocks still owned).
+    """
+    paged_args = [
+        "--port", "0", "--num_slots", "2", "--prefill_chunk", "8",
+        "--num_layers", "1", "--hidden_size", "32", "--num_heads", "2",
+        "--ffn_dim", "64", "--seq_length", "64",
+        # pool: 9 usable blocks of 8 tokens — one worst-case request below
+        # reserves 6, so a second concurrent one cannot be admitted
+        "--kv_block_size", "8", "--kv_num_blocks", "10",
+        "--max_queue", "2",
+        "--request_ttl_s", "120", "--drain_timeout_s", "30",
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               GALVATRON_FAULTS="engine_crash_at_iter=10,slow_decode_ms=30")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "galvatron_tpu.cli", "serve", *paged_args,
+         "--flight_dir", os.path.join(out_dir, "flight"),
+         "--compile_cache_dir", os.path.join(out_dir, "cache")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    port = None
+    for line in proc.stdout:
+        m = re.search(r"listening on http://[^:]+:(\d+)/api", line)
+        if m:
+            port = int(m.group(1))
+            break
+    if port is None:
+        proc.kill()
+        raise SystemExit("paged server never came up")
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz", timeout=10
+            ) as r:
+                if json.loads(r.read()).get("ready"):
+                    break
+        except Exception:  # noqa: BLE001 — 503 while starting
+            pass
+        time.sleep(0.1)
+
+    outcomes = {"ok": 0, "queue_full": 0, "engine_restarted": 0, "other": 0}
+    retry_after = []
+    lock = threading.Lock()
+
+    def one(i):
+        # distinct prompts: each completed request leaves a DIFFERENT
+        # refcount-0 prefix block in the LRU, so the pool must evict
+        body = json.dumps({"prompts": [f"chaos {i}"],
+                           "tokens_to_generate": 40}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api", data=body,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                json.loads(r.read())
+            kind = "ok"
+        except urllib.error.HTTPError as e:
+            detail = json.loads(e.read() or b"{}").get("detail", "")
+            kind = detail if detail in ("queue_full", "engine_restarted") \
+                else "other"
+            ra = e.headers.get("Retry-After")
+            with lock:
+                if detail == "queue_full" and ra is not None:
+                    retry_after.append(ra)
+        except Exception:  # noqa: BLE001 — dropped conns are outcomes too
+            kind = "other"
+        with lock:
+            outcomes[kind] += 1
+
+    # two waves: the first saturates the pool + queue (the shed), the
+    # second (after the crash window) proves recovery + forces eviction
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(8, 14)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+
+    total = sum(outcomes.values())
+    assert total == 14, outcomes  # outcome partition sums to the burst
+    assert outcomes["ok"] >= 1, outcomes
+    assert outcomes["queue_full"] >= 1, \
+        f"pool pressure never shed at the queue: {outcomes}"
+    assert retry_after and all(float(ra) > 0 for ra in retry_after), \
+        f"queue_full 503s carried no Retry-After hint: {retry_after}"
+
+    h = healthz(port)
+    s = h["serving"]
+    assert s["kv_backend"] == "paged", s
+    assert s["engine_restarts"] >= 1, s
+    # warm restart of the PAGED programs: the in-process supervisor re-hit
+    # both artifacts in the store (recorded at the startup warm-start)
+    assert s.get("restart_warm"), s
+    assert s["restart_warm"]["hits"] >= 1, s["restart_warm"]
+    assert s["prefix_cache_evictions"] >= 1, \
+        f"saturation never evicted a cached prefix block: {s}"
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=30
+    ) as r:
+        text = r.read().decode()
+    for fam in ("galvatron_kv_blocks_total", "galvatron_kv_blocks_free",
+                "galvatron_prefix_cache_hits_total",
+                "galvatron_prefix_cache_evictions_total"):
+        assert fam in text, f"missing {fam} in /metrics"
+    _lint_metrics(f"http://127.0.0.1:{port}/metrics")
+
+    drain(port)
+    rc, out = wait_exit(proc)
+    check_common("evict", rc, out, out_dir)
+    assert "serving warm-start: 2/2" in out, \
+        f"evict: paged programs never warm-started\n{out[-2000:]}"
+    print(f"  {outcomes['ok']} served, {outcomes['queue_full']} shed with "
+          f"Retry-After, {outcomes['engine_restarted']} crash 503s, "
+          f"evictions={s['prefix_cache_evictions']}, restart warm hits="
+          f"{s['restart_warm']['hits']}, zero leaked blocks")
 
 
 # ---------------------------------------------------------------------------
@@ -524,7 +669,7 @@ def scenario_fleet_rolling(out_dir):
 
 
 SCENARIOS = {"crash": scenario_crash, "stall": scenario_stall,
-             "sigterm": scenario_sigterm,
+             "sigterm": scenario_sigterm, "evict": scenario_evict,
              "fleet-kill": scenario_fleet_kill,
              "fleet-rolling": scenario_fleet_rolling}
 

@@ -10,7 +10,9 @@ will compile is enumerable with no data and no device work:
   pipeline runtime compiles through the same jitted ``train_step`` entry
   (`build_runtime` dispatches; the registry does not care which engine won).
 - ``serving`` (serving/engine.py): ``serving_prefill`` / ``serving_decode``
-  — the engine's exactly-two pinned programs at its static shapes.
+  — the engine's exactly-two pinned programs at its static shapes — or the
+  paged twins ``serving_paged_prefill`` / ``serving_paged_decode`` when the
+  context carries ``kv_num_blocks != 0``.
 - ``generate`` (registered here, lazily importing models/generation):
   the batch eval/generate program at its default length bucket.
 
@@ -46,6 +48,12 @@ class ProgramContext:
     num_slots: int = 4
     prefill_chunk: int = 32
     max_seq_len: Optional[int] = None
+    # paged-KV serving shapes: kv_num_blocks 0 = slot backend (contiguous
+    # cache, serving_prefill/serving_decode), != 0 = paged backend
+    # (serving_paged_prefill/serving_paged_decode; -1 sizes the pool to the
+    # slot cache's HBM footprint)
+    kv_block_size: int = 16
+    kv_num_blocks: int = 0
     # generate shapes
     max_new_tokens: int = 32
     length_bucket: int = 64

@@ -215,6 +215,8 @@ def warmup_plan(
     include: Optional[Sequence[str]] = None,
     num_slots: int = 4,
     prefill_chunk: int = 32,
+    kv_block_size: int = 16,
+    kv_num_blocks: int = 0,
     adam: Any = None,
     serialize: bool = False,
     verbose: bool = True,
@@ -227,6 +229,7 @@ def warmup_plan(
     ctx = aot_registry.ProgramContext(
         cfg=cfg, hp=hp, global_bsz=global_bsz, seq_len=seq_len,
         num_slots=num_slots, prefill_chunk=prefill_chunk, adam=adam,
+        kv_block_size=kv_block_size, kv_num_blocks=kv_num_blocks,
     )
     try:
         specs = aot_registry.enumerate_programs(ctx, include=include)

@@ -442,6 +442,9 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
                 max_engine_restarts=ns.max_engine_restarts,
                 drain_timeout_s=ns.drain_timeout_s,
                 flight_dir=ns.flight_dir,
+                kv_block_size=ns.kv_block_size,
+                kv_num_blocks=ns.kv_num_blocks,
+                prefix_cache=ns.prefix_cache == "on",
             )
         service = GenerationService(params, cfg, tok, ns.max_new_tokens,
                                     ns.seed, engine=engine)
@@ -598,6 +601,8 @@ def _warmup_mode(ns) -> int:
             cfg = cfg.replace(attn_impl=ns.attn_impl)
         ctx = aot_registry.ProgramContext(
             cfg=cfg, num_slots=ns.num_slots, prefill_chunk=ns.prefill_chunk,
+            kv_block_size=getattr(ns, "kv_block_size", 16),
+            kv_num_blocks=getattr(ns, "kv_num_blocks", 0),
         )
         specs = aot_registry.enumerate_programs(ctx, include=include)
         all_reports += aot_warmup.warmup_programs(
@@ -642,6 +647,8 @@ def _warmup_mode(ns) -> int:
         all_reports += aot_warmup.warmup_plan(
             cfg, hp, global_bsz=bsz, store=store, include=include,
             num_slots=ns.num_slots, prefill_chunk=ns.prefill_chunk,
+            kv_block_size=getattr(ns, "kv_block_size", 16),
+            kv_num_blocks=getattr(ns, "kv_num_blocks", 0),
             adam=adam_config_from_args(ns),
             serialize=bool(ns.serialize),
         )

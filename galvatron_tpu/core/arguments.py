@@ -415,6 +415,22 @@ def _add_generate_args(p: argparse.ArgumentParser):
     g.add_argument("--prefill_chunk", type=int, default=32,
                    help="prompt tokens prefilled per jitted chunk when a "
                    "request joins its slot (one compiled program per size)")
+    g.add_argument("--kv_num_blocks", type=int, default=0,
+                   help="paged KV backend (serving/paged_kv.py): device "
+                   "block-pool size including the reserved null block; 0 = "
+                   "contiguous slot cache, -1 = auto-size to the slot "
+                   "cache's HBM footprint. A program-key term: pass the "
+                   "same value to `cli warmup`")
+    g.add_argument("--kv_block_size", type=int, default=16,
+                   help="paged KV backend: tokens per block (prefix sharing "
+                   "is block-granular, so smaller blocks share more and "
+                   "table/gather overhead grows)")
+    g.add_argument("--prefix_cache", type=str, default="on",
+                   choices=["on", "off"],
+                   help="paged KV backend: keep refcount-0 prompt blocks "
+                   "registered for copy-on-write prefix sharing (LRU-"
+                   "evicted under pool pressure); off = blocks free "
+                   "immediately on retirement")
     g.add_argument("--request_ttl_s", type=float, default=30.0,
                    help="end-to-end request deadline: a request that "
                    "out-waits it in queue 503s, and one still decoding past "
@@ -584,6 +600,12 @@ def _add_warmup_args(p: argparse.ArgumentParser):
                    help="serving-family shapes: KV-cache slots")
     g.add_argument("--prefill_chunk", type=int, default=32,
                    help="serving-family shapes: prefill chunk length")
+    g.add_argument("--kv_num_blocks", type=int, default=0,
+                   help="serving-family shapes: paged KV pool size (0 = "
+                   "slot backend programs, -1 = slot-HBM-equivalent pool); "
+                   "match the serve flag or the warm artifacts miss")
+    g.add_argument("--kv_block_size", type=int, default=16,
+                   help="serving-family shapes: paged KV tokens per block")
 
 
 def _add_trace_export_args(p: argparse.ArgumentParser):

@@ -182,6 +182,101 @@ def forward_with_cache_slots(params: Params, tokens, cfg: ModelConfig,
 
 
 # ---------------------------------------------------------------------------
+# Paged forward: K/V live in a shared block pool, addressed via block tables
+# (serving/paged_kv.py owns the pool and the host-side allocator)
+# ---------------------------------------------------------------------------
+
+
+def _layer_with_cache_paged(x, p, cfg: ModelConfig, pool_k, pool_v, tables,
+                            offsets, cos_sin, alibi):
+    """``_layer_with_cache_slots`` variant over a paged pool: ``pool_k``/
+    ``pool_v`` are (num_blocks, block_size, kvh, hd), ``tables`` is (B,
+    max_blocks) int32 and row ``b``'s logical position ``p`` lives at
+    ``(tables[b, p // bs], p % bs)``. Returns (x, pool_k, pool_v)."""
+    from galvatron_tpu.ops import flash_attention
+
+    b, s, h = x.shape
+    bs = pool_k.shape[1]
+    smax = tables.shape[1] * bs
+    xa = modeling.norm(x, p["attn_norm"], cfg)
+    pa = p["attn"]
+    q, k, v = modeling.project_qkv_heads(xa, pa, cfg)
+    if cfg.pos_embed == "rope":
+        cos, sin = cos_sin  # (B, s, hd/2) per-row tables
+        q = modeling.apply_rope(q, cos, sin)
+        k = modeling.apply_rope(k, cos, sin)
+    # scatter the new k/v through the table (duplicate targets only arise on
+    # the null block, whose contents are never attended)
+    pos = offsets[:, None] + jnp.arange(s)[None]  # (B, s)
+    blk = jnp.take_along_axis(tables, pos // bs, axis=1)  # (B, s)
+    sub = pos % bs
+    pool_k = pool_k.at[blk, sub].set(k.astype(pool_k.dtype))
+    pool_v = pool_v.at[blk, sub].set(v.astype(pool_v.dtype))
+    if s == 1 and alibi is None and cfg.causal:
+        # decode step: paged attention reads pages through the table (XLA
+        # gather fallback is bit-identical to the slot engine's decode core)
+        o = flash_attention.paged_decode_attention(q, pool_k, pool_v, tables, offsets)
+    else:
+        # prefill chunk (or bias'd attention): materialize the row's context
+        # contiguously and reuse the slot attention core unchanged
+        k_ctx = pool_k[tables].reshape(b, smax, *pool_k.shape[2:])
+        v_ctx = pool_v[tables].reshape(b, smax, *pool_v.shape[2:])
+        bias = None
+        if alibi is not None:
+            q_pos = offsets[:, None] + jnp.arange(s)[None]  # (B, s)
+            k_pos = jnp.arange(smax)
+            rel = k_pos[None, None, :] - q_pos[:, :, None]  # (B, s, Smax)
+            bias = (alibi[None, :, None, None] * rel[:, None]).astype(jnp.float32)
+        o = modeling.attention_xla(q, k_ctx, v_ctx, cfg, bias=bias, q_offset=offsets)
+    x = x + modeling.attn_output(o, pa, cfg, x.dtype)
+    x = x + modeling.mlp_block(
+        modeling.norm(x, p["mlp_norm"], cfg), p["mlp"], cfg, train=False
+    )
+    return x, pool_k, pool_v
+
+
+def forward_with_cache_paged(params: Params, tokens, cfg: ModelConfig,
+                             pool: KVCache, tables, offsets):
+    """Run ``tokens`` (B, s) through the model with PER-ROW positions
+    ``offsets`` (B,), reading/writing K/V through ``tables`` (B, max_blocks)
+    into the shared block ``pool`` (L, num_blocks, block_size, kvh, hd).
+    Returns (logits, new_pool). ``tables`` and ``offsets`` may be traced —
+    both are fixed-shape operands, so the compiled program is reused across
+    every allocation pattern the host-side allocator produces.
+
+    Numerics match :func:`forward_with_cache_slots` bit-for-bit when
+    ``block_size * max_blocks`` equals the slot cache's max_seq_len: per-row
+    rope tables, scatter-then-attend ordering and the decode attention core
+    are all shared, only the K/V addressing differs (the paged/slot parity
+    tests pin this)."""
+    b, s = tokens.shape
+    smax = tables.shape[1] * pool.k.shape[2]
+    if cfg.pos_embed == "rope":
+        cos_all, sin_all = modeling.rope_tables(cfg, smax)
+        pos = offsets[:, None] + jnp.arange(s)[None]  # (B, s)
+        cos_sin = (cos_all[pos], sin_all[pos])
+    else:
+        cos_sin = None
+    alibi = (
+        jnp.asarray(modeling.alibi_slopes(cfg.num_heads)) if cfg.pos_embed == "alibi" else None
+    )
+    x = params["embed"]["tok"].astype(cfg.dtype)[tokens]
+    if cfg.pos_embed == "learned":
+        pos = offsets[:, None] + jnp.arange(s)[None]
+        x = x + params["embed"]["pos"].astype(cfg.dtype)[pos]
+    new_k, new_v = [], []
+    for i, lp in enumerate(params["layers"]):
+        x, ki, vi = _layer_with_cache_paged(
+            x, lp, cfg, pool.k[i], pool.v[i], tables, offsets, cos_sin, alibi
+        )
+        new_k.append(ki)
+        new_v.append(vi)
+    x = modeling.norm(x, params["final_norm"], cfg)
+    logits = modeling.lm_head(x, params, cfg)
+    return logits, KVCache(jnp.stack(new_k), jnp.stack(new_v))
+
+
+# ---------------------------------------------------------------------------
 # Sampling (reference: megatron/text_generation/sampling.py modify_logits_for_
 # top_k_filtering / top_p_filtering + sample)
 # ---------------------------------------------------------------------------

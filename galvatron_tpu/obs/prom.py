@@ -204,6 +204,37 @@ def server_metrics_text(service) -> str:
         for q, key in (("0.5", "ttft_p50_s"), ("0.95", "ttft_p95_s")):
             out.add("serving_ttft_seconds", s[key], labels={"quantile": q},
                     help_="time-to-first-token over the recent-request window")
+        out.add("serving_max_seq_len_effective", s.get("max_seq_len_effective"),
+                help_="cache capacity actually in force (a requested "
+                "max_seq_len above the model's is clamped, with a warning)")
+        # paged-KV backend families (serving/paged_kv.py) — absent entirely
+        # under the slot backend, so a scraper keys on family presence
+        if "kv_blocks_total" in s:
+            out.add("kv_block_size", s["kv_block_size"],
+                    help_="tokens per KV block (--kv_block_size)")
+            out.add("kv_blocks_total", s["kv_blocks_total"],
+                    help_="device KV block pool size, incl. the reserved "
+                    "null block")
+            out.add("kv_blocks_free", s["kv_blocks_free"])
+            out.add("kv_blocks_cached", s["kv_blocks_cached"],
+                    help_="refcount-0 prefix blocks held in the LRU "
+                    "(reclaimable without losing correctness)")
+            out.add("kv_blocks_active", s["kv_blocks_active"],
+                    help_="blocks referenced by at least one live request")
+            for name in ("hits", "misses", "evictions"):
+                out.add(f"prefix_cache_{name}_total",
+                        s.get(f"prefix_cache_{name}"), mtype="counter",
+                        help_="prefix-cache block matches at admission "
+                        "(cumulative across engine resets)"
+                        if name == "hits" else "")
+            out.add("kv_cow_copies_total", s.get("cow_copies"),
+                    mtype="counter",
+                    help_="copy-on-write block copies (shared block written)")
+            for rid, held in sorted((s.get("blocks_held") or {}).items()):
+                out.add("kv_blocks_held", held, labels={"rid": rid},
+                        help_="blocks reserved by each live request "
+                        "(rid label; rows exist only while the request "
+                        "holds a slot)")
         # cumulative histograms beside the quantile gauges: quantiles are a
         # single-process readout; buckets aggregate across replicas (the
         # fleet router sums them — fleet_metrics_text)
@@ -272,7 +303,9 @@ def fleet_metrics_text(router) -> str:
     replica_stats = [
         (r, (r.last_health.get("serving") or {})) for r in router.replicas
     ]
-    for name in ("tokens_generated", "completed", "failed", "expired"):
+    for name in ("tokens_generated", "completed", "failed", "expired",
+                 "prefix_cache_hits", "prefix_cache_misses",
+                 "prefix_cache_evictions"):
         total = 0
         seen = False
         for r, s in replica_stats:
@@ -289,7 +322,8 @@ def fleet_metrics_text(router) -> str:
         if seen:
             out.add(f"fleet_serving_{name}_sum_total", total, mtype="counter",
                     help_="sum over currently-reachable replicas")
-    for name in ("queue_depth", "active_slots", "tokens_per_s"):
+    for name in ("queue_depth", "active_slots", "tokens_per_s",
+                 "kv_blocks_total", "kv_blocks_free"):
         total = 0.0
         seen = False
         for r, s in replica_stats:

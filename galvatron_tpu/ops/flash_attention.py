@@ -1142,3 +1142,139 @@ def decode_attention(q, k, v, q_offset=0, sm_scale=None):
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bkgs,bskh->bkgh", probs, v)
     return out.reshape(b, 1, n, d)
+
+
+# ---------------------------------------------------------------------------
+# Paged decode: K/V live in a block pool, addressed through block tables
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(
+    tables_ref,  # scalar-prefetch (B, mb) int32 — logical block j of row b
+    off_ref,  # scalar-prefetch (B,) int32 — absolute position of the query
+    q_ref,  # (1, 1, g, d) block of (B, kv, g, d)
+    k_ref,  # (1, bs, 1, d) page of (N, bs, kv, d), chosen by the index map
+    v_ref,
+    o_ref,  # (1, 1, g, d)
+    m_ref,  # VMEM (g, 1) fp32 running max
+    l_ref,  # VMEM (g, 1) fp32 running denominator
+    acc_ref,  # VMEM (g, d) fp32 running numerator
+    *,
+    sm_scale: float,
+    block_size: int,
+    max_blocks: int,
+):
+    """One grid step = one (row, kv head, logical block): FlashAttention-style
+    online softmax over the row's pages. The page lives wherever the block
+    table says — the index map resolves ``tables_ref[b, j]`` at prefetch time,
+    so the DMA engine streams exactly the pages this row owns and the gather
+    is never materialized in HBM."""
+    bi = pl.program_id(0)
+    ji = pl.program_id(2)
+
+    @pl.when(ji == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # skip pages entirely past the query position (their scores would all
+    # mask out anyway; the predicate saves the VPU work)
+    @pl.when(ji * block_size <= off_ref[bi])
+    def _accum():
+        qb = q_ref[0, 0].astype(jnp.float32)  # (g, d)
+        kb = k_ref[0, :, 0].astype(jnp.float32)  # (bs, d)
+        vb = v_ref[0, :, 0].astype(jnp.float32)
+        s = jnp.dot(qb, kb.T) * sm_scale  # (g, bs)
+        k_pos = ji * block_size + jax.lax.broadcasted_iota(jnp.int32, (1, block_size), 1)
+        s = jnp.where(k_pos <= off_ref[bi], s, NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(p, vb)
+
+    @pl.when(ji == max_blocks - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q, k_pages, v_pages, block_tables, q_offset, sm_scale=None, impl: str = "auto"
+):
+    """``decode_attention`` over paged K/V: one query token per row, keys and
+    values gathered through a block table instead of a contiguous cache row.
+
+    q: (B, 1, n, d); k_pages/v_pages: (num_blocks, block_size, kv, d) — the
+    serving block pool for ONE layer; block_tables: (B, max_blocks) int32
+    mapping row b's logical block j to a pool block (entries past a row's
+    reserved capacity point at the null block and are masked by ``q_offset``);
+    q_offset: (B,) absolute query positions.
+
+    ``impl``: 'xla' gathers pages into a contiguous (B, S, kv, d) view and
+    delegates to :func:`decode_attention` — bit-identical to the slot
+    engine's decode when block_size divides its max_seq_len, which is what
+    the paged/slot parity tests pin. 'pallas' runs the online-softmax kernel
+    above (per-page DMA via scalar-prefetched tables, no materialized
+    gather; interpret mode on CPU). 'auto' picks pallas on TPU, xla
+    elsewhere.
+    """
+    b, q_len, n, d = q.shape
+    assert q_len == 1, f"paged_decode_attention requires q_len == 1, got {q_len}"
+    num_blocks, block_size, kv, _ = k_pages.shape
+    max_blocks = block_tables.shape[1]
+    g = n // kv
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(f"impl must be auto|xla|pallas, got {impl!r}")
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    offsets = jnp.broadcast_to(jnp.asarray(q_offset, jnp.int32).reshape(-1), (b,))
+
+    if impl == "xla":
+        k = k_pages[block_tables].reshape(b, max_blocks * block_size, kv, d)
+        v = v_pages[block_tables].reshape(b, max_blocks * block_size, kv, d)
+        return decode_attention(q, k, v, q_offset=offsets, sm_scale=sm_scale)
+
+    qg = q[:, 0].reshape(b, kv, g, d)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kv, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda bi, hi, ji, tables, off: (bi, hi, 0, 0)),
+            pl.BlockSpec(
+                (1, block_size, 1, d),
+                lambda bi, hi, ji, tables, off: (tables[bi, ji], 0, hi, 0),
+            ),
+            pl.BlockSpec(
+                (1, block_size, 1, d),
+                lambda bi, hi, ji, tables, off: (tables[bi, ji], 0, hi, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, g, d), lambda bi, hi, ji, tables, off: (bi, hi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel,
+            sm_scale=float(sm_scale),
+            block_size=block_size,
+            max_blocks=max_blocks,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kv, g, d), q.dtype),
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=_use_interpret(),
+    )(block_tables.astype(jnp.int32), offsets, qg, k_pages, v_pages)
+    return out.reshape(b, 1, n, d)

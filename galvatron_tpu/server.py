@@ -339,7 +339,13 @@ class GenerationService:
                             rid=r.rid)
             return outs, truncated
         except QueueFull as e:
-            raise ServiceBusy(str(e), detail="queue_full") from e
+            # paged admission may leave the head request queued until blocks
+            # free up, so queue-full 503s carry a Retry-After hint sized to
+            # the engine's backlog horizon (chaos `evict` asserts the header)
+            raise ServiceBusy(
+                str(e), detail="queue_full",
+                retry_after_s=getattr(self.engine, "busy_retry_after_s", None),
+            ) from e
         except (RequestExpired, DeadlineExceeded) as e:
             if self.slo is not None:
                 self.slo.observe("deadline_miss_ratio", bad=True)

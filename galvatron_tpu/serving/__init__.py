@@ -5,6 +5,11 @@ Four layers, one per module:
 - [[kv_slots]] ``SlotKVCache`` — persistent fixed-shape device KV cache,
   host-side slot allocator (per-slot offset/length, alloc/free/reset,
   invariant ``audit``).
+- [[paged_kv]] ``PagedKVCache`` — block-granular alternative backend
+  (``--kv_num_blocks``): fixed device block pool + per-request block
+  tables, refcounted copy-on-write prefix sharing keyed by token-chunk
+  hash, LRU eviction of cold prefix blocks, block-headroom admission
+  (``NoFreeBlocks`` is its can't-happen-in-the-engine exhaustion error).
 - [[scheduler]] ``Scheduler`` — FIFO admission queue with per-request TTL,
   bounded depth (``QueueFull``), expiry (``RequestExpired``), shed-on-drain,
   counters.
@@ -32,6 +37,7 @@ disabled (``--num_slots 0``).
 
 from galvatron_tpu.serving.engine import Engine
 from galvatron_tpu.serving.kv_slots import SlotKVCache
+from galvatron_tpu.serving.paged_kv import NoFreeBlocks, PagedKVCache
 from galvatron_tpu.serving.resilience import (
     DeadlineExceeded,
     EngineClosed,
@@ -51,6 +57,8 @@ from galvatron_tpu.serving.scheduler import (
 __all__ = [
     "Engine",
     "SlotKVCache",
+    "PagedKVCache",
+    "NoFreeBlocks",
     "Scheduler",
     "Request",
     "QueueFull",

@@ -16,6 +16,13 @@ the engine's whole lifetime — ``_decode_step`` at ``(num_slots, 1)`` and
 and prompt contents are all traced operands, so the jit cache stays bounded
 at 2 regardless of traffic mix (no per-request recompiles).
 
+``kv_num_blocks != 0`` swaps the contiguous slot cache for the paged
+backend ([[paged_kv]]): K/V lives in a shared block pool addressed through
+per-request block tables (a fixed ``(num_slots, max_blocks)`` int32 traced
+operand), with copy-on-write prefix sharing and block-headroom admission.
+The engine keeps the same pinned-program discipline — the paged prefill
+and decode twins replace the slot pair one-for-one.
+
 Sampling runs on host from the per-slot last logits: each request carries
 its own temperature/top_k/top_p, which therefore never enter the compiled
 program (a per-request static ``top_k`` would recompile; a host-side
@@ -44,6 +51,7 @@ from galvatron_tpu.models.modeling import ModelConfig
 from galvatron_tpu.obs.tracing import tracer as _obs_tracer
 from galvatron_tpu.serving import resilience as rz
 from galvatron_tpu.serving.kv_slots import SlotKVCache
+from galvatron_tpu.serving.paged_kv import PagedKVCache
 from galvatron_tpu.serving.scheduler import Request, Scheduler
 from galvatron_tpu.utils.metrics import Counters, Histogram, QuantileWindow
 
@@ -80,6 +88,32 @@ def _decode_step(params, cfg: ModelConfig, cache: KVCache, tokens, offsets):
         params, tokens[:, None], cfg, cache, offsets
     )
     return logits[:, 0], cache
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("pool",))
+def _paged_prefill_chunk(params, cfg: ModelConfig, pool: KVCache, tokens, table,
+                         offset):
+    """Paged twin of ``_prefill_chunk``: tokens (1, C) land in the request's
+    blocks via its (1, max_blocks) table row; ``offset`` is a (1,) traced
+    position. Tail-padding garbage goes to the null block or to positions
+    past the query offset — invisible either way."""
+    logits, pool = generation.forward_with_cache_paged(
+        params, tokens, cfg, pool, table, offset
+    )
+    return logits[0], pool
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("pool",))
+def _paged_decode_step(params, cfg: ModelConfig, pool: KVCache, tokens, tables,
+                       offsets):
+    """Paged twin of ``_decode_step``: one iteration over ALL slots, K/V
+    addressed through the full (num_slots, max_blocks) table. Inactive rows
+    carry (0, 0) and an all-null table row — their write lands in the null
+    block, which is never attended."""
+    logits, pool = generation.forward_with_cache_paged(
+        params, tokens[:, None], cfg, pool, tables, offsets
+    )
+    return logits[:, 0], pool
 
 
 def _sample_host(rng: np.random.Generator, logits: np.ndarray,
@@ -126,7 +160,10 @@ class Engine:
                  max_engine_restarts: int = 3,
                  restart_backoff_s: float = 0.05,
                  drain_timeout_s: float = 30.0,
-                 flight_dir: Optional[str] = None):
+                 flight_dir: Optional[str] = None,
+                 kv_block_size: int = 16,
+                 kv_num_blocks: int = 0,
+                 prefix_cache: bool = True):
         if deadline_policy not in ("partial", "fail"):
             raise ValueError(
                 f"deadline_policy must be 'partial' or 'fail', got "
@@ -145,7 +182,19 @@ class Engine:
         self.pad_id = int(pad_id)
         self.seed = int(seed)
         self.result_timeout_s = float(result_timeout_s)
-        self.slots = SlotKVCache(cfg, num_slots, max_seq_len)
+        # kv_num_blocks != 0 selects the paged backend: block-granular KV
+        # with COW prefix sharing (serving/paged_kv.py); -1 sizes the pool
+        # to the same HBM as the slot cache. 0 keeps the contiguous slot
+        # cache. Both expose the same allocator surface to the engine.
+        self.paged = int(kv_num_blocks) != 0
+        if self.paged:
+            self.slots = PagedKVCache(
+                cfg, num_slots, block_size=kv_block_size,
+                num_blocks=kv_num_blocks, max_seq_len=max_seq_len,
+                prefix_cache=prefix_cache,
+            )
+        else:
+            self.slots = SlotKVCache(cfg, num_slots, max_seq_len)
         # a chunk longer than the slot would slice past the cache end
         self.prefill_chunk = min(int(prefill_chunk), self.slots.max_seq_len)
         self.scheduler = Scheduler(max_queue=max_queue, default_ttl_s=request_ttl_s)
@@ -290,7 +339,24 @@ class Engine:
         ttft = self.ttft.summary()
         tokens = ec["tokens_generated"]
         busy = self._busy_s
+        extra = {}
+        if self.paged:
+            extra = self.slots.block_stats()
+            # per-request block footprint, keyed by rid (JSON-safe): what an
+            # operator reads to see who is holding the pool
+            extra["blocks_held"] = {
+                str(req.rid): self.slots.blocks_held(slot)
+                for slot, req in self._by_slot.items()
+            }
         return {
+            "kv_backend": "paged" if self.paged else "slot",
+            # the capacity the replica ACTUALLY reserved (satellite of the
+            # silent-clamp fix: a clamped --max_seq_len shows up here)
+            "max_seq_len_effective": self.slots.max_seq_len,
+            # crash-recovery warmth over HTTP: the chaos harness asserts a
+            # restarted engine re-hit its programs in the artifact store
+            "restart_warm": self.last_restart_warm,
+            **extra,
             "queue_depth": self.scheduler.depth,
             "queue_capacity": self.scheduler.max_queue,
             "queue_saturated": self.scheduler.saturated,
@@ -332,6 +398,15 @@ class Engine:
         """False once the engine is closed, drained, or gave up restarting
         — what ``/readyz`` keys on."""
         return not self._closed and not self.supervisor.gave_up
+
+    @property
+    def busy_retry_after_s(self) -> float:
+        """Honest Retry-After hint for admission backpressure (queue full /
+        pool saturated): the queue turns over at TTL granularity at worst,
+        so a shed client retrying sooner than a fraction of it just burns
+        its budget re-queueing."""
+        ttl = self.scheduler.default_ttl_s
+        return max(1.0, min(ttl if ttl else 5.0, 5.0))
 
     def reset_metrics(self) -> None:
         """Zero counters/TTFT/throughput accounting (bench: drop warmup
@@ -418,9 +493,12 @@ class Engine:
     def audit(self) -> dict:
         """Post-drain/post-traffic invariant check: every slot returned to
         the free list, no request bookkeeping left behind, and (when the
-        jit programs exist) the two-program pin intact."""
+        jit programs exist) the two-program pin intact. On the paged
+        backend the block partition is part of the leak proof: after a
+        drain every block must be FREE or CACHED (a cached prefix is kept
+        warm deliberately — only an OWNED block with no owner is a leak)."""
         slot_audit = self.slots.audit()
-        return {
+        out = {
             "slots_ok": slot_audit["ok"],
             "active_slots": slot_audit["active"],
             "free_slots": slot_audit["free"],
@@ -432,6 +510,19 @@ class Engine:
                        or bool(self._by_slot)),
             "engine_restarts": self.counters.get("engine_restarts"),
         }
+        if self.paged:
+            out.update(
+                blocks_ok=slot_audit["blocks_ok"],
+                blocks_total=slot_audit["blocks_total"],
+                blocks_free=slot_audit["blocks_free"],
+                blocks_cached=slot_audit["blocks_cached"],
+                blocks_active=slot_audit["blocks_active"],
+            )
+            out["leaked"] = bool(
+                out["leaked"] or not slot_audit["blocks_ok"]
+                or slot_audit["blocks_active"] != 0
+            )
+        return out
 
     def close(self, join_timeout_s: float = 30.0) -> None:
         self._closed = True
@@ -490,9 +581,23 @@ class Engine:
                     break
 
     def _admit(self) -> None:
-        """Admit queued requests into free slots (chunked prefill)."""
+        """Admit queued requests into free slots (chunked prefill). On the
+        paged backend, admission additionally gates on BLOCK headroom: the
+        head request stays queued (TTL still burning — that is the
+        backpressure signal) until the pool's free + evictable blocks cover
+        its worst-case footprint, so decode can never hit an empty pool."""
         self.scheduler.expire()
         while self.slots.free_slots > 0:
+            if self.paged:
+                head = self.scheduler.peek()
+                if head is None:
+                    return
+                blocked = not (head.cancel_requested or head.future.cancelled()
+                               ) and not self.slots.can_admit(
+                    head.tokens, head.max_new_tokens, chunk=self.prefill_chunk
+                )
+                if blocked:
+                    return
             req = self.scheduler.pop()
             if req is None:
                 return
@@ -546,7 +651,15 @@ class Engine:
         toks = np.asarray(req.tokens, np.int32)
         c = self.prefill_chunk
         smax = self.slots.max_seq_len
-        starts = list(range(0, len(toks), c))
+        matched = 0
+        if self.paged:
+            # attach the longest cached prefix read-only and reserve the
+            # request's WORST-CASE block footprint up front (evicting cold
+            # prefix blocks if needed) — decode never allocates, so it can
+            # never fail on pool pressure mid-request
+            matched = self.slots.attach_prefix(slot, req.tokens)
+            self.slots.reserve(slot, len(toks) + req.max_new_tokens)
+        starts = list(range(matched, len(toks), c))
         if starts and starts[-1] + c > smax:
             # the fixed-size window must not cross the slot end:
             # dynamic_update_slice would CLAMP the start index, silently
@@ -572,17 +685,34 @@ class Engine:
             # next chunk would corrupt the in-flight one's input
             buf = np.full((1, c), self.pad_id, np.int32)
             buf[0, :n] = chunk
-            logits, cache = _prefill_chunk(
-                self.params, self.cfg, self.slots.cache, jnp.asarray(buf),
-                np.int32(slot), np.int32(start),
-            )
-            self.slots.cache = cache
+            if self.paged:
+                # the slid-left window may dip below the attached prefix —
+                # COW-copy any shared/registered block the write covers
+                # (recomputed k/v is identical; this protects the CACHE
+                # entry and other holders, not this request's numerics)
+                self.slots.ensure_writable(slot, start, min(start + c, smax))
+                logits, pool = _paged_prefill_chunk(
+                    self.params, self.cfg, self.slots.pool, jnp.asarray(buf),
+                    jnp.asarray(self.slots.tables[slot:slot + 1]),
+                    jnp.asarray([start], np.int32),
+                )
+                self.slots.pool = pool
+            else:
+                logits, cache = _prefill_chunk(
+                    self.params, self.cfg, self.slots.cache, jnp.asarray(buf),
+                    np.int32(slot), np.int32(start),
+                )
+                self.slots.cache = cache
             last_row = (logits, n - 1)
             self.counters.inc("prefill_chunks")
             self.counters.inc("prefill_tokens", n)
         logits, idx = last_row
         self._last_logits[slot] = np.asarray(logits[idx], np.float32)
         self.slots.lengths[slot] = len(toks)
+        if self.paged:
+            # publish the prompt's full blocks while the request decodes, so
+            # a same-prefix request admitted next iteration already shares
+            self.slots.register_prefix(slot, req.tokens)
         self._by_slot[slot] = req
         self._rng[slot] = np.random.default_rng((self.seed, req.rid))
         rz.advance(req, rz.DECODING, slot=slot)
@@ -649,11 +779,26 @@ class Engine:
         still = self.slots.active_slots()
         if still:
             with _obs_tracer.span("decode", active=len(still)):
-                logits, cache = _decode_step(
-                    self.params, self.cfg, self.slots.cache,
-                    jnp.asarray(tokens), jnp.asarray(offsets),
-                )
-                self.slots.cache = cache
+                if self.paged:
+                    for slot in still:
+                        # provably a no-op today (decode writes past every
+                        # registered/shared block), kept as a cheap COW
+                        # invariant so a future sharing scheme cannot
+                        # silently corrupt cached prefixes
+                        off = int(offsets[slot])
+                        self.slots.ensure_writable(slot, off, off + 1)
+                    logits, pool = _paged_decode_step(
+                        self.params, self.cfg, self.slots.pool,
+                        jnp.asarray(tokens), jnp.asarray(self.slots.tables),
+                        jnp.asarray(offsets),
+                    )
+                    self.slots.pool = pool
+                else:
+                    logits, cache = _decode_step(
+                        self.params, self.cfg, self.slots.cache,
+                        jnp.asarray(tokens), jnp.asarray(offsets),
+                    )
+                    self.slots.cache = cache
                 # np.asarray is the engine's own readback sync (it needs the
                 # logits on host to sample the next token), so the decode
                 # span closes on realized compute, not dispatch
@@ -670,12 +815,18 @@ class Engine:
             self._last_step_tps = sampled / dt
 
     def assert_cache_bounded(self) -> None:
-        """Pin "exactly two compiled programs for the engine lifetime": the
+        """Pin the fixed compiled-program set for the engine lifetime: the
         first call records the post-warmup baseline, later calls raise
-        ``RecompileError`` on any growth (a static-arg or shape leak)."""
+        ``RecompileError`` on any growth (a static-arg or shape leak). Each
+        backend pins its own prefill + decode pair; the paged backend's
+        third program (the COW block copy, one shape forever) compiles
+        lazily at the first shared write, so it stays outside the guard."""
         from galvatron_tpu.analysis.guards import RecompileError, cache_sizes
 
-        sizes = cache_sizes((_prefill_chunk, _decode_step))
+        if self.paged:
+            sizes = cache_sizes((_paged_prefill_chunk, _paged_decode_step))
+        else:
+            sizes = cache_sizes((_prefill_chunk, _decode_step))
         if self._guard_baseline is None:
             # warmup isn't over until BOTH programs exist: a first step whose
             # requests all retire before the shared forward (1-token answers,
@@ -810,6 +961,8 @@ class Engine:
         ctx = aot_registry.ProgramContext(
             cfg=self.cfg, num_slots=self.slots.num_slots,
             prefill_chunk=self.prefill_chunk, max_seq_len=self.slots.max_seq_len,
+            kv_block_size=self.slots.block_size if self.paged else 16,
+            kv_num_blocks=self.slots.num_blocks if self.paged else 0,
         )
         specs = aot_registry.enumerate_programs(ctx, include=("serving",))
         return aot_warmup.warmup_programs(
@@ -836,10 +989,40 @@ def _serving_programs(ctx):
     max_len = int(min(ctx.max_seq_len or cfg.max_seq_len, cfg.max_seq_len))
     num_slots = max(1, int(ctx.num_slots))
     chunk = min(max(1, int(ctx.prefill_chunk)), max_len)
+    i32 = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.int32)  # noqa: E731
+    kv_num_blocks = int(getattr(ctx, "kv_num_blocks", 0) or 0)
+    if kv_num_blocks:
+        # paged backend: the pool/table shapes are fully determined by
+        # (block_size, num_blocks, max_len), so a warm restart re-hits the
+        # same two artifacts regardless of the allocator's runtime state
+        block_size = max(1, int(ctx.kv_block_size))
+        max_blocks = -(-max_len // block_size)
+        if kv_num_blocks == -1:
+            kv_num_blocks = num_slots * max_blocks + 1
+        pool_abs = jax.eval_shape(
+            lambda: generation.init_kv_cache(cfg, kv_num_blocks, block_size)
+        )
+        return [
+            ProgramSpec(
+                "serving_paged_prefill", _paged_prefill_chunk,
+                (params_abs, cfg, pool_abs, i32(1, chunk), i32(1, max_blocks),
+                 i32(1)),
+                meta={"donate": ("pool",), "num_slots": num_slots,
+                      "prefill_chunk": chunk, "kv_block_size": block_size,
+                      "kv_num_blocks": kv_num_blocks},
+            ),
+            ProgramSpec(
+                "serving_paged_decode", _paged_decode_step,
+                (params_abs, cfg, pool_abs, i32(num_slots),
+                 i32(num_slots, max_blocks), i32(num_slots)),
+                meta={"donate": ("pool",), "num_slots": num_slots,
+                      "kv_block_size": block_size,
+                      "kv_num_blocks": kv_num_blocks},
+            ),
+        ]
     cache_abs = jax.eval_shape(
         lambda: generation.init_kv_cache(cfg, num_slots, max_len)
     )
-    i32 = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.int32)  # noqa: E731
     return [
         ProgramSpec(
             "serving_prefill", _prefill_chunk,
@@ -860,7 +1043,8 @@ def _register_aot_programs():
 
     register_program(
         "serving", _serving_programs,
-        programs=("serving_prefill", "serving_decode"),
+        programs=("serving_prefill", "serving_decode",
+                  "serving_paged_prefill", "serving_paged_decode"),
     )
 
 

@@ -19,12 +19,36 @@ attended.
 
 from __future__ import annotations
 
+import warnings
 from typing import List, Optional
 
 import numpy as np
 
 from galvatron_tpu.models import generation
 from galvatron_tpu.models.modeling import ModelConfig
+
+
+def effective_max_seq_len(cfg: ModelConfig, max_seq_len: Optional[int]) -> int:
+    """Clamp a caller-requested per-request capacity to the model's trained
+    ``cfg.max_seq_len`` — rope tables and position embeddings don't extend
+    past it. A request above the model bound used to be clamped *silently*,
+    which made ``--max_seq_len 8192`` on a 2k model look honoured while every
+    long request was rejected at admission; now the mismatch warns and the
+    effective value is surfaced through ``Engine.stats()`` → /healthz."""
+    if max_seq_len is None:
+        return int(cfg.max_seq_len)
+    requested = int(max_seq_len)
+    if requested > cfg.max_seq_len:
+        warnings.warn(
+            f"requested max_seq_len={requested} exceeds model cfg.max_seq_len="
+            f"{cfg.max_seq_len}; clamping — the replica serves at most "
+            f"{cfg.max_seq_len} tokens per request (see max_seq_len_effective "
+            "in /healthz)",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+        return int(cfg.max_seq_len)
+    return requested
 
 
 class SlotKVCache:
@@ -35,7 +59,7 @@ class SlotKVCache:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.cfg = cfg
         self.num_slots = int(num_slots)
-        self.max_seq_len = int(min(max_seq_len or cfg.max_seq_len, cfg.max_seq_len))
+        self.max_seq_len = effective_max_seq_len(cfg, max_seq_len)
         # device arrays; reassigned by the engine after every jitted step
         self.cache = generation.init_kv_cache(cfg, self.num_slots, self.max_seq_len)
         # host bookkeeping: length = tokens materialized in the slot so far

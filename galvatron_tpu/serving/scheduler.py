@@ -141,6 +141,17 @@ class Scheduler:
                 ))
         return dropped
 
+    def peek(self, now: Optional[float] = None) -> Optional[Request]:
+        """Head of the queue WITHOUT admitting it (expired ones shed
+        first). The paged engine's admission gate reads the head's block
+        footprint before deciding to pop — a request too big for current
+        pool headroom stays queued, burning its own TTL as backpressure.
+        Only the engine loop pops, so peek→pop cannot race another
+        consumer."""
+        self.expire(now)
+        with self._lock:
+            return self._q[0] if self._q else None
+
     def pop(self, now: Optional[float] = None) -> Optional[Request]:
         """Next admissible request (expired ones already shed), or None."""
         self.expire(now)
