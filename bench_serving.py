@@ -31,7 +31,8 @@ import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 
 
-def _build(num_slots, max_seq_len, kv_num_blocks=0, kv_block_size=16):
+def _build(num_slots, max_seq_len, kv_num_blocks=0, kv_block_size=16,
+           serve_quant="off", spec_decode_k=0):
     import jax
     import jax.numpy as jnp
 
@@ -58,7 +59,9 @@ def _build(num_slots, max_seq_len, kv_num_blocks=0, kv_block_size=16):
                         max_seq_len=max_seq_len,
                         eos_id=tok.eos_id, pad_id=tok.pad_id,
                         kv_num_blocks=kv_num_blocks,
-                        kv_block_size=kv_block_size)
+                        kv_block_size=kv_block_size,
+                        serve_quant=serve_quant,
+                        spec_decode_k=spec_decode_k)
     return params, cfg, tok, engine
 
 
@@ -532,6 +535,135 @@ def run_prefix(ns):
     }
 
 
+def run_decode(ns):
+    """Decode-speed section (--decode): the same greedy workload through
+    three numerics arms of the engine — ``fp`` (checkpoint dtype), ``int8``
+    (per-channel weight quantization, --serve_quant int8) and ``int8_spec``
+    (int8 + speculative decoding with the prompt-lookup drafter,
+    --spec_decode_k). Prompts are deliberately repetitive ("abab…"), the
+    shape prompt-lookup drafting exists for, so ``accepted_tokens_per_step``
+    has room to exceed 1.0.
+
+    Reported per arm: decode tokens/s per replica (one replica here — the
+    fleet rollup is the router's job), TTFT p99, and for the spec arm the
+    draft economy (accepted tokens/step, acceptance rate, headroom
+    fallbacks). Exactness is *measured*, not asserted: greedy outputs of
+    ``int8_spec`` must be bit-identical to ``int8`` (speculative decoding's
+    contract), while ``int8`` vs ``fp`` greedy agreement is quantization
+    drift and is reported as a fraction. Outcomes partition the request
+    total per arm, so the CI assertion is arithmetic, not an impression."""
+    tokens = ns.decode_tokens
+    requests = ns.clients * ns.requests_per_client
+    max_seq = ns.prompt_len + 2 + tokens + 1  # +1: verify-window headroom
+
+    def drive(port):
+        outcomes = {"served": 0, "error": 0}
+        outputs = {}
+        lock = threading.Lock()
+
+        def one(i):
+            pstr = "ab" * (ns.prompt_len // 2) + str(i % 10)
+            body = json.dumps({
+                "prompts": [pstr], "tokens_to_generate": tokens,
+                "temperature": 0.0,
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api", data=body,
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=600) as r:
+                    out = json.loads(r.read())
+                with lock:
+                    outcomes["served"] += 1
+                    outputs[i] = list(out["tokens"][0])
+            except Exception:  # noqa: BLE001 — counted, not raised
+                with lock:
+                    outcomes["error"] += 1
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=ns.clients) as ex:
+            list(ex.map(one, range(requests)))
+        return time.perf_counter() - t0, outcomes, outputs
+
+    arms = {}
+    arm_outputs = {}
+    for arm, kw in (
+        ("fp", {}),
+        ("int8", {"serve_quant": "int8"}),
+        ("int8_spec", {"serve_quant": "int8",
+                       "spec_decode_k": ns.spec_decode_k}),
+    ):
+        params, cfg, tok, engine = _build(ns.num_slots, max_seq, **kw)
+        svc, port = _start(params, cfg, tok, engine)
+        try:
+            drive(port)  # warmup: compiles stay out of the timed window
+            engine.reset_metrics()
+            wall, outcomes, outputs = drive(port)
+            st = engine.stats()
+            total_tokens = sum(
+                len(t) - (1 + ns.prompt_len + 1) for t in outputs.values()
+            )
+            arms[arm] = {
+                "wall_s": round(wall, 3), **outcomes,
+                "outcome_total": outcomes["served"] + outcomes["error"],
+                "requests": requests,
+                "tokens_per_s_per_replica": round(total_tokens / wall, 3),
+                "ttft_p99_s": (
+                    round(engine.ttft.quantile(0.99), 4)
+                    if engine.ttft.quantile(0.99) else None),
+                "accepted_tokens_per_step": st["accepted_tokens_per_step"],
+            }
+            if arm == "int8_spec":
+                arms[arm].update(
+                    draft_acceptance_rate=st["draft_acceptance_rate"],
+                    draft_proposed=st["draft_proposed"],
+                    draft_accepted=st["draft_accepted"],
+                    spec_fallbacks=st["spec_fallbacks"],
+                )
+            if kw.get("serve_quant") == "int8":
+                qp = st["quant_parity"] or {}
+                arms[arm]["quant_max_abs_logit_drift"] = qp.get(
+                    "max_abs_logit_drift")
+            arm_outputs[arm] = outputs
+        finally:
+            svc.httpd.shutdown()
+            engine.close()
+
+    def agreement(a, b):
+        """(exact-match request fraction, mean matching-prefix fraction)
+        over requests both arms served."""
+        common = sorted(set(a) & set(b))
+        if not common:
+            return None, None
+        exact = sum(1 for i in common if a[i] == b[i]) / len(common)
+        prefix = 0.0
+        for i in common:
+            n = max(len(a[i]), len(b[i]))
+            m = sum(1 for x, y in zip(a[i], b[i]) if x == y)
+            prefix += m / n if n else 1.0
+        return round(exact, 4), round(prefix / len(common), 4)
+
+    spec_exact, _ = agreement(arm_outputs["int8"], arm_outputs["int8_spec"])
+    q_exact, q_prefix = agreement(arm_outputs["fp"], arm_outputs["int8"])
+    return {
+        "metric": "serving_decode",
+        "tokens": tokens,
+        "clients": ns.clients,
+        "requests": requests,
+        "spec_decode_k": ns.spec_decode_k,
+        "served": sum(a["served"] for a in arms.values()),
+        "error": sum(a["error"] for a in arms.values()),
+        "outcome_total": sum(a["outcome_total"] for a in arms.values()),
+        **arms,
+        # bit-exactness of speculative decoding under greedy (contract:
+        # must be 1.0) and int8-vs-fp greedy agreement (drift, reported)
+        "spec_greedy_exact_frac": spec_exact,
+        "int8_greedy_exact_frac": q_exact,
+        "int8_greedy_prefix_agree_frac": q_prefix,
+    }
+
+
 def run_side(num_slots, clients, requests_per_client, tokens, prompt_len):
     # +2: ByteTokenizer bos + the one-digit client suffix
     params, cfg, tok, engine = _build(num_slots, prompt_len + 2 + tokens)
@@ -605,6 +737,17 @@ def main(argv=None):
                     "goodput + p99 TTFT of served requests (use with "
                     "--overload-style knobs)")
     ap.add_argument("--fleet_replicas", type=int, default=3)
+    ap.add_argument("--decode", action="store_true",
+                    help="also run the decode-speed section: fp vs int8 vs "
+                    "int8+speculative arms on repetitive prompts — decode "
+                    "tokens/s per replica, TTFT p99 per arm, accepted "
+                    "tokens per step, and measured greedy parity — printed "
+                    "before the headline")
+    ap.add_argument("--decode_tokens", type=int, default=32,
+                    help="tokens to generate per request in --decode (long "
+                    "enough that decode, not prefill, dominates)")
+    ap.add_argument("--spec_decode_k", type=int, default=4,
+                    help="draft length for the --decode int8_spec arm")
     ns = ap.parse_args(argv)
 
     if ns.fleet:
@@ -637,6 +780,14 @@ def main(argv=None):
             print(json.dumps(run_prefix(ns)))
         except Exception as e:  # noqa: BLE001 — isolate, report, continue
             print(json.dumps({"metric": "serving_prefix", "skipped": True,
+                              "error": f"{type(e).__name__}: {e}"}))
+
+    if ns.decode:
+        # same isolation contract as --overload
+        try:
+            print(json.dumps(run_decode(ns)))
+        except Exception as e:  # noqa: BLE001 — isolate, report, continue
+            print(json.dumps({"metric": "serving_decode", "skipped": True,
                               "error": f"{type(e).__name__}: {e}"}))
 
     engine_side = run_side(ns.num_slots, ns.clients, ns.requests_per_client,

@@ -23,7 +23,10 @@ Scenarios::
     evict    paged backend under block-pool pressure: queue_full 503s
              carry Retry-After, the LRU evicts cold prefix blocks, an
              engine crash warm-restarts the paged programs from the
-             artifact store, and the drain leaks zero blocks.
+             artifact store, and the drain leaks zero blocks.  With
+             ``--serve_quant int8`` the same run proves QUANTIZED crash
+             recovery: the program keys carry the int8 avals, so the warm
+             hits can only come from re-warming the quantized keys.
 
 Fleet scenarios (``--fleet``, or the ``fleet-`` prefixed names) drive a
 real ``cli serve-fleet`` router over 3 replica subprocesses:
@@ -258,6 +261,12 @@ def scenario_evict(out_dir):
         "--max_queue", "2",
         "--request_ttl_s", "120", "--drain_timeout_s", "30",
     ]
+    # --serve_quant int8 makes this the quantized-recovery proof: the paged
+    # programs' keys now carry the int8 params avals + serve_quant term, so
+    # the warm-restart hits below can only come from re-warming the
+    # QUANTIZED keys (a stale fp artifact cannot satisfy them)
+    int8 = "int8" in EXTRA_SERVE_ARGS
+    paged_args += EXTRA_SERVE_ARGS
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                GALVATRON_FAULTS="engine_crash_at_iter=10,slow_decode_ms=30")
     proc = subprocess.Popen(
@@ -268,7 +277,11 @@ def scenario_evict(out_dir):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     port = None
+    saw_parity = False
     for line in proc.stdout:
+        # the load-time parity line prints at engine construction, BEFORE
+        # "listening on" — it must be caught here, not in the drain tail
+        saw_parity |= "serving quant: int8 per-channel" in line
         m = re.search(r"listening on http://[^:]+:(\d+)/api", line)
         if m:
             port = int(m.group(1))
@@ -276,6 +289,8 @@ def scenario_evict(out_dir):
     if port is None:
         proc.kill()
         raise SystemExit("paged server never came up")
+    assert saw_parity or not int8, \
+        "evict(int8): engine came up without the load-time parity line"
     deadline = time.time() + 120
     while time.time() < deadline:
         try:
@@ -339,9 +354,31 @@ def scenario_evict(out_dir):
     assert retry_after and all(float(ra) > 0 for ra in retry_after), \
         f"queue_full 503s carried no Retry-After hint: {retry_after}"
 
+    # deterministic eviction pressure: how much the concurrent waves shed
+    # at the queue is CPU-speed dependent (a slower engine — e.g. int8
+    # dequant on a host without an int8 datapath — sheds more and completes
+    # fewer distinct prompts), so top up with SEQUENTIAL distinct prompts:
+    # each always admits and leaves different refcount-0 prefix blocks in
+    # the 9-block pool, so a bounded number of them forces the LRU to evict
+    for i in range(100, 108):
+        if healthz(port)["serving"]["prefix_cache_evictions"] >= 1:
+            break
+        try:
+            post(port, {"prompts": [f"evict filler {i}"],
+                        "tokens_to_generate": 24}, timeout=120)
+        except Exception:  # noqa: BLE001 — a straggler 503 is not the point
+            pass
+
     h = healthz(port)
     s = h["serving"]
     assert s["kv_backend"] == "paged", s
+    if int8:
+        # the replica advertises the numerics config it actually serves
+        # under, and the load-time parity probe's measured drift rode along
+        assert s["serve_quant"] == "int8", s
+        qp = s.get("quant_parity") or {}
+        assert qp.get("max_abs_logit_drift") is not None, s
+        assert qp["max_abs_logit_drift"] <= qp["drift_bound"], qp
     assert s["engine_restarts"] >= 1, s
     # warm restart of the PAGED programs: the in-process supervisor re-hit
     # both artifacts in the store (recorded at the startup warm-start)
@@ -367,7 +404,8 @@ def scenario_evict(out_dir):
     print(f"  {outcomes['ok']} served, {outcomes['queue_full']} shed with "
           f"Retry-After, {outcomes['engine_restarted']} crash 503s, "
           f"evictions={s['prefix_cache_evictions']}, restart warm hits="
-          f"{s['restart_warm']['hits']}, zero leaked blocks")
+          f"{s['restart_warm']['hits']}, zero leaked blocks"
+          + (", int8 parity-gated" if int8 else ""))
 
 
 # ---------------------------------------------------------------------------
@@ -673,6 +711,11 @@ SCENARIOS = {"crash": scenario_crash, "stall": scenario_stall,
              "fleet-kill": scenario_fleet_kill,
              "fleet-rolling": scenario_fleet_rolling}
 
+#: extra `cli serve` argv every scenario's replica inherits — set by
+#: --serve_quant so CI can re-run a scenario against the quantized engine
+#: (the int8-specific assertions in scenario_evict key on it)
+EXTRA_SERVE_ARGS: list = []
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser("serving_chaos")
@@ -680,6 +723,9 @@ def main(argv=None) -> int:
                     choices=sorted(SCENARIOS) + ["kill", "rolling"])
     ap.add_argument("--fleet", action="store_true",
                     help="map kill/rolling to the fleet- scenarios")
+    ap.add_argument("--serve_quant", default="off", choices=["off", "int8"],
+                    help="run the scenario's engine quantized: the warm "
+                    "restarts then prove recovery of the int8 program keys")
     ap.add_argument("--out_dir", default=None)
     ns = ap.parse_args(argv)
     scenario = ns.scenario
@@ -687,6 +733,8 @@ def main(argv=None) -> int:
         scenario = f"fleet-{scenario}"
     if scenario not in SCENARIOS:
         ap.error(f"unknown scenario {scenario!r}")
+    if ns.serve_quant != "off":
+        EXTRA_SERVE_ARGS.extend(["--serve_quant", ns.serve_quant])
     out_dir = ns.out_dir or f"/tmp/serving_chaos_{scenario}"
     shutil.rmtree(out_dir, ignore_errors=True)
     os.makedirs(out_dir, exist_ok=True)

@@ -10,9 +10,11 @@ will compile is enumerable with no data and no device work:
   pipeline runtime compiles through the same jitted ``train_step`` entry
   (`build_runtime` dispatches; the registry does not care which engine won).
 - ``serving`` (serving/engine.py): ``serving_prefill`` / ``serving_decode``
-  — the engine's exactly-two pinned programs at its static shapes — or the
+  — the engine's declared pinned programs at its static shapes — or the
   paged twins ``serving_paged_prefill`` / ``serving_paged_decode`` when the
-  context carries ``kv_num_blocks != 0``.
+  context carries ``kv_num_blocks != 0``; plus ``serving_decode_verify``
+  (and its paged twin) at ``(num_slots, 1+k)`` when ``spec_decode_k > 0``,
+  and int8 params avals + a ``serve_quant`` key term when quantized.
 - ``generate`` (registered here, lazily importing models/generation):
   the batch eval/generate program at its default length bucket.
 
@@ -54,6 +56,12 @@ class ProgramContext:
     # slot cache's HBM footprint)
     kv_block_size: int = 16
     kv_num_blocks: int = 0
+    # serving numerics/speed levers that change the program set: int8
+    # weights change every serving program's params avals (and add an
+    # explicit key_extra term); spec_decode_k > 0 adds the decode_verify
+    # program at (num_slots, 1+k)
+    serve_quant: str = "off"
+    spec_decode_k: int = 0
     # generate shapes
     max_new_tokens: int = 32
     length_bucket: int = 64

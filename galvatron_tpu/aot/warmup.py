@@ -217,6 +217,8 @@ def warmup_plan(
     prefill_chunk: int = 32,
     kv_block_size: int = 16,
     kv_num_blocks: int = 0,
+    serve_quant: str = "off",
+    spec_decode_k: int = 0,
     adam: Any = None,
     serialize: bool = False,
     verbose: bool = True,
@@ -230,6 +232,7 @@ def warmup_plan(
         cfg=cfg, hp=hp, global_bsz=global_bsz, seq_len=seq_len,
         num_slots=num_slots, prefill_chunk=prefill_chunk, adam=adam,
         kv_block_size=kv_block_size, kv_num_blocks=kv_num_blocks,
+        serve_quant=serve_quant, spec_decode_k=spec_decode_k,
     )
     try:
         specs = aot_registry.enumerate_programs(ctx, include=include)

@@ -445,7 +445,19 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
                 kv_block_size=ns.kv_block_size,
                 kv_num_blocks=ns.kv_num_blocks,
                 prefix_cache=ns.prefix_cache == "on",
+                serve_quant=ns.serve_quant,
+                quant_drift_max=ns.quant_drift_max,
+                spec_decode_k=ns.spec_decode_k,
+                spec_drafter=ns.spec_drafter,
             )
+            if engine.quant_parity is not None:
+                qp = engine.quant_parity
+                print(
+                    f"serving quant: int8 per-channel, max-abs logit drift "
+                    f"{qp['max_abs_logit_drift']} (bound {qp['drift_bound']}), "
+                    f"greedy agreement {qp['greedy_agree_frac']:.2%} over "
+                    f"{qp['probe_positions']} probe positions", flush=True,
+                )
         service = GenerationService(params, cfg, tok, ns.max_new_tokens,
                                     ns.seed, engine=engine)
         if getattr(ns, "slo", 0):
@@ -603,6 +615,8 @@ def _warmup_mode(ns) -> int:
             cfg=cfg, num_slots=ns.num_slots, prefill_chunk=ns.prefill_chunk,
             kv_block_size=getattr(ns, "kv_block_size", 16),
             kv_num_blocks=getattr(ns, "kv_num_blocks", 0),
+            serve_quant=getattr(ns, "serve_quant", "off"),
+            spec_decode_k=getattr(ns, "spec_decode_k", 0),
         )
         specs = aot_registry.enumerate_programs(ctx, include=include)
         all_reports += aot_warmup.warmup_programs(
@@ -649,6 +663,8 @@ def _warmup_mode(ns) -> int:
             num_slots=ns.num_slots, prefill_chunk=ns.prefill_chunk,
             kv_block_size=getattr(ns, "kv_block_size", 16),
             kv_num_blocks=getattr(ns, "kv_num_blocks", 0),
+            serve_quant=getattr(ns, "serve_quant", "off"),
+            spec_decode_k=getattr(ns, "spec_decode_k", 0),
             adam=adam_config_from_args(ns),
             serialize=bool(ns.serialize),
         )

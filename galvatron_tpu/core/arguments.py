@@ -431,6 +431,31 @@ def _add_generate_args(p: argparse.ArgumentParser):
                    "registered for copy-on-write prefix sharing (LRU-"
                    "evicted under pool pressure); off = blocks free "
                    "immediately on retirement")
+    g.add_argument("--serve_quant", type=str, default="off",
+                   choices=["off", "int8"],
+                   help="serve: weight quantization for the engine (ops/"
+                   "quant.py): int8 = per-channel symmetric absmax weights "
+                   "dequantized inside the matmuls (fp32 accumulate), "
+                   "quantized ONCE at load and parity-gated against "
+                   "--quant_drift_max. A program-key term: pass the same "
+                   "value to `cli warmup`")
+    g.add_argument("--quant_drift_max", type=float, default=1.0,
+                   help="serve: max-abs logit drift the int8 engine may show "
+                   "vs fp on the load-time probe forward before it refuses "
+                   "to serve (the measured drift + greedy agreement land in "
+                   "stats()/healthz either way)")
+    g.add_argument("--spec_decode_k", type=int, default=0,
+                   help="serve: speculative decoding draft length — the "
+                   "drafter proposes up to k tokens per slot per iteration "
+                   "and ONE (B,1+k) verify forward scores them (rejection "
+                   "sampling keeps the output distribution exact; greedy is "
+                   "bit-identical). 0 = off. A program-key term: pass the "
+                   "same value to `cli warmup`")
+    g.add_argument("--spec_drafter", type=str, default="prompt_lookup",
+                   choices=["prompt_lookup"],
+                   help="serve: draft source for --spec_decode_k (serving/"
+                   "speculative.py): prompt_lookup = checkpoint-free n-gram "
+                   "continuation from the request's own prompt+generation")
     g.add_argument("--request_ttl_s", type=float, default=30.0,
                    help="end-to-end request deadline: a request that "
                    "out-waits it in queue 503s, and one still decoding past "
@@ -606,6 +631,15 @@ def _add_warmup_args(p: argparse.ArgumentParser):
                    "match the serve flag or the warm artifacts miss")
     g.add_argument("--kv_block_size", type=int, default=16,
                    help="serving-family shapes: paged KV tokens per block")
+    g.add_argument("--serve_quant", type=str, default="off",
+                   choices=["off", "int8"],
+                   help="serving-family numerics: int8 derives the quantized "
+                   "params avals into every serving program key; match the "
+                   "serve flag or the warm artifacts miss")
+    g.add_argument("--spec_decode_k", type=int, default=0,
+                   help="serving-family shapes: speculative draft length — "
+                   "adds the (num_slots, 1+k) decode_verify program; match "
+                   "the serve flag or the warm artifacts miss")
 
 
 def _add_trace_export_args(p: argparse.ArgumentParser):

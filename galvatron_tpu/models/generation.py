@@ -312,6 +312,39 @@ def sample_logits(key, logits, temperature=1.0, top_k: int = 0, top_p=0.0,
     return jnp.where(t <= 0, greedy, sampled)
 
 
+def host_probs(logits, temperature: float, top_k: int, top_p: float):
+    """Host-side (numpy, float64) mirror of :func:`sample_logits`'s
+    processed distribution over ONE position: temperature scaling, top-k
+    filter, nucleus cutoff (smallest prefix with cumulative prob >= top_p,
+    always >= 1 token) → normalized probabilities (V,).
+
+    Shared by the serving engine's per-slot sampler and the speculative
+    verifier's acceptance test — both must score tokens under the SAME
+    distribution the sampler draws from, or rejection sampling stops being
+    exact. Greedy (temperature <= 0) returns a one-hot at the argmax.
+    """
+    logits = np.asarray(logits, np.float64)
+    p = np.zeros_like(logits)
+    if temperature <= 0:
+        p[np.argmax(logits)] = 1.0
+        return p
+    scaled = logits / temperature
+    if top_k > 0:
+        kth = np.sort(scaled)[-min(top_k, len(scaled))]
+        scaled = np.where(scaled < kth, -np.inf, scaled)
+    if top_p > 0:
+        sorted_logits = np.sort(scaled)[::-1]
+        shifted = sorted_logits - sorted_logits[0]
+        probs = np.exp(shifted) / np.exp(shifted).sum()
+        cum = np.cumsum(probs)
+        keep = cum - probs < top_p
+        threshold = sorted_logits[keep].min()
+        scaled = np.where(scaled < threshold, -np.inf, scaled)
+    shifted = scaled - scaled.max()
+    p = np.exp(shifted)
+    return p / p.sum()
+
+
 # ---------------------------------------------------------------------------
 # Generation loop
 # ---------------------------------------------------------------------------

@@ -34,6 +34,8 @@ from galvatron_tpu import compat
 import jax.numpy as jnp
 import numpy as np
 
+from galvatron_tpu.ops.quant import QuantTensor, qeinsum, qmatmul
+
 Params = Dict[str, Any]
 
 
@@ -257,7 +259,12 @@ def qkv_dims(cfg: ModelConfig) -> Tuple[int, int]:
 def qkv_project(x, w, cfg: ModelConfig):
     """Fused QKV GEMM in the stored layout's natural shape: blocked weights
     (h, 3, n·hd) contract via einsum to (…, 3, n·hd); interleaved weights
-    (h, kv·group) via a plain matmul."""
+    (h, kv·group) via a plain matmul. int8-quantized weights (serving,
+    ops.quant) dequantize inside the GEMM with an fp32 accumulator."""
+    if isinstance(w, QuantTensor):
+        if cfg.qkv_blocked:
+            return qeinsum("...h,hcd->...cd", x, w)
+        return qmatmul(x, w)
     if cfg.qkv_blocked:
         return jnp.einsum("...h,hcd->...cd", x, w.astype(x.dtype))
     return x @ w.astype(x.dtype)
@@ -278,7 +285,9 @@ def attn_output(o, p_attn, cfg: ModelConfig, dtype):
     """(B, S, n, hd) attention context → (B, S, h) via the output projection
     (+ optional bias, added after the row-parallel reduction)."""
     b, s = o.shape[:2]
-    y = o.reshape(b, s, cfg.num_heads * cfg.head_dim) @ p_attn["wo"].astype(dtype)
+    ctx = o.reshape(b, s, cfg.num_heads * cfg.head_dim)
+    wo = p_attn["wo"]
+    y = qmatmul(ctx, wo) if isinstance(wo, QuantTensor) else ctx @ wo.astype(dtype)
     if "wo_b" in p_attn:
         y = y + p_attn["wo_b"].astype(dtype)
     return y
@@ -855,9 +864,18 @@ def _proj_up(subscripts, x, w, cfg: ModelConfig, w_shard_dim: int):
     seq-sharded over the tp axes and the GSPMD-inserted blocking seq
     all-gather is replaced by the decomposed all-gather⊗matmul ring
     (ops.collective_matmul). Non-sp layers keep the plain einsum — x is
-    already tp-replicated, there is no gather to overlap."""
+    already tp-replicated, there is no gather to overlap.
+
+    int8 weights (serving, ops.quant) dequantize inside the plain einsum;
+    the overlap ring streams fp weight shards, so under tp_overlap_ctx a
+    quantized weight is materialized back to fp first (serving never
+    installs the overlap ctx — this branch exists for safety, not speed)."""
     if cfg.tp_overlap_ctx is None:
+        if isinstance(w, QuantTensor):
+            return qeinsum(subscripts, x, w)
         return jnp.einsum(subscripts, x, w)
+    if isinstance(w, QuantTensor):
+        w = w.dequantize(x.dtype)
     from galvatron_tpu.ops import collective_matmul as cm
 
     mesh, dp_ax, tp_ax, sp = cfg.tp_overlap_ctx
@@ -876,7 +894,11 @@ def _proj_down(subscripts, x, w, cfg: ModelConfig, w_shard_dim: int):
     seq-scattered output layout; non-sp layers gather it back (the reduce
     half of the all-reduce still overlaps)."""
     if cfg.tp_overlap_ctx is None:
+        if isinstance(w, QuantTensor):
+            return qeinsum(subscripts, x, w)
         return jnp.einsum(subscripts, x, w)
+    if isinstance(w, QuantTensor):
+        w = w.dequantize(x.dtype)
     from galvatron_tpu.ops import collective_matmul as cm
 
     mesh, dp_ax, tp_ax, sp = cfg.tp_overlap_ctx
@@ -1074,15 +1096,18 @@ def mlp_block(x, p, cfg: ModelConfig, train: bool = True):
         return moe.moe_block(x, p, cfg, train=train)
     # _proj_up/_proj_down only serve the (B, S, H) token stream; vision /
     # windowed layouts keep the plain matmul (tp_overlap_ctx is token-only)
+    plain = lambda x_, w_: (  # noqa: E731 — non-token (vision) layouts
+        qmatmul(x_, w_) if isinstance(w_, QuantTensor) else x_ @ w_
+    )
     up = (
         (lambda x_, w_: _proj_up("bsh,hf->bsf", x_, w_, cfg, w_shard_dim=1))
         if x.ndim == 3
-        else (lambda x_, w_: x_ @ w_)
+        else plain
     )
     down = (
         (lambda x_, w_: _proj_down("bsf,fh->bsh", x_, w_, cfg, w_shard_dim=0))
         if x.ndim == 3
-        else (lambda x_, w_: x_ @ w_)
+        else plain
     )
     if cfg.act_fn == "swiglu":
         # fused [w1 | w3] gate GEMM (~3.5 ms/layer-batch over two narrow
@@ -1208,9 +1233,13 @@ def embed(tokens, params, cfg: ModelConfig, pos_ids=None):
 
 def lm_head(x, params, cfg: ModelConfig):
     if cfg.tie_word_embeddings:
+        # the tied table also feeds the embed gather — it stays fp
         w = params["embed"]["tok"].astype(x.dtype).T
     else:
-        w = params["head"]["w"].astype(x.dtype)
+        w = params["head"]["w"]
+        if isinstance(w, QuantTensor):
+            return qmatmul(x, w)
+        w = w.astype(x.dtype)
     return x @ w
 
 

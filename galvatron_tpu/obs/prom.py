@@ -243,6 +243,47 @@ def server_metrics_text(service) -> str:
         out.add_histogram("serving_latency_hist_seconds", s.get("latency_hist"),
                           help_="request e2e latency, submit to completion "
                           "(cumulative buckets)")
+        # decode-step observability: the per-ITERATION hot path, plus the
+        # speculative-decoding draft economy and the quant/spec numerics
+        # config the replica is actually serving under (config in labels —
+        # a fleet scrape diffing this row across replicas is the cheap
+        # cross-replica consistency check)
+        out.add_histogram("serving_decode_step_hist_seconds",
+                          s.get("decode_step_hist"),
+                          help_="per-iteration decode step latency "
+                          "(cumulative buckets, finer than the "
+                          "request-level histograms)")
+        for name in ("draft_proposed", "draft_accepted", "spec_steps",
+                     "spec_fallbacks"):
+            out.add(f"serving_{name}_total", s.get(name), mtype="counter",
+                    help_="speculative-decoding draft tokens proposed by "
+                    "the prompt-lookup drafter"
+                    if name == "draft_proposed" else "")
+        out.add("serving_accepted_tokens_per_step",
+                s.get("accepted_tokens_per_step"),
+                help_="tokens emitted per decode iteration (batched over "
+                "slots, so ~active-slot width without spec); rising above "
+                "that width means speculative acceptance is paying")
+        out.add("serving_draft_acceptance_rate",
+                s.get("draft_acceptance_rate"),
+                help_="draft_accepted / draft_proposed (cumulative)")
+        if "serve_quant" in s:
+            out.add("serving_numerics_info", 1, labels={
+                "serve_quant": s.get("serve_quant"),
+                "spec_decode_k": s.get("spec_decode_k"),
+                "spec_drafter": s.get("spec_drafter") or "off",
+            }, help_="serving numerics/speed config (constant 1; config "
+                     "in labels)")
+        qp = s.get("quant_parity") or {}
+        out.add("serving_quant_max_abs_logit_drift",
+                qp.get("max_abs_logit_drift"),
+                help_="int8-vs-fp logit drift measured on the load-time "
+                "parity probe (gate bound in "
+                "serving_quant_drift_bound)")
+        out.add("serving_quant_drift_bound", qp.get("drift_bound"))
+        out.add("serving_quant_greedy_agree_frac", qp.get("greedy_agree_frac"),
+                help_="fraction of parity-probe positions whose int8 "
+                "greedy token matches fp")
     render_slo(out, getattr(service, "slo", None))
     c = service.cfg
     out.add("model_info", 1, labels={
@@ -305,7 +346,8 @@ def fleet_metrics_text(router) -> str:
     ]
     for name in ("tokens_generated", "completed", "failed", "expired",
                  "prefix_cache_hits", "prefix_cache_misses",
-                 "prefix_cache_evictions"):
+                 "prefix_cache_evictions", "draft_proposed",
+                 "draft_accepted", "spec_steps", "spec_fallbacks"):
         total = 0
         seen = False
         for r, s in replica_stats:
@@ -322,6 +364,13 @@ def fleet_metrics_text(router) -> str:
         if seen:
             out.add(f"fleet_serving_{name}_sum_total", total, mtype="counter",
                     help_="sum over currently-reachable replicas")
+    # per-replica-only rate gauges: a cross-replica SUM of a rate is
+    # meaningless, so these get no `_sum` twin (the summable raw counters
+    # draft_proposed/draft_accepted are in the counter rollup above)
+    for name in ("accepted_tokens_per_step", "draft_acceptance_rate"):
+        for r, s in replica_stats:
+            out.add(f"fleet_serving_{name}", s.get(name),
+                    labels={"replica": r.idx})
     for name in ("queue_depth", "active_slots", "tokens_per_s",
                  "kv_blocks_total", "kv_blocks_free"):
         total = 0.0
@@ -338,7 +387,9 @@ def fleet_metrics_text(router) -> str:
     from galvatron_tpu.utils.metrics import Histogram
 
     for hist_key, fam in (("ttft_hist", "fleet_ttft_hist_seconds"),
-                          ("latency_hist", "fleet_latency_hist_seconds")):
+                          ("latency_hist", "fleet_latency_hist_seconds"),
+                          ("decode_step_hist",
+                           "fleet_decode_step_hist_seconds")):
         snaps = [s[hist_key] for _, s in replica_stats if s.get(hist_key)]
         for r, s in replica_stats:
             if s.get(hist_key):

@@ -22,7 +22,14 @@ Four layers, one per module:
 - [[engine]] ``Engine`` — the loop: one jitted decode step over all slots
   per iteration, chunked prefill on admission, host-side per-request
   sampling, retire-on-eos/budget/deadline/cancel, graceful ``drain`` with
-  a post-drain zero-leak ``audit``.
+  a post-drain zero-leak ``audit``.  Optional numerics/speed levers:
+  per-channel int8 weights (``--serve_quant int8``, ops/quant.py) and
+  speculative decoding with the [[speculative]] prompt-lookup drafter
+  (``--spec_decode_k``) — both program-key terms the AOT warmup must see.
+- [[speculative]] ``PromptLookupDrafter`` — checkpoint-free n-gram
+  drafter + the exactness contract for draft verification (greedy output
+  is bit-identical to plain decode; sampling keeps the distribution via
+  rejection sampling).
 - [[fleet]] ``FleetRouter`` — the horizontal layer (``cli serve-fleet``):
   N engine replicas behind one router with health-driven dispatch
   (STARTING → READY → DRAINING → DEAD), mid-flight failover inside the
@@ -53,9 +60,12 @@ from galvatron_tpu.serving.scheduler import (
     RequestExpired,
     Scheduler,
 )
+from galvatron_tpu.serving.speculative import PromptLookupDrafter, make_drafter
 
 __all__ = [
     "Engine",
+    "PromptLookupDrafter",
+    "make_drafter",
     "SlotKVCache",
     "PagedKVCache",
     "NoFreeBlocks",

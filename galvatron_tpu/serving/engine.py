@@ -10,11 +10,22 @@ their slot, and finished rows retire and free their slot immediately
 (iteration-level scheduling — Orca, OSDI '22). Overlapping requests share
 every forward pass instead of queueing on a lock.
 
-Static shapes are the point on TPU: exactly two compiled programs exist for
-the engine's whole lifetime — ``_decode_step`` at ``(num_slots, 1)`` and
-``_prefill_chunk`` at ``(1, prefill_chunk)`` — slot index, per-row offsets,
-and prompt contents are all traced operands, so the jit cache stays bounded
-at 2 regardless of traffic mix (no per-request recompiles).
+Static shapes are the point on TPU: a small DECLARED set of compiled
+programs exists for the engine's whole lifetime — ``_decode_step`` at
+``(num_slots, 1)``, ``_prefill_chunk`` at ``(1, prefill_chunk)``, plus
+``_decode_verify`` at ``(num_slots, 1+k)`` when speculative decoding is on
+(``spec_decode_k > 0``) — slot index, per-row offsets, and prompt contents
+are all traced operands, so the jit cache stays bounded at the declared
+count regardless of traffic mix (no per-request recompiles). The original
+2-program pin grew deliberately: every member of the set is enumerable
+up-front (aot/registry), swept by ``cli warmup``, and re-warmed on crash
+recovery — an UNdeclared third program is still a bug the recompile guard
+catches.
+
+``--serve_quant int8`` swaps the fp weights for per-channel int8
+(ops.quant) ONCE at engine load — the quantized avals flow into every
+program key, so the int8 engine warms its own artifact set — and the load
+parity-gates the measured max-abs logit drift against a declared bound.
 
 ``kv_num_blocks != 0`` swaps the contiguous slot cache for the paged
 backend ([[paged_kv]]): K/V lives in a shared block pool addressed through
@@ -50,10 +61,18 @@ from galvatron_tpu.models.generation import KVCache
 from galvatron_tpu.models.modeling import ModelConfig
 from galvatron_tpu.obs.tracing import tracer as _obs_tracer
 from galvatron_tpu.serving import resilience as rz
+from galvatron_tpu.serving import speculative
 from galvatron_tpu.serving.kv_slots import SlotKVCache
 from galvatron_tpu.serving.paged_kv import PagedKVCache
 from galvatron_tpu.serving.scheduler import Request, Scheduler
 from galvatron_tpu.utils.metrics import Counters, Histogram, QuantileWindow
+
+#: decode-iteration latency bucket bounds (seconds): an iteration is
+#: single-digit milliseconds on TPU and tens on CPU CI — the request-level
+#: DEFAULT_LATENCY_BUCKETS would dump everything into the first bucket
+_DECODE_STEP_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
@@ -90,6 +109,24 @@ def _decode_step(params, cfg: ModelConfig, cache: KVCache, tokens, offsets):
     return logits[:, 0], cache
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _decode_verify(params, cfg: ModelConfig, cache: KVCache, tokens, offsets):
+    """Speculative verify step: tokens (B, 1+k) — column 0 is each row's
+    sampled token, columns 1..k its drafted continuation — scored in ONE
+    forward at per-row positions ``offsets`` (the per-row q_offset machinery
+    that already powers chunked prefill handles s>1 rows natively). Returns
+    ((B, 1+k, V) logits, cache): row logits[:, j] is the target
+    distribution AFTER consuming column j, which is exactly what rejection
+    sampling scores draft j+1 against. Rejected-draft k/v written at
+    positions past the accepted length is overwritten by the next step's
+    window before any query attends it — the same scatter-then-attend
+    discipline the (0, 0) inactive rows rely on."""
+    logits, cache = generation.forward_with_cache_slots(
+        params, tokens, cfg, cache, offsets
+    )
+    return logits, cache
+
+
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("pool",))
 def _paged_prefill_chunk(params, cfg: ModelConfig, pool: KVCache, tokens, table,
                          offset):
@@ -116,29 +153,32 @@ def _paged_decode_step(params, cfg: ModelConfig, pool: KVCache, tokens, tables,
     return logits[:, 0], pool
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("pool",))
+def _paged_decode_verify(params, cfg: ModelConfig, pool: KVCache, tokens,
+                         tables, offsets):
+    """Paged twin of ``_decode_verify``: the (B, 1+k) window lands in each
+    row's blocks through the full table. Window positions past a row's
+    reserved footprint resolve to the null block — written, never attended
+    (only accepted positions are ever queried again, and acceptance is
+    capped by the row's admission-time budget)."""
+    logits, pool = generation.forward_with_cache_paged(
+        params, tokens, cfg, pool, tables, offsets
+    )
+    return logits, pool
+
+
 def _sample_host(rng: np.random.Generator, logits: np.ndarray,
                  temperature: float, top_k: int, top_p: float) -> int:
     """Host-side sampler mirroring ``generation.sample_logits`` semantics
     (temperature<=0 → greedy; top-k filter; nucleus keeps the smallest
-    prefix with cumulative prob >= top_p, always >= 1 token)."""
+    prefix with cumulative prob >= top_p, always >= 1 token). The processed
+    distribution itself lives in ``generation.host_probs`` — shared with
+    the speculative verifier, whose acceptance test must score drafts under
+    the SAME distribution this sampler draws from."""
     logits = np.asarray(logits, np.float64)
     if temperature <= 0:
         return int(np.argmax(logits))
-    scaled = logits / temperature
-    if top_k > 0:
-        kth = np.sort(scaled)[-min(top_k, len(scaled))]
-        scaled = np.where(scaled < kth, -np.inf, scaled)
-    if top_p > 0:
-        sorted_logits = np.sort(scaled)[::-1]
-        shifted = sorted_logits - sorted_logits[0]
-        probs = np.exp(shifted) / np.exp(shifted).sum()
-        cum = np.cumsum(probs)
-        keep = cum - probs < top_p
-        threshold = sorted_logits[keep].min()
-        scaled = np.where(scaled < threshold, -np.inf, scaled)
-    shifted = scaled - scaled.max()
-    p = np.exp(shifted)
-    p /= p.sum()
+    p = generation.host_probs(logits, temperature, top_k, top_p)
     return int(rng.choice(len(p), p=p))
 
 
@@ -163,7 +203,11 @@ class Engine:
                  flight_dir: Optional[str] = None,
                  kv_block_size: int = 16,
                  kv_num_blocks: int = 0,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 serve_quant: str = "off",
+                 quant_drift_max: float = 1.0,
+                 spec_decode_k: int = 0,
+                 spec_drafter: str = "prompt_lookup"):
         if deadline_policy not in ("partial", "fail"):
             raise ValueError(
                 f"deadline_policy must be 'partial' or 'fail', got "
@@ -176,6 +220,31 @@ class Engine:
             )
         if prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        if serve_quant not in ("off", "int8"):
+            raise ValueError(
+                f"serve_quant must be 'off' or 'int8', got {serve_quant!r}"
+            )
+        self.serve_quant = serve_quant
+        self.quant_drift_max = float(quant_drift_max)
+        self.quant_parity: Optional[dict] = None
+        if serve_quant == "int8":
+            # quantize ONCE, here — the step never touches fp weights — and
+            # refuse to serve a quantization that left its accuracy budget:
+            # the drift is measured on a probe forward, not assumed
+            from galvatron_tpu.ops import quant as _quant
+
+            qparams = _quant.quantize_params(params, cfg)
+            self.quant_parity = _quant.parity_report(
+                params, qparams, cfg, drift_max=self.quant_drift_max
+            )
+            params = qparams
+        self.spec_k = int(spec_decode_k)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_decode_k must be >= 0, got {spec_decode_k}")
+        self.spec_drafter = spec_drafter if self.spec_k > 0 else None
+        self.drafter = (
+            speculative.make_drafter(spec_drafter) if self.spec_k > 0 else None
+        )
         self.params = params
         self.cfg = cfg
         self.eos_id = int(eos_id)
@@ -206,7 +275,8 @@ class Engine:
         )
         self.counters = Counters(
             "steps", "prefill_chunks", "prefill_tokens", "tokens_generated",
-            "engine_restarts",
+            "engine_restarts", "draft_proposed", "draft_accepted",
+            "spec_steps", "spec_fallbacks",
         )
         self.ttft = QuantileWindow(512)
         # cumulative-bucket twins of the quantile windows: quantiles are the
@@ -214,6 +284,10 @@ class Engine:
         # fleet router aggregates these (snapshots ride /healthz → probe)
         self.ttft_hist = Histogram()
         self.latency_hist = Histogram()
+        # per-ITERATION decode latency (the least-measured hot path until
+        # now): finer buckets than the request-level histograms — one
+        # iteration is milliseconds, not seconds
+        self.decode_step_hist = Histogram(_DECODE_STEP_BUCKETS)
         # AOT artifact store for crash warm-rebuilds (set by warm_start);
         # summary of the most recent restart's warm-up, for tests/probes
         self._store = None
@@ -348,8 +422,17 @@ class Engine:
                 str(req.rid): self.slots.blocks_held(slot)
                 for slot, req in self._by_slot.items()
             }
+        steps = ec["steps"]
         return {
             "kv_backend": "paged" if self.paged else "slot",
+            # the replica's numerics contract rides /healthz: the fleet
+            # router refuses to mix replicas whose quant/spec config
+            # disagrees (bit-parity across a fleet is only meaningful
+            # between identically-configured engines)
+            "serve_quant": self.serve_quant,
+            "spec_decode_k": self.spec_k,
+            "spec_drafter": self.spec_drafter,
+            "quant_parity": self.quant_parity,
             # the capacity the replica ACTUALLY reserved (satellite of the
             # silent-clamp fix: a clamped --max_seq_len shows up here)
             "max_seq_len_effective": self.slots.max_seq_len,
@@ -378,6 +461,22 @@ class Engine:
             # histograms (quantiles can't aggregate; buckets do)
             "ttft_hist": self.ttft_hist.snapshot(),
             "latency_hist": self.latency_hist.snapshot(),
+            "decode_step_hist": self.decode_step_hist.snapshot(),
+            # decode-speed observability (the "least-measured hot path"
+            # satellite): tokens per decode iteration, batched over slots —
+            # ~active-slot width without spec; rising above that width means
+            # speculative acceptance is paying — plus the raw draft economy
+            "accepted_tokens_per_step": (
+                round(tokens / steps, 4) if steps else 0.0
+            ),
+            "draft_proposed": ec["draft_proposed"],
+            "draft_accepted": ec["draft_accepted"],
+            "draft_acceptance_rate": (
+                round(ec["draft_accepted"] / ec["draft_proposed"], 4)
+                if ec["draft_proposed"] else 0.0
+            ),
+            "spec_steps": ec["spec_steps"],
+            "spec_fallbacks": ec["spec_fallbacks"],
             "submitted": sc["submitted"],
             "admitted": sc["admitted"],
             "completed": sc["completed"],
@@ -413,7 +512,8 @@ class Engine:
         compile time from the measured window). Call while idle."""
         self.counters = Counters(
             "steps", "prefill_chunks", "prefill_tokens", "tokens_generated",
-            "engine_restarts",
+            "engine_restarts", "draft_proposed", "draft_accepted",
+            "spec_steps", "spec_fallbacks",
         )
         self.scheduler.counters = Scheduler.new_counters()
         # the supervisor's progress detection reads the completed counter:
@@ -423,6 +523,7 @@ class Engine:
         self.ttft = QuantileWindow(512)
         self.ttft_hist = Histogram()
         self.latency_hist = Histogram()
+        self.decode_step_hist = Histogram(_DECODE_STEP_BUCKETS)
         self._busy_s = 0.0
         self._last_step_tps = 0.0
 
@@ -777,7 +878,10 @@ class Engine:
         for slot in expired:
             self._retire_deadline(slot)
         still = self.slots.active_slots()
-        if still:
+        drafts = self._build_drafts(still, offsets) if still else {}
+        if still and drafts:
+            appended += self._verify_step(still, tokens, offsets, drafts)
+        elif still:
             with _obs_tracer.span("decode", active=len(still)):
                 if self.paged:
                     for slot in still:
@@ -811,22 +915,152 @@ class Engine:
             self.assert_cache_bounded()
         dt = time.perf_counter() - t0
         self._busy_s += dt
+        if still:
+            self.decode_step_hist.observe(dt)
         if dt > 0:
             self._last_step_tps = sampled / dt
 
+    def _build_drafts(self, still, offsets) -> Dict[int, List[int]]:
+        """Propose up to ``spec_k`` draft tokens per surviving slot from the
+        prompt-lookup drafter. Returns {} — plain decode — when speculation
+        is off, no row produced a draft (a wasted (1+k)-wide verify is pure
+        overhead), or ANY surviving row lacks ``k+1`` positions of slot
+        headroom: ``dynamic_update_slice`` CLAMPS an out-of-range window
+        start, which would silently overwrite earlier cache positions (the
+        same hazard the prefill slide-left handles), and the paged gather
+        clamps table indices past ``max_seq_len`` the same way. Both the
+        plain and verify programs are pinned and warm, so the per-iteration
+        choice costs nothing."""
+        if self.spec_k <= 0 or self.drafter is None:
+            return {}
+        k = self.spec_k
+        smax = self.slots.max_seq_len
+        drafts: Dict[int, List[int]] = {}
+        for slot in still:
+            if int(offsets[slot]) + 1 + k > smax:
+                self.counters.inc("spec_fallbacks")
+                return {}
+            req = self._by_slot[slot]
+            budget = req.max_new_tokens - len(req.generated)
+            d = self.drafter.draft(
+                list(req.tokens) + req.generated, min(k, budget)
+            )
+            if d:
+                drafts[slot] = d
+        return drafts
+
+    def _verify_step(self, still, tokens, offsets,
+                     drafts: Dict[int, List[int]]) -> int:
+        """One speculative decode iteration: score every row's t0+drafts in
+        a single (B, 1+k) forward, then run the rejection-sampling
+        acceptance loop per row on host.
+
+        Alignment: ``logits[slot, j]`` is the target distribution AFTER
+        consuming window column j, so draft ``d[j]`` (window column j+1) is
+        scored against ``logits[slot, j]``. On the first rejection the
+        rejected token is struck (-inf) from the stored logits — the exact
+        residual for a point-mass draft, and an argmax no-op under greedy
+        (the rejected token was not the argmax by definition). On full
+        acceptance ``logits[slot, len(d)]`` becomes the next iteration's
+        sampling distribution. Rows without drafts ride along: their
+        column-0 logits are exactly what the plain decode step would have
+        produced."""
+        k = self.spec_k
+        batch = np.full((self.slots.num_slots, 1 + k), self.pad_id, np.int32)
+        batch[:, 0] = tokens
+        for slot, d in drafts.items():
+            batch[slot, 1:1 + len(d)] = d
+        with _obs_tracer.span("decode_verify", active=len(still), k=k):
+            if self.paged:
+                smax = self.slots.max_seq_len
+                for slot in still:
+                    off = int(offsets[slot])
+                    self.slots.ensure_writable(slot, off, min(off + 1 + k, smax))
+                logits, pool = _paged_decode_verify(
+                    self.params, self.cfg, self.slots.pool,
+                    jnp.asarray(batch), jnp.asarray(self.slots.tables),
+                    jnp.asarray(offsets),
+                )
+                self.slots.pool = pool
+            else:
+                logits, cache = _decode_verify(
+                    self.params, self.cfg, self.slots.cache,
+                    jnp.asarray(batch), jnp.asarray(offsets),
+                )
+                self.slots.cache = cache
+            logits = np.asarray(logits)  # (B, 1+k, V)
+        self.counters.inc("spec_steps")
+        appended = 0
+        retired: List[int] = []
+        for slot in still:
+            req = self._by_slot[slot]
+            d = drafts.get(slot, [])
+            L = logits[slot]
+            accepted = 0
+            rejected_at = -1
+            finish = None
+            for j, dt in enumerate(d):
+                if req.temperature <= 0:
+                    ok = int(np.argmax(L[j])) == dt
+                else:
+                    p = generation.host_probs(
+                        L[j], req.temperature, req.top_k, req.top_p
+                    )
+                    ok = self._rng[slot].random() < p[dt]
+                if not ok:
+                    rejected_at = j
+                    break
+                accepted += 1
+                if self.eos_id >= 0 and dt == self.eos_id:
+                    # matches the sampling loop: eos retires WITHOUT being
+                    # appended to the completion
+                    finish = "eos"
+                    break
+                req.generated.append(dt)
+                appended += 1
+                if len(req.generated) >= req.max_new_tokens:
+                    finish = "length"
+                    break
+            self.counters.inc("draft_proposed", len(d))
+            self.counters.inc("draft_accepted", accepted)
+            if finish is not None:
+                req.finish_reason = finish
+                retired.append(slot)
+                continue
+            # only appended tokens advance the row's KV length (the eos /
+            # budget cases above never reach here); rejected-draft k/v past
+            # the new length is dead weight the next window overwrites
+            self.slots.lengths[slot] += accepted
+            if rejected_at >= 0:
+                resid = np.asarray(L[rejected_at], np.float32).copy()
+                resid[d[rejected_at]] = -np.inf
+                self._last_logits[slot] = resid
+            else:
+                self._last_logits[slot] = L[len(d)]
+        for slot in retired:
+            self._retire(slot)
+        return appended
+
     def assert_cache_bounded(self) -> None:
-        """Pin the fixed compiled-program set for the engine lifetime: the
-        first call records the post-warmup baseline, later calls raise
+        """Pin the DECLARED compiled-program set for the engine lifetime:
+        the first call records the post-warmup baseline, later calls raise
         ``RecompileError`` on any growth (a static-arg or shape leak). Each
-        backend pins its own prefill + decode pair; the paged backend's
-        third program (the COW block copy, one shape forever) compiles
-        lazily at the first shared write, so it stays outside the guard."""
+        backend pins its own prefill + decode pair, plus the decode_verify
+        program when speculative decoding is on — the 2-program pin became
+        a declared set, not an open one; the paged backend's COW block copy
+        (one shape forever) compiles lazily at the first shared write, so
+        it stays outside the guard."""
         from galvatron_tpu.analysis.guards import RecompileError, cache_sizes
 
         if self.paged:
-            sizes = cache_sizes((_paged_prefill_chunk, _paged_decode_step))
+            fns = [_paged_prefill_chunk, _paged_decode_step]
+            if self.spec_k > 0:
+                fns.append(_paged_decode_verify)
         else:
-            sizes = cache_sizes((_prefill_chunk, _decode_step))
+            fns = [_prefill_chunk, _decode_step]
+            if self.spec_k > 0:
+                fns.append(_decode_verify)
+        sizes = cache_sizes(tuple(fns))
         if self._guard_baseline is None:
             # warmup isn't over until BOTH programs exist: a first step whose
             # requests all retire before the shared forward (1-token answers,
@@ -963,6 +1197,7 @@ class Engine:
             prefill_chunk=self.prefill_chunk, max_seq_len=self.slots.max_seq_len,
             kv_block_size=self.slots.block_size if self.paged else 16,
             kv_num_blocks=self.slots.num_blocks if self.paged else 0,
+            serve_quant=self.serve_quant, spec_decode_k=self.spec_k,
         )
         specs = aot_registry.enumerate_programs(ctx, include=("serving",))
         return aot_warmup.warmup_programs(
@@ -971,9 +1206,13 @@ class Engine:
 
 
 # --- AOT program registration (galvatron_tpu/aot): the serving family -------
-# The engine's whole design is "exactly two compiled programs for the
-# lifetime" — which makes them the cheapest possible warm-start: both are
-# enumerable from (ModelConfig, num_slots, prefill_chunk) with no weights.
+# The engine's whole design is "a small declared program set for the
+# lifetime" — which makes it the cheapest possible warm-start: every member
+# is enumerable from (ModelConfig, num_slots, prefill_chunk, serve_quant,
+# spec_decode_k) with no weights. int8 engines derive their params avals
+# through quantize_params under eval_shape, so the quantized dtype lands in
+# every program key (plus an explicit key_extra term) — a warm fp store can
+# never satisfy an int8 engine, and crash recovery re-warms the right set.
 
 
 def _serving_programs(ctx):
@@ -986,6 +1225,17 @@ def _serving_programs(ctx):
     params_abs = jax.eval_shape(
         lambda k: modeling.init_model_params(k, cfg), jax.random.key(0)
     )
+    serve_quant = str(getattr(ctx, "serve_quant", "off") or "off")
+    spec_k = int(getattr(ctx, "spec_decode_k", 0) or 0)
+    if serve_quant == "int8":
+        from galvatron_tpu.ops import quant as _quant
+
+        params_abs = jax.eval_shape(
+            lambda p: _quant.quantize_params(p, cfg), params_abs
+        )
+    key_extra = (
+        {"serve_quant": serve_quant} if serve_quant != "off" else None
+    )
     max_len = int(min(ctx.max_seq_len or cfg.max_seq_len, cfg.max_seq_len))
     num_slots = max(1, int(ctx.num_slots))
     chunk = min(max(1, int(ctx.prefill_chunk)), max_len)
@@ -994,7 +1244,7 @@ def _serving_programs(ctx):
     if kv_num_blocks:
         # paged backend: the pool/table shapes are fully determined by
         # (block_size, num_blocks, max_len), so a warm restart re-hits the
-        # same two artifacts regardless of the allocator's runtime state
+        # same artifacts regardless of the allocator's runtime state
         block_size = max(1, int(ctx.kv_block_size))
         max_blocks = -(-max_len // block_size)
         if kv_num_blocks == -1:
@@ -1002,40 +1252,63 @@ def _serving_programs(ctx):
         pool_abs = jax.eval_shape(
             lambda: generation.init_kv_cache(cfg, kv_num_blocks, block_size)
         )
-        return [
+        paged_meta = {"kv_block_size": block_size,
+                      "kv_num_blocks": kv_num_blocks}
+        if key_extra:
+            paged_meta["key_extra"] = key_extra
+        out = [
             ProgramSpec(
                 "serving_paged_prefill", _paged_prefill_chunk,
                 (params_abs, cfg, pool_abs, i32(1, chunk), i32(1, max_blocks),
                  i32(1)),
                 meta={"donate": ("pool",), "num_slots": num_slots,
-                      "prefill_chunk": chunk, "kv_block_size": block_size,
-                      "kv_num_blocks": kv_num_blocks},
+                      "prefill_chunk": chunk, **paged_meta},
             ),
             ProgramSpec(
                 "serving_paged_decode", _paged_decode_step,
                 (params_abs, cfg, pool_abs, i32(num_slots),
                  i32(num_slots, max_blocks), i32(num_slots)),
                 meta={"donate": ("pool",), "num_slots": num_slots,
-                      "kv_block_size": block_size,
-                      "kv_num_blocks": kv_num_blocks},
+                      **paged_meta},
             ),
         ]
+        if spec_k > 0:
+            out.append(ProgramSpec(
+                "serving_paged_decode_verify", _paged_decode_verify,
+                (params_abs, cfg, pool_abs, i32(num_slots, 1 + spec_k),
+                 i32(num_slots, max_blocks), i32(num_slots)),
+                meta={"donate": ("pool",), "num_slots": num_slots,
+                      "spec_decode_k": spec_k, **paged_meta},
+            ))
+        return out
     cache_abs = jax.eval_shape(
         lambda: generation.init_kv_cache(cfg, num_slots, max_len)
     )
-    return [
+    slot_meta = {"key_extra": key_extra} if key_extra else {}
+    out = [
         ProgramSpec(
             "serving_prefill", _prefill_chunk,
             (params_abs, cfg, cache_abs, i32(1, chunk), i32(), i32()),
             meta={"donate": ("cache",), "num_slots": num_slots,
-                  "prefill_chunk": chunk},
+                  "prefill_chunk": chunk, **slot_meta},
         ),
         ProgramSpec(
             "serving_decode", _decode_step,
             (params_abs, cfg, cache_abs, i32(num_slots), i32(num_slots)),
-            meta={"donate": ("cache",), "num_slots": num_slots},
+            meta={"donate": ("cache",), "num_slots": num_slots, **slot_meta},
         ),
     ]
+    if spec_k > 0:
+        # the verify program's key carries k via the (B, 1+k) token aval —
+        # sweeping --spec_decode_k at warmup warms each k separately
+        out.append(ProgramSpec(
+            "serving_decode_verify", _decode_verify,
+            (params_abs, cfg, cache_abs, i32(num_slots, 1 + spec_k),
+             i32(num_slots)),
+            meta={"donate": ("cache",), "num_slots": num_slots,
+                  "spec_decode_k": spec_k, **slot_meta},
+        ))
+    return out
 
 
 def _register_aot_programs():
@@ -1044,7 +1317,9 @@ def _register_aot_programs():
     register_program(
         "serving", _serving_programs,
         programs=("serving_prefill", "serving_decode",
-                  "serving_paged_prefill", "serving_paged_decode"),
+                  "serving_decode_verify",
+                  "serving_paged_prefill", "serving_paged_decode",
+                  "serving_paged_decode_verify"),
     )
 
 

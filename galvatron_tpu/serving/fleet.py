@@ -963,12 +963,39 @@ class FleetRouter:
             "requests": self.counters.snapshot(),
             "replica": [r.snapshot() for r in self.replicas],
         }
+        # numerics contract across the fleet: every replica advertises its
+        # quant/spec config via /healthz (engine.stats()); cross-replica
+        # bit-parity — what the fleet parity test and any response-equality
+        # failover check rely on — is only meaningful between identically
+        # configured engines, so a mixed fleet is surfaced loudly here
+        configs = {}
+        for r in self.replicas:
+            s = r.last_health.get("serving") or {}
+            if "serve_quant" in s:
+                configs[r.idx] = {
+                    "serve_quant": s.get("serve_quant"),
+                    "spec_decode_k": s.get("spec_decode_k"),
+                    "spec_drafter": s.get("spec_drafter"),
+                }
+        if configs:
+            distinct = {json.dumps(c, sort_keys=True) for c in configs.values()}
+            out["numerics"] = {
+                "replica_configs": configs,
+                "consistent": len(distinct) == 1,
+            }
+            if len(distinct) > 1:
+                out.setdefault("degraded_reasons", []).append(
+                    "numerics_config_mismatch"
+                )
         if self.slo is not None:
             # the fleet's degradation view: the router's own SLO breaches
             # plus every replica's (probed /healthz carries them) — one
             # probe of the router answers "is anything in the fleet burning
             # its error budget, and which rule"
-            reasons = list(self.slo.degraded_reasons())
+            reasons = out.get("degraded_reasons", [])
+            for why in self.slo.degraded_reasons():
+                if why not in reasons:
+                    reasons.append(why)
             for r in self.replicas:
                 for why in (r.last_health.get("degraded_reasons") or []):
                     tag = f"replica{r.idx}:{why}"

@@ -1,0 +1,92 @@
+"""Speculative decoding: checkpoint-free drafting + exact verification.
+
+Speculative decoding (Leviathan et al. 2023) turns decode's bandwidth
+bound into arithmetic: a cheap drafter proposes ``k`` tokens, the target
+model scores all of them in ONE forward at ``(B, 1+k)`` — re-reading the
+weight set once instead of ``1+k`` times — and rejection sampling keeps
+the output distribution exactly the target's. Acceptance is the whole
+game: ``accepted_tokens_per_step`` > 1 is pure decode speedup, ≈1 is pure
+overhead.
+
+The drafter here is PROMPT LOOKUP (n-gram continuation): propose the
+tokens that followed the longest matching suffix n-gram earlier in the
+request's own prompt+generation. No draft checkpoint, no second model, no
+extra HBM — and it is strong exactly where serving traffic is repetitive
+(RAG quoting its context, code completion, structured output), weak on
+free prose (acceptance → 0, the engine falls back to plain decode steps).
+
+Exactness contract (what the tests pin):
+
+- A proposed token ``d`` is a point-mass draft distribution ``q = δ_d``.
+  Rejection sampling accepts ``d`` with probability ``p(d)`` under the
+  target's processed distribution (same temperature/top-k/top-p pipeline
+  as the engine's host sampler); on rejection the replacement token is
+  drawn from the residual ``norm(p - p(d)·δ_d)`` — i.e. ``p`` with ``d``
+  struck out and renormalized, which the engine realizes by writing
+  ``-inf`` into the stored logits at ``d``.
+- Under greedy (temperature ≤ 0) this degenerates to "accept while
+  ``argmax == d``", and striking out a non-argmax token cannot move the
+  argmax — so greedy speculative output is BIT-IDENTICAL to the plain
+  engine, not merely close.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+class PromptLookupDrafter:
+    """Longest-suffix n-gram lookup over the request's own token stream.
+
+    For ``n`` from ``ngram_max`` down to ``ngram_min``: find the most
+    recent earlier occurrence of the sequence's last ``n`` tokens and
+    propose (up to ``k``) tokens that followed it. First hit wins — longer
+    matches are better predictors. Returns ``[]`` when nothing matches;
+    the engine then runs a plain decode step for free (no wasted verify).
+    """
+
+    name = "prompt_lookup"
+
+    def __init__(self, ngram_max: int = 3, ngram_min: int = 1,
+                 max_scan: int = 4096):
+        if ngram_min < 1 or ngram_max < ngram_min:
+            raise ValueError(
+                f"need 1 <= ngram_min <= ngram_max, got "
+                f"[{ngram_min}, {ngram_max}]"
+            )
+        self.ngram_max = int(ngram_max)
+        self.ngram_min = int(ngram_min)
+        # bound the suffix scan: O(max_scan · ngram_max) per draft keeps the
+        # host-side cost flat for book-length sessions
+        self.max_scan = int(max_scan)
+
+    def draft(self, tokens: Sequence[int], k: int) -> List[int]:
+        if k <= 0:
+            return []
+        toks = list(tokens[-self.max_scan:])
+        ln = len(toks)
+        for n in range(min(self.ngram_max, ln - 1), self.ngram_min - 1, -1):
+            suffix = toks[ln - n:]
+            # scan right-to-left: recency beats earlier occurrences
+            for i in range(ln - n - 1, -1, -1):
+                if toks[i:i + n] == suffix:
+                    cont = toks[i + n:i + n + k]
+                    if cont:
+                        return [int(t) for t in cont]
+        return []
+
+
+_DRAFTERS = {"prompt_lookup": PromptLookupDrafter}
+
+
+def make_drafter(name: str, **kw):
+    """Drafter registry: ``--spec_drafter`` values resolve here (a future
+    draft-model drafter registers alongside without touching the engine)."""
+    try:
+        cls = _DRAFTERS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown spec drafter {name!r}; available: "
+            f"{sorted(_DRAFTERS)}"
+        ) from None
+    return cls(**kw)
