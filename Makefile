@@ -22,9 +22,11 @@ test-all:
 	$(PY) -m pytest tests/ -q -m ""
 
 # static analysis (docs/DESIGN.md § Static analysis): trace-hygiene linter
-# + plan checker over the checked-in strategy configs — the CI gate
+# + concurrency (lock-discipline) linter + plan checker over the checked-in
+# strategy configs — the CI gate
 lint:
 	$(PY) -m galvatron_tpu.analysis.lint galvatron_tpu
+	$(PY) -m galvatron_tpu.analysis.concurrency galvatron_tpu
 
 check-plan:
 	$(PY) -m galvatron_tpu.cli check-plan configs/strategies/*.json --strict 1

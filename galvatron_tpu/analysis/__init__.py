@@ -14,6 +14,17 @@ Two pillars (neither compiles anything):
   after async dispatch (the exact serving-engine corruption bug class),
   recompilation hazards. ``python -m galvatron_tpu.analysis.lint <paths>``.
 
+- **Concurrency linter** (``concurrency``): lock discipline for the
+  host-side control plane — ``# guarded-by:`` annotations checked against
+  lock regions, static lock-order cycles, blocking calls under locks,
+  ``Condition.wait`` predicate loops, thread leaks (``GTL2…`` codes).
+  ``python -m galvatron_tpu.analysis.concurrency <paths>``. Its runtime
+  twin (``locks``) swaps ``make_lock``/``make_rlock``/``make_condition``
+  to instrumented primitives under ``GALVATRON_LOCK_CHECK=1``: actual
+  acquisition-order validation (``LockOrderError`` with both stacks),
+  per-lock hold/contention counters for /metrics, held-lock snapshots for
+  the flight recorder and watchdog.
+
 Plus ``recompile_guard`` (``guards``): a context manager generalizing the
 ``generate._cache_size()`` test pins so tests and the serving engine can
 assert bounded jit-cache growth.
@@ -21,13 +32,29 @@ assert bounded jit-cache growth.
 
 from galvatron_tpu.analysis.diagnostics import Diagnostic, format_report
 from galvatron_tpu.analysis.guards import RecompileError, recompile_guard
+from galvatron_tpu.analysis.locks import (
+    LockOrderError,
+    held_snapshot,
+    lock_check_armed,
+    lock_metrics,
+    make_condition,
+    make_lock,
+    make_rlock,
+)
 from galvatron_tpu.analysis.plan_check import PlanError, check_plan
 
 __all__ = [
     "Diagnostic",
+    "LockOrderError",
     "PlanError",
     "RecompileError",
     "check_plan",
     "format_report",
+    "held_snapshot",
+    "lock_check_armed",
+    "lock_metrics",
+    "make_condition",
+    "make_lock",
+    "make_rlock",
     "recompile_guard",
 ]

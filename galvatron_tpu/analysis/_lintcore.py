@@ -1,0 +1,180 @@
+"""Shared core for the AST linters (`lint.py` trace hygiene, `concurrency.py`
+lock discipline): the ONE suppression contract, finding dedup, file walking
+and CLI scaffolding — extracted so the GTL1xx and GTL2xx families cannot
+drift on how ``# gta: disable=<CODE> — <reason>`` is parsed or reported.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import sys
+import tokenize
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from galvatron_tpu.analysis.diagnostics import Diagnostic, format_report
+
+# codes must LOOK like codes (GTL101/GTA012) so a plain-word reason after a
+# space ("# gta: disable=GTL101 gated by flag") parses as the reason, not as
+# part of the code list
+SUPPRESS_RE = re.compile(
+    r"#\s*gta:\s*disable=((?:GT[A-Z]\d+\s*,\s*)*GT[A-Z]\d+)(.*)"
+)
+
+
+class Suppressions:
+    """Per-file suppression map: ``# gta: disable=<CODE> — <reason>`` by
+    line. A reasonless suppression is itself a finding (GTL100), collected
+    in ``malformed``."""
+
+    def __init__(self, src: str, path: str):
+        self.by_line: Dict[int, Set[str]] = {}
+        self.malformed: List[Diagnostic] = []
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(src).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+                reason = m.group(2).strip().lstrip("—-: ").strip()
+                if not reason:
+                    self.malformed.append(
+                        Diagnostic(
+                            "GTL100",
+                            "suppression without a reason — say why the rule "
+                            "does not apply here",
+                            hint="# gta: disable=<CODE> — <reason>",
+                            source=path,
+                            line=tok.start[0],
+                        )
+                    )
+                    continue
+                self.by_line.setdefault(tok.start[0], set()).update(codes)
+        except tokenize.TokenError:
+            pass
+
+    def active(self, line: int, code: str) -> bool:
+        return code in self.by_line.get(line, ())
+
+
+def comment_lines(src: str) -> Dict[int, str]:
+    """{line: comment text} for every comment token — the channel the
+    guarded-by annotation grammar rides (tokenize, not regex, so strings
+    containing '#' cannot fake an annotation)."""
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def dotted(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """('np', 'random', 'randint') for np.random.randint; None otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+class BaseLinter:
+    """Suppression-aware finding collector both linters subclass: ``_emit``
+    drops suppressed findings (counting each site once even when a rule
+    re-walks a region), ``finalize`` dedups by (code, line, message)."""
+
+    def __init__(self, src: str, path: str):
+        self.src = src
+        self.path = path
+        self.findings: List[Diagnostic] = []
+        self.suppressed = 0
+        self._sup_seen: set = set()
+        self.sup = Suppressions(src, path)
+
+    def parse(self) -> Optional[ast.AST]:
+        try:
+            return ast.parse(self.src)
+        except SyntaxError as e:
+            # not a linter's job; flag nothing (py_compile/CI catches it)
+            print(f"{self.path}: skipped (syntax error: {e})", file=sys.stderr)
+            return None
+
+    def _emit(self, code: str, line: int, message: str, hint: str = ""):
+        if self.sup.active(line, code):
+            # same dedup key as the findings list: a rule's double pass over
+            # loop bodies (and nested-loop re-walks) must not over-count one
+            # suppression
+            key = (code, line, message)
+            if key not in self._sup_seen:
+                self._sup_seen.add(key)
+                self.suppressed += 1
+            return
+        self.findings.append(
+            Diagnostic(code, message, hint=hint, source=self.path, line=line)
+        )
+
+    def finalize(self) -> List[Diagnostic]:
+        seen = set()
+        unique = []
+        for f in self.findings:
+            key = (f.code, f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                unique.append(f)
+        self.findings = unique
+        return self.findings
+
+
+LintFn = Callable[[str, str], Tuple[List[Diagnostic], int]]
+
+
+def walk_py_files(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                if "__pycache__" in root:
+                    continue
+                files += [os.path.join(root, n) for n in names if n.endswith(".py")]
+        elif p.endswith(".py"):
+            files.append(p)
+    return sorted(files)
+
+
+def lint_paths_with(lint_source: LintFn, paths: Sequence[str]) -> Tuple[List[Diagnostic], int]:
+    findings: List[Diagnostic] = []
+    suppressed = 0
+    for f in walk_py_files(paths):
+        with open(f, encoding="utf-8") as fh:
+            fs, sup = lint_source(fh.read(), f)
+        findings += fs
+        suppressed += sup
+    return findings, suppressed
+
+
+def cli_main(lint_source: LintFn, doc: str,
+             argv: Optional[Sequence[str]] = None) -> int:
+    """The shared ``python -m …`` entry: paths (files or trees) → exit 1 on
+    any unsuppressed finding, with the suppression count always printed so
+    a silently-suppressed tree is visible in the CI log."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(doc)
+        return 0
+    findings, suppressed = lint_paths_with(lint_source, argv)
+    if findings:
+        print(format_report(findings, clean=""))
+        print(f"({suppressed} suppressed)")
+        return 1
+    print(f"lint clean ({suppressed} suppressed)")
+    return 0

@@ -1,0 +1,729 @@
+"""Lock-discipline linter: AST rules for the threaded host control plane.
+
+``python -m galvatron_tpu.analysis.concurrency galvatron_tpu/`` — exit 1 on
+any unsuppressed finding. The serving engine, fleet router, paged-KV
+allocator, peer store and watchdogs are classic multithreaded Python; every
+bug class a chaos harness has caught here is encoded as a static rule, in
+the spirit of ``@GuardedBy``/Clang Thread Safety Analysis (guarded fields)
+and lockdep (acquisition-order graphs).
+
+Annotation grammar (DESIGN.md § Static analysis has the full table):
+
+  self._q = deque()          # guarded-by: self._lock
+      declares ``_q`` guarded by ``_lock`` (on the assignment line);
+  _GUARDED_BY = {"_q": "_lock"}
+      the class-map equivalent (one dict, many fields);
+  def _drop(self):  # holds: self._lock
+      an assert-hold helper: its body is analyzed as holding the lock, and
+      calling it at a site that does NOT hold the lock is a finding.
+
+Rules (codes in diagnostics.CODES; ``RULES`` maps code → summary):
+
+  GTL200  a guarded-by/holds declaration names a lock attribute the class
+          never creates — the annotation would silently check nothing.
+  GTL201  a guarded field read or written outside its declared lock
+          (``__init__`` is exempt: the object is not yet shared).
+  GTL202  lock-order inversion: the static acquisition-order graph (per
+          class, plus cross-class edges through ``self.<attr>.<method>()``
+          resolution) contains a cycle; the diagnostic names both paths.
+  GTL203  a blocking call while holding a lock: ``time.sleep``, socket
+          send/recv/accept/connect, ``subprocess`` wait/communicate,
+          ``Future.result()``/``Queue.get()``/``.join()``/``.wait()``
+          without a timeout, HTTP requests, ``block_until_ready``.
+  GTL204  thread leak: a non-daemon Thread started without a reachable
+          ``join``; or a thread started in ``__init__`` before the rest of
+          the instance state is assigned (the thread can observe a
+          half-constructed object).
+  GTL205  ``Condition.wait`` outside a ``while``-predicate loop — a lost or
+          spurious wakeup turns into a hang or a premature continue.
+  GTL206  check-then-act: one ``with lock:`` block reads a guarded field,
+          a later block in the same suite writes it — the decision is stale
+          by the time it is applied (the ``try_advance`` bug class).
+
+Suppression: the finding's line must carry ``# gta: disable=<CODE>`` WITH a
+reason, e.g. ``# gta: disable=GTL203 — bounded by the socket timeout set at
+connect``. A reasonless suppression is itself a finding (GTL100).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from galvatron_tpu.analysis._lintcore import (
+    BaseLinter,
+    cli_main,
+    comment_lines,
+    dotted,
+    lint_paths_with,
+)
+from galvatron_tpu.analysis.diagnostics import CODES, Diagnostic
+
+#: code → one-line summary; the single source the DESIGN.md table is pinned
+#: to (doc-sync test in tests/test_concurrency.py)
+RULES: Dict[str, str] = {
+    c: CODES[c][0] for c in sorted(CODES) if c.startswith("GTL2")
+}
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*self\.(\w+)")
+_HOLDS_RE = re.compile(r"#\s*holds:\s*self\.(\w+)")
+
+# constructor names that create a lock-like attribute (threading primitives
+# and the analysis/locks.py instrumented drop-ins / factories)
+_LOCK_CTORS = {
+    "Lock", "RLock", "Condition",
+    "InstrumentedLock", "InstrumentedRLock", "InstrumentedCondition",
+    "make_lock", "make_rlock", "make_condition",
+}
+_CONDITION_CTORS = {"Condition", "InstrumentedCondition", "make_condition"}
+
+# dotted call heads that block regardless of arguments
+_BLOCKING_DOTTED_TAILS = {
+    ("time", "sleep"),
+    ("urllib", "request", "urlopen"),
+    ("urlopen",),
+}
+_BLOCKING_DOTTED_HEADS = {"requests"}  # requests.get / requests.post / ...
+# socket-style method names that block on the peer
+_BLOCKING_METHODS = {"send", "sendall", "recv", "recv_into", "accept",
+                     "connect", "communicate", "block_until_ready"}
+# methods that block only when called WITHOUT a timeout
+_TIMEOUT_METHODS = {"result", "get", "join", "wait"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'_lock' for the AST of ``self._lock``; None otherwise."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return bool(call.args) or any(
+        kw.arg in ("timeout", "block") for kw in call.keywords
+    )
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    return dotted(call.func) in (("threading", "Thread"), ("Thread",))
+
+
+def _daemon_kw(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+class _ClassInfo:
+    """Pass-1 harvest of one class: its locks, guarded-field declarations,
+    assert-hold annotations, per-method acquisition sets, and same-module
+    attribute types (for cross-class lock-order edges)."""
+
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.name = node.name
+        self.locks: Set[str] = set()
+        self.conditions: Set[str] = set()
+        self.guarded: Dict[str, str] = {}
+        self.decl_lines: Dict[str, int] = {}
+        self.attr_types: Dict[str, str] = {}
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        self.holds: Dict[str, Set[str]] = {}
+        self.acquires: Dict[str, Set[str]] = {}
+
+
+class ConcurrencyLinter(BaseLinter):
+    def run(self) -> List[Diagnostic]:
+        tree = self.parse()
+        if tree is None:
+            return []
+        self.findings.extend(self.sup.malformed)
+        self.comments = comment_lines(self.src)
+        self.classes: Dict[str, _ClassInfo] = {}
+        # edge (u, v) of lock-node tuples → (line, human description)
+        self.graph: Dict[Tuple[Tuple[str, str], Tuple[str, str]], Tuple[int, str]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                info = self._collect_class(node)
+                self.classes[info.name] = info
+        for info in self.classes.values():
+            self._analyze_class(info)
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_thread_leaks(node, cls=None)
+        self._check_lock_order_cycles()
+        return self.finalize()
+
+    # -- pass 1: harvest ---------------------------------------------------
+
+    def _collect_class(self, node: ast.ClassDef) -> _ClassInfo:
+        info = _ClassInfo(node)
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods[stmt.name] = stmt
+            elif isinstance(stmt, ast.Assign):
+                # class map: _GUARDED_BY = {"_field": "_lock", ...}
+                for t in stmt.targets:
+                    if (isinstance(t, ast.Name) and t.id == "_GUARDED_BY"
+                            and isinstance(stmt.value, ast.Dict)):
+                        for k, v in zip(stmt.value.keys, stmt.value.values):
+                            if (isinstance(k, ast.Constant)
+                                    and isinstance(v, ast.Constant)):
+                                info.guarded[str(k.value)] = str(v.value)
+                                info.decl_lines[str(k.value)] = stmt.lineno
+        for fn in info.methods.values():
+            # lock attributes: self.X = <anything containing a Lock ctor>
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Assign):
+                    attr = None
+                    for t in sub.targets:
+                        a = _self_attr(t)
+                        if a:
+                            attr = a
+                    if not attr:
+                        continue
+                    for c in ast.walk(sub.value):
+                        if isinstance(c, ast.Call):
+                            d = dotted(c.func)
+                            if d and d[-1] in _LOCK_CTORS:
+                                info.locks.add(attr)
+                                if d[-1] in _CONDITION_CTORS:
+                                    info.conditions.add(attr)
+                            elif (d and d[-1][0:1].isupper()
+                                  and d[-1] in self.classes):
+                                info.attr_types[attr] = d[-1]
+                    # guarded-by comment on the assignment line
+                    m = _GUARDED_BY_RE.search(self.comments.get(sub.lineno, ""))
+                    if m:
+                        info.guarded[attr] = m.group(1)
+                        info.decl_lines[attr] = sub.lineno
+            # assert-hold annotation on the def line
+            m = _HOLDS_RE.search(self.comments.get(fn.lineno, ""))
+            if m:
+                info.holds[fn.name] = {m.group(1)}
+        # a second sweep for attr types: classes defined later in the module
+        for fn in info.methods.values():
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+                    attr = None
+                    for t in sub.targets:
+                        a = _self_attr(t)
+                        if a:
+                            attr = a
+                    d = dotted(sub.value.func)
+                    if attr and d and len(d) == 1 and d[0][0:1].isupper():
+                        info.attr_types.setdefault(attr, d[0])
+        # per-method acquisition sets (for call-through edge resolution)
+        for name, fn in info.methods.items():
+            acq: Set[str] = set(info.holds.get(name, ()))
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.With, ast.AsyncWith)):
+                    for item in sub.items:
+                        a = _self_attr(item.context_expr)
+                        if a and a in info.locks:
+                            acq.add(a)
+                if isinstance(sub, ast.Call):
+                    f = sub.func
+                    if (isinstance(f, ast.Attribute) and f.attr == "acquire"):
+                        a = _self_attr(f.value)
+                        if a and a in info.locks:
+                            acq.add(a)
+            info.acquires[name] = acq
+        return info
+
+    # -- pass 2: per-class analysis ----------------------------------------
+
+    def _analyze_class(self, info: _ClassInfo) -> None:
+        # GTL200: declarations must name a real lock (otherwise the
+        # annotation checks nothing and the field is silently unguarded)
+        for field_name, lock in sorted(info.guarded.items()):
+            if lock not in info.locks:
+                self._emit(
+                    "GTL200", info.decl_lines.get(field_name, info.node.lineno),
+                    f"{info.name}.{field_name} declared guarded by "
+                    f"self.{lock}, but the class never creates that lock",
+                    hint="create the lock in __init__, or fix the name in "
+                    "the guarded-by declaration",
+                )
+        for field_name in [f for f, lk in info.guarded.items()
+                           if lk not in info.locks]:
+            del info.guarded[field_name]  # don't cascade into GTL201 noise
+        for name, locks in sorted(info.holds.items()):
+            for lock in sorted(locks - info.locks):
+                self._emit(
+                    "GTL200", info.methods[name].lineno,
+                    f"{info.name}.{name} asserts it holds self.{lock}, but "
+                    "the class never creates that lock",
+                )
+        for name, fn in info.methods.items():
+            held = frozenset(info.holds.get(name, set()) & info.locks)
+            self._walk_stmts(info, fn, fn.body, held)
+            self._check_cond_wait(info, fn)
+            self._check_check_then_act(info, fn)
+        self._check_thread_leaks_class(info)
+
+    # ---- lock-region walker (GTL201, GTL202 edges, GTL203) ----------------
+
+    def _walk_stmts(self, info: _ClassInfo, fn, stmts, held: FrozenSet[str]):
+        held = frozenset(held)
+        for stmt in stmts:
+            held = self._walk_stmt(info, fn, stmt, held)
+
+    def _walk_stmt(self, info, fn, stmt, held: FrozenSet[str]) -> FrozenSet[str]:
+        """Process one statement under ``held``; returns the held set for the
+        NEXT statement in the same suite (bare acquire()/release() calls
+        mutate it — ``with`` blocks do not outlive their body)."""
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in stmt.items:
+                a = _self_attr(item.context_expr)
+                if a and a in info.locks:
+                    self._record_acquire(info, fn, inner, a, stmt.lineno)
+                    inner = inner | {a}
+                else:
+                    self._scan_expr(info, fn, item.context_expr, inner)
+            self._walk_stmts(info, fn, stmt.body, inner)
+            return held
+        if isinstance(stmt, (ast.If,)):
+            self._scan_expr(info, fn, stmt.test, held)
+            self._walk_stmts(info, fn, stmt.body, held)
+            self._walk_stmts(info, fn, stmt.orelse, held)
+            return held
+        if isinstance(stmt, ast.While):
+            self._scan_expr(info, fn, stmt.test, held)
+            self._walk_stmts(info, fn, stmt.body, held)
+            self._walk_stmts(info, fn, stmt.orelse, held)
+            return held
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_expr(info, fn, stmt.iter, held)
+            self._scan_expr(info, fn, stmt.target, held)
+            self._walk_stmts(info, fn, stmt.body, held)
+            self._walk_stmts(info, fn, stmt.orelse, held)
+            return held
+        if isinstance(stmt, ast.Try):
+            self._walk_stmts(info, fn, stmt.body, held)
+            for h in stmt.handlers:
+                self._walk_stmts(info, fn, h.body, held)
+            self._walk_stmts(info, fn, stmt.orelse, held)
+            self._walk_stmts(info, fn, stmt.finalbody, held)
+            return held
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def is a deferred body (thread target, callback): it
+            # does NOT inherit the lexical lock scope — analyzed lock-free
+            self._walk_stmts(info, fn, stmt.body, frozenset())
+            return held
+        if isinstance(stmt, ast.ClassDef):
+            return held
+        # bare acquire()/release() tracked linearly through the suite
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            f = stmt.value.func
+            if isinstance(f, ast.Attribute):
+                a = _self_attr(f.value)
+                if a and a in info.locks:
+                    if f.attr == "acquire":
+                        self._record_acquire(info, fn, held, a, stmt.lineno)
+                        self._scan_expr(info, fn, stmt.value, held)
+                        return held | {a}
+                    if f.attr == "release":
+                        return held - {a}
+        for child in ast.iter_child_nodes(stmt):
+            self._scan_expr(info, fn, child, held)
+        return held
+
+    def _record_acquire(self, info, fn, held: FrozenSet[str], lock: str,
+                        line: int) -> None:
+        for h in held:
+            if h == lock:
+                continue
+            self._add_edge((info.name, h), (info.name, lock), line,
+                           f"{info.name}.{fn.name}")
+
+    def _add_edge(self, u: Tuple[str, str], v: Tuple[str, str], line: int,
+                  where: str) -> None:
+        if u != v:
+            self.graph.setdefault((u, v), (line, where))
+
+    def _scan_expr(self, info, fn, expr, held: FrozenSet[str]) -> None:
+        in_init = fn.name == "__init__"
+        for node in ast.walk(expr):
+            a = _self_attr(node)
+            if a and a in info.guarded and not in_init:
+                guard = info.guarded[a]
+                if guard not in held:
+                    ctx = "written" if isinstance(
+                        getattr(node, "ctx", None), (ast.Store, ast.Del)
+                    ) else "read"
+                    self._emit(
+                        "GTL201", node.lineno,
+                        f"{info.name}.{a} is guarded by self.{guard} but "
+                        f"{ctx} here without it (in {fn.name})",
+                        hint=f"wrap the access in `with self.{guard}:` (or "
+                        "annotate the method `# holds: self."
+                        f"{guard}` if every caller already holds it)",
+                    )
+            if isinstance(node, ast.Call):
+                self._scan_call(info, fn, node, held)
+
+    def _scan_call(self, info, fn, call: ast.Call, held: FrozenSet[str]) -> None:
+        f = call.func
+        # call-through resolution: self.m() and self.attr.m()
+        if isinstance(f, ast.Attribute):
+            recv_attr = _self_attr(f.value)
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                callee = f.attr
+                if callee in info.holds:
+                    missing = info.holds[callee] - held
+                    for lock in sorted(missing & info.locks):
+                        self._emit(
+                            "GTL201", call.lineno,
+                            f"call to {info.name}.{callee} (asserts it holds "
+                            f"self.{lock}) without holding the lock",
+                            hint=f"acquire self.{lock} around the call",
+                        )
+                for acq in sorted(info.acquires.get(callee, ())):
+                    self._record_acquire(info, fn, held, acq, call.lineno)
+            elif recv_attr and recv_attr in info.attr_types:
+                other = self.classes.get(info.attr_types[recv_attr])
+                if other is not None:
+                    for acq in sorted(other.acquires.get(f.attr, ())):
+                        for h in held:
+                            self._add_edge(
+                                (info.name, h), (other.name, acq),
+                                call.lineno, f"{info.name}.{fn.name}")
+        if held:
+            self._check_blocking(info, fn, call, held)
+
+    def _check_blocking(self, info, fn, call: ast.Call,
+                        held: FrozenSet[str]) -> None:
+        f = call.func
+        d = dotted(f)
+        what = None
+        if d is not None:
+            if d in _BLOCKING_DOTTED_TAILS or (
+                    len(d) >= 2 and d[-2:] in _BLOCKING_DOTTED_TAILS):
+                what = ".".join(d)
+            elif d[0] in _BLOCKING_DOTTED_HEADS and len(d) >= 2:
+                what = ".".join(d)
+        if what is None and isinstance(f, ast.Attribute):
+            if f.attr in _BLOCKING_METHODS:
+                what = f".{f.attr}()"
+            elif f.attr in _TIMEOUT_METHODS and not _has_timeout(call):
+                recv = _self_attr(f.value)
+                if f.attr == "wait" and recv is not None and recv in held:
+                    # self._cond.wait() releases the condition's own lock
+                    # while parked; held-other-locks still block (below)
+                    if len(held) == 1:
+                        return
+                what = f".{f.attr}() without a timeout"
+        if what is None:
+            return
+        locks = ", ".join(f"self.{h}" for h in sorted(held))
+        self._emit(
+            "GTL203", call.lineno,
+            f"blocking call {what} while holding {locks} (in "
+            f"{info.name}.{fn.name}): every thread contending the lock "
+            "stalls behind this wait",
+            hint="move the blocking call outside the lock, or bound it "
+            "with a timeout",
+        )
+
+    # ---- GTL205: Condition.wait predicate loops ---------------------------
+
+    def _check_cond_wait(self, info: _ClassInfo, fn) -> None:
+        parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(fn):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and f.attr == "wait"):
+                continue
+            recv = _self_attr(f.value)
+            if recv not in info.conditions:
+                continue
+            p = parents.get(node)
+            in_while = False
+            while p is not None and not isinstance(
+                    p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                if isinstance(p, ast.While):
+                    in_while = True
+                    break
+                p = parents.get(p)
+            if not in_while:
+                self._emit(
+                    "GTL205", node.lineno,
+                    f"self.{recv}.wait() outside a while-predicate loop (in "
+                    f"{info.name}.{fn.name}): a spurious or lost wakeup "
+                    "continues without the condition being true",
+                    hint="wrap it: `while not <predicate>: cond.wait(...)` "
+                    "(or use cond.wait_for(predicate))",
+                )
+
+    # ---- GTL206: check-then-act across split lock regions -----------------
+
+    def _check_check_then_act(self, info: _ClassInfo, fn) -> None:
+        if fn.name == "__init__" or not info.guarded:
+            return
+        regions: List[Tuple[int, str, Set[str], Set[str], int]] = []
+
+        def collect(stmts, block_id: int):
+            nonlocal next_block
+            for stmt in stmts:
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    lock = None
+                    for item in stmt.items:
+                        a = _self_attr(item.context_expr)
+                        if a and a in info.locks:
+                            lock = a
+                    if lock is not None:
+                        reads: Set[str] = set()
+                        writes: Set[str] = set()
+                        for sub in ast.walk(stmt):
+                            a = _self_attr(sub)
+                            if a and info.guarded.get(a) == lock:
+                                if isinstance(sub.ctx, (ast.Store, ast.Del)):
+                                    writes.add(a)
+                                else:
+                                    reads.add(a)
+                        regions.append(
+                            (block_id, lock, reads, writes, stmt.lineno))
+                        continue  # the region is atomic; don't recurse
+                for child_block in (
+                    getattr(stmt, "body", None), getattr(stmt, "orelse", None),
+                    getattr(stmt, "finalbody", None),
+                ):
+                    if isinstance(child_block, list) and child_block and not (
+                        isinstance(stmt, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef, ast.ClassDef))
+                    ):
+                        next_block += 1
+                        collect(child_block, next_block)
+                if isinstance(stmt, ast.Try):
+                    for h in stmt.handlers:
+                        next_block += 1
+                        collect(h.body, next_block)
+
+        next_block = 0
+        collect(fn.body, 0)
+        for i, (bi, lock_i, reads_i, writes_i, line_i) in enumerate(regions):
+            for bj, lock_j, _reads_j, writes_j, line_j in regions[i + 1:]:
+                if bi != bj or lock_i != lock_j:
+                    continue
+                stale = (reads_i - writes_i) & writes_j
+                for field_name in sorted(stale):
+                    self._emit(
+                        "GTL206", line_j,
+                        f"check-then-act on {info.name}.{field_name}: read "
+                        f"under self.{lock_i} at line {line_i}, written "
+                        f"under a separate acquisition here — the check is "
+                        "stale by the time it is applied",
+                        hint="hold the lock across check and act, or "
+                        "re-validate inside the writing region "
+                        "(the try_advance pattern)",
+                    )
+
+    # ---- GTL204: thread leaks ---------------------------------------------
+
+    def _check_thread_leaks_class(self, info: _ClassInfo) -> None:
+        joined_attrs: Set[str] = set()
+        daemon_attrs: Set[str] = set()
+        started_attrs: Dict[str, int] = {}
+        for fn in info.methods.values():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute):
+                    a = _self_attr(node.value)
+                    if a:
+                        if node.attr == "join":
+                            joined_attrs.add(a)
+                        elif node.attr == "start":
+                            started_attrs.setdefault(a, node.lineno)
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and t.attr == "daemon"):
+                            a = _self_attr(t.value)
+                            if a and isinstance(node.value, ast.Constant) \
+                                    and node.value.value:
+                                daemon_attrs.add(a)
+        for fn in info.methods.values():
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and _is_thread_ctor(node.value)):
+                    continue
+                attr = None
+                for t in node.targets:
+                    a = _self_attr(t)
+                    if a:
+                        attr = a
+                if attr is None:
+                    continue
+                if attr not in started_attrs:
+                    continue
+                if _daemon_kw(node.value) or attr in daemon_attrs:
+                    continue
+                if attr not in joined_attrs:
+                    self._emit(
+                        "GTL204", node.lineno,
+                        f"non-daemon thread self.{attr} is started but "
+                        f"never joined anywhere in {info.name}",
+                        hint="join it in close()/a finally block, or mark "
+                        "it daemon=True if it must not block exit",
+                    )
+            self._check_thread_leaks(fn, cls=info)
+        init = info.methods.get("__init__")
+        if init is not None:
+            self._check_init_start_order(info, init)
+
+    def _check_init_start_order(self, info: _ClassInfo, init) -> None:
+        thread_attrs: Set[str] = set()
+        start_lines: List[Tuple[int, str]] = []
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                    and _is_thread_ctor(node.value):
+                for t in node.targets:
+                    a = _self_attr(t)
+                    if a:
+                        thread_attrs.add(a)
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "start":
+                a = _self_attr(node.func.value)
+                if a:
+                    start_lines.append((node.lineno, a))
+        for line, attr in start_lines:
+            if attr not in thread_attrs:
+                continue
+            late = [
+                (n.lineno, t.attr)
+                for n in ast.walk(init) if isinstance(n, ast.Assign)
+                for t in n.targets
+                if isinstance(t, ast.Attribute) and _self_attr(t)
+                and t.attr != attr and n.lineno > line
+            ]
+            if late:
+                lline, lattr = min(late)
+                self._emit(
+                    "GTL204", line,
+                    f"thread self.{attr} started in {info.name}.__init__ "
+                    f"before state init completes (self.{lattr} assigned at "
+                    f"line {lline}): the thread can observe a "
+                    "half-constructed object",
+                    hint="start the thread as the LAST statement of "
+                    "__init__ (or from an explicit start method)",
+                )
+
+    def _check_thread_leaks(self, fn, cls: Optional[_ClassInfo]) -> None:
+        """Local (non-self) threads inside one function: non-daemon +
+        started + not joined in the same function ⇒ leak."""
+        created: Dict[str, Tuple[int, bool]] = {}  # var → (line, daemon)
+        joined: Set[str] = set()
+        started: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                    and _is_thread_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        created[t.id] = (node.lineno, _daemon_kw(node.value))
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute) and t.attr == "daemon"
+                            and isinstance(t.value, ast.Name)
+                            and isinstance(node.value, ast.Constant)
+                            and node.value.value):
+                        created[t.value.id] = (
+                            created.get(t.value.id, (node.lineno, False))[0],
+                            True,
+                        )
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name):
+                if node.func.attr == "join":
+                    joined.add(node.func.value.id)
+                elif node.func.attr == "start":
+                    started.add(node.func.value.id)
+        for var, (line, daemon) in sorted(created.items()):
+            if daemon or var not in started or var in joined:
+                continue
+            self._emit(
+                "GTL204", line,
+                f"non-daemon thread {var!r} started without a reachable "
+                f"join in {fn.name}",
+                hint="join it (a finally block survives exceptions), or "
+                "mark it daemon=True",
+            )
+
+    # ---- GTL202: cycle detection over the acquisition graph ---------------
+
+    def _check_lock_order_cycles(self) -> None:
+        succ: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+        for (u, v) in self.graph:
+            succ.setdefault(u, []).append(v)
+        reported: Set[FrozenSet[Tuple[str, str]]] = set()
+        for (u, v), (line, where) in sorted(
+                self.graph.items(), key=lambda kv: kv[1][0]):
+            path = self._find_path(succ, v, u)
+            if path is None:
+                continue
+            nodes = frozenset([u, v] + path)
+            if nodes in reported:
+                continue
+            reported.add(nodes)
+            fwd = f"{u[0]}.{u[1]} → {v[0]}.{v[1]} (in {where}, line {line})"
+            back_hops = [v] + path
+            back_descr = []
+            for a, b in zip(back_hops, back_hops[1:]):
+                bl, bw = self.graph[(a, b)]
+                back_descr.append(
+                    f"{a[0]}.{a[1]} → {b[0]}.{b[1]} (in {bw}, line {bl})")
+            self._emit(
+                "GTL202", line,
+                "lock-order inversion: " + fwd + " but also "
+                + "; ".join(back_descr),
+                hint="pick one global acquisition order and restructure "
+                "the second path to follow it (or merge the locks)",
+            )
+
+    @staticmethod
+    def _find_path(succ, src, dst) -> Optional[List[Tuple[str, str]]]:
+        """Shortest path src→dst as the list of nodes AFTER src (BFS);
+        None when unreachable. src == dst returns [] only via a real hop."""
+        from collections import deque
+        prev: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        q = deque([src])
+        seen = {src}
+        while q:
+            n = q.popleft()
+            for m in succ.get(n, ()):
+                if m == dst:
+                    path = [m]
+                    while n != src:
+                        path.append(n)
+                        n = prev[n]
+                    return list(reversed(path))
+                if m not in seen:
+                    seen.add(m)
+                    prev[m] = n
+                    q.append(m)
+        return None
+
+
+def lint_source(src: str, path: str = "<string>") -> Tuple[List[Diagnostic], int]:
+    linter = ConcurrencyLinter(src, path)
+    findings = linter.run()
+    return findings, linter.suppressed
+
+
+def lint_paths(paths: Sequence[str]) -> Tuple[List[Diagnostic], int]:
+    return lint_paths_with(lint_source, paths)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    return cli_main(lint_source, __doc__, argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,7 +1,8 @@
 """Structured diagnostics shared by the plan checker and the linter.
 
 Every failure class has a STABLE code — ``GTA0xx`` for plan diagnostics,
-``GTL1xx`` for lint rules — so CI can gate on specific codes, suppressions
+``GTL1xx`` for trace-hygiene lint rules, ``GTL2xx`` for lock-discipline
+lint rules — so CI can gate on specific codes, suppressions
 can name them, and the docs table (DESIGN.md "Static analysis") stays the
 single reference. Codes are append-only: a retired rule keeps its number.
 """
@@ -44,6 +45,14 @@ CODES = {
     "GTL104": ("Python branch on a traced argument inside a jitted function", ERROR),
     "GTL105": ("jax.jit constructed inside a loop (fresh cache per iteration)", WARN),
     "GTL106": ("unhashable literal passed as a static jit argument", ERROR),
+    # --- lock-discipline linter (GTL2xx, analysis/concurrency.py) ---
+    "GTL200": ("guarded-by declaration names a lock the class never creates", ERROR),
+    "GTL201": ("guarded field accessed outside its declared lock", ERROR),
+    "GTL202": ("lock-order inversion: acquisition-order graph has a cycle", ERROR),
+    "GTL203": ("blocking call while holding a lock", ERROR),
+    "GTL204": ("thread leak: non-daemon thread without a reachable join, or started before __init__ completes", ERROR),
+    "GTL205": ("Condition.wait outside a while-predicate loop (lost wakeup)", ERROR),
+    "GTL206": ("check-then-act: guarded read and dependent write hold the lock separately", ERROR),
 }
 
 

@@ -33,14 +33,16 @@ A reasonless suppression is itself a finding (GTL100).
 from __future__ import annotations
 
 import ast
-import io
-import os
-import re
-import sys
-import tokenize
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from galvatron_tpu.analysis.diagnostics import Diagnostic, format_report
+from galvatron_tpu.analysis._lintcore import (
+    BaseLinter,
+    SUPPRESS_RE as _SUPPRESS_RE,  # re-exported: tests pin the contract here
+    cli_main,
+    dotted as _dotted,
+    lint_paths_with,
+)
+from galvatron_tpu.analysis.diagnostics import Diagnostic
 
 # host-sync call forms: bare builtins over a device value, np conversions,
 # and method calls on the value itself
@@ -59,60 +61,6 @@ _DISPATCH_CHAINS = {
     ("jax", "numpy", "asarray"),
     ("jax", "numpy", "array"),
 }
-
-# codes must LOOK like codes (GTL101/GTA012) so a plain-word reason after a
-# space ("# gta: disable=GTL101 gated by flag") parses as the reason, not as
-# part of the code list
-_SUPPRESS_RE = re.compile(
-    r"#\s*gta:\s*disable=((?:GT[A-Z]\d+\s*,\s*)*GT[A-Z]\d+)(.*)"
-)
-
-
-class _Suppressions:
-    def __init__(self, src: str, path: str):
-        self.by_line: Dict[int, Set[str]] = {}
-        self.malformed: List[Diagnostic] = []
-        try:
-            toks = tokenize.generate_tokens(io.StringIO(src).readline)
-            for tok in toks:
-                if tok.type != tokenize.COMMENT:
-                    continue
-                m = _SUPPRESS_RE.search(tok.string)
-                if not m:
-                    continue
-                codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
-                reason = m.group(2).strip().lstrip("—-: ").strip()
-                if not reason:
-                    self.malformed.append(
-                        Diagnostic(
-                            "GTL100",
-                            "suppression without a reason — say why the rule "
-                            "does not apply here",
-                            hint="# gta: disable=<CODE> — <reason>",
-                            source=path,
-                            line=tok.start[0],
-                        )
-                    )
-                    continue
-                self.by_line.setdefault(tok.start[0], set()).update(codes)
-        except tokenize.TokenError:
-            pass
-
-    def active(self, line: int, code: str) -> bool:
-        return code in self.by_line.get(line, ())
-
-
-def _dotted(node: ast.AST) -> Optional[Tuple[str, ...]]:
-    """('np', 'random', 'randint') for np.random.randint; None otherwise."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return tuple(reversed(parts))
-    return None
-
 
 def _is_jax_jit(node: ast.AST) -> bool:
     d = _dotted(node)
@@ -186,21 +134,10 @@ class _ModuleIndex(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-class Linter:
-    def __init__(self, src: str, path: str):
-        self.src = src
-        self.path = path
-        self.findings: List[Diagnostic] = []
-        self.suppressed = 0
-        self._sup_seen: set = set()
-        self.sup = _Suppressions(src, path)
-
+class Linter(BaseLinter):
     def run(self) -> List[Diagnostic]:
-        try:
-            tree = ast.parse(self.src)
-        except SyntaxError as e:
-            # not this linter's job; flag nothing (py_compile/CI catches it)
-            print(f"{self.path}: skipped (syntax error: {e})", file=sys.stderr)
+        tree = self.parse()
+        if tree is None:
             return []
         idx = _ModuleIndex()
         idx.visit(tree)
@@ -224,31 +161,7 @@ class Linter:
             if isinstance(node, ast.Call):
                 self._check_static_literal(node)
         # nested loops are visited by the outer loop's walk too — dedup
-        seen = set()
-        unique = []
-        for f in self.findings:
-            key = (f.code, f.line, f.message)
-            if key not in seen:
-                seen.add(key)
-                unique.append(f)
-        self.findings = unique
-        return self.findings
-
-    # -- emission ----------------------------------------------------------
-
-    def _emit(self, code: str, line: int, message: str, hint: str = ""):
-        if self.sup.active(line, code):
-            # same dedup key as the findings list: the GTL103 double pass
-            # over loop bodies (and nested-loop re-walks) must not
-            # over-count one suppression
-            key = (code, line, message)
-            if key not in self._sup_seen:
-                self._sup_seen.add(key)
-                self.suppressed += 1
-            return
-        self.findings.append(
-            Diagnostic(code, message, hint=hint, source=self.path, line=line)
-        )
+        return self.finalize()
 
     # -- GTL102 / GTL104: inside jit-traced functions ----------------------
 
@@ -484,37 +397,11 @@ def lint_source(src: str, path: str = "<string>") -> Tuple[List[Diagnostic], int
 
 
 def lint_paths(paths: Sequence[str]) -> Tuple[List[Diagnostic], int]:
-    findings: List[Diagnostic] = []
-    suppressed = 0
-    files: List[str] = []
-    for p in paths:
-        if os.path.isdir(p):
-            for root, _dirs, names in os.walk(p):
-                if "__pycache__" in root:
-                    continue
-                files += [os.path.join(root, n) for n in names if n.endswith(".py")]
-        elif p.endswith(".py"):
-            files.append(p)
-    for f in sorted(files):
-        with open(f, encoding="utf-8") as fh:
-            fs, sup = lint_source(fh.read(), f)
-        findings += fs
-        suppressed += sup
-    return findings, suppressed
+    return lint_paths_with(lint_source, paths)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    argv = list(sys.argv[1:] if argv is None else argv)
-    if not argv or argv[0] in ("-h", "--help"):
-        print(__doc__)
-        return 0
-    findings, suppressed = lint_paths(argv)
-    if findings:
-        print(format_report(findings, clean=""))
-        print(f"({suppressed} suppressed)")
-        return 1
-    print(f"lint clean ({suppressed} suppressed)")
-    return 0
+    return cli_main(lint_source, __doc__, argv)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,318 @@
+"""Runtime lock-order / contention validator for the host-side control plane.
+
+The static linter (`analysis/concurrency.py`, GTL2xx) proves lock discipline
+for the acquisition orders it can SEE; this module validates the orders that
+actually happen. Armed by ``GALVATRON_LOCK_CHECK=1`` (same pattern as
+``GALVATRON_RECOMPILE_GUARD``): off, the factories return plain
+``threading`` primitives — zero overhead, zero behavior change. On, every
+lock is wrapped in an instrumented shim that
+
+- keeps a **thread-local held stack** and records every (outer → inner)
+  acquisition edge into a process-global order graph;
+- raises :class:`LockOrderError` the moment a reverse edge appears — with
+  BOTH stacks (where the forward edge was recorded, and where the inversion
+  is being attempted), so the report reads like the deadlock that would
+  eventually happen instead of a probabilistic hang;
+- counts **contention** (acquire had to wait) and accumulates **hold time**
+  per lock name, exported through :func:`lock_metrics` into ``/metrics`` as
+  ``galvatron_lock_hold_ms`` / ``galvatron_lock_contended_total``;
+- exposes :func:`held_snapshot` — {thread name: [lock names]} — which the
+  flight recorder folds into hang/crash dumps, so "which thread holds what"
+  is in the artifact instead of being reconstructed from a core.
+
+Use the factories, not the classes::
+
+    from galvatron_tpu.analysis.locks import make_lock, make_rlock, make_condition
+    self._lock = make_lock("scheduler.q")
+
+Lock NAMES are the unit of ordering: two instances created under the same
+name are the same node in the order graph (a fleet of per-replica locks
+named "replica.state" must be consistently ordered against "fleet.gate"
+regardless of which replica instance is involved). Per-instance cycles on a
+shared name are therefore reported conservatively — that is the point: a
+discipline that depends on WHICH instance you hold is already broken.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+LOCK_CHECK_ENV = "GALVATRON_LOCK_CHECK"
+
+
+def lock_check_armed() -> bool:
+    """True when ``GALVATRON_LOCK_CHECK`` is set to anything but ''/'0'."""
+    return os.environ.get(LOCK_CHECK_ENV, "0") not in ("", "0")
+
+
+class LockOrderError(RuntimeError):
+    """An acquisition edge that reverses a previously recorded edge.
+
+    Carries both ends of the would-be deadlock: ``forward_stack`` is where
+    (outer → inner) was first recorded, ``reverse_stack`` is the acquisition
+    being attempted now (inner held, outer wanted)."""
+
+    def __init__(self, msg: str, forward_stack: str = "", reverse_stack: str = ""):
+        super().__init__(msg)
+        self.forward_stack = forward_stack
+        self.reverse_stack = reverse_stack
+
+
+# --- process-global registries (armed mode only) -----------------------------
+
+_tls = threading.local()
+
+# (outer name, inner name) → stack text where the edge was first recorded.
+# Guarded by _registry_lock; this meta-lock nests inside user locks only
+# for bounded dict ops, so it cannot itself deadlock with instrumented locks.
+_order_edges: Dict[Tuple[str, str], str] = {}
+_registry_lock = threading.Lock()
+
+# name → [hold_ms_total, contended_total, acquisitions_total]
+_stats: Dict[str, List[float]] = {}
+
+
+def _held_stack() -> List["_InstrumentedBase"]:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = []
+        _tls.held = stack
+    return stack
+
+
+def _record_edges(inner: "_InstrumentedBase") -> None:
+    """Record (outer → inner) for every lock currently held; raise on a
+    previously seen reverse edge."""
+    here = "".join(traceback.format_stack(limit=16)[:-2])
+    for outer in _held_stack():
+        if outer.name == inner.name:
+            continue  # reentrant same-name nesting orders nothing
+        with _registry_lock:
+            fwd = _order_edges.get((inner.name, outer.name))
+            if fwd is not None:
+                raise LockOrderError(
+                    f"lock-order inversion: acquiring {inner.name!r} while "
+                    f"holding {outer.name!r}, but {outer.name!r} was "
+                    f"previously acquired while holding {inner.name!r}",
+                    forward_stack=fwd,
+                    reverse_stack=here,
+                )
+            _order_edges.setdefault((outer.name, inner.name), here)
+
+
+def _bump(name: str, hold_ms: float = 0.0, contended: int = 0,
+          acquired: int = 0) -> None:
+    with _registry_lock:
+        row = _stats.setdefault(name, [0.0, 0, 0])
+        row[0] += hold_ms
+        row[1] += contended
+        row[2] += acquired
+
+
+def reset_registry() -> None:
+    """Drop recorded edges and counters (tests: isolate one scenario's order
+    graph from the next)."""
+    with _registry_lock:
+        _order_edges.clear()
+        _stats.clear()
+
+
+def lock_metrics() -> Dict[str, Dict[str, float]]:
+    """{name: {hold_ms, contended_total, acquired_total}} — the /metrics
+    families. Empty when nothing has been acquired (or check is off)."""
+    with _registry_lock:
+        return {
+            name: {"hold_ms": row[0], "contended_total": row[1],
+                   "acquired_total": row[2]}
+            for name, row in sorted(_stats.items())
+        }
+
+
+def order_edges() -> Dict[Tuple[str, str], str]:
+    """Snapshot of the recorded acquisition-order graph (tests/debugging)."""
+    with _registry_lock:
+        return dict(_order_edges)
+
+
+def held_snapshot() -> Dict[str, List[str]]:
+    """{thread name: [lock names held, outermost first]} across all threads.
+
+    Snapshotted from each instrumented lock's owner bookkeeping — safe to
+    call from the watchdog thread while other threads are blocked."""
+    with _registry_lock:
+        out: Dict[str, List[str]] = {}
+        for (name, tname) in _live_holds:
+            out.setdefault(tname, []).append(name)
+        return out
+
+
+# (lock name, thread name) entries for currently-held locks, in acquisition
+# order per thread (list, not set: RLock re-entry appears once)
+_live_holds: List[Tuple[str, str]] = []
+
+
+class _InstrumentedBase:
+    """Shared acquire/release instrumentation over a wrapped primitive."""
+
+    def __init__(self, name: str, inner):
+        self.name = name
+        self._inner = inner
+        self._acquired_at = 0.0
+        self._depth = 0  # >0 only while held by some thread (RLock: nesting)
+
+    # -- context manager ----------------------------------------------------
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # -- core protocol -------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        reentry = self._depth > 0 and self in _held_stack()
+        if not reentry:
+            _record_edges(self)
+        # contention = the uncontended fast path failed and we had to wait
+        got = self._inner.acquire(False)
+        contended = 0
+        if not got:
+            contended = 1
+            if not blocking:
+                _bump(self.name, contended=1)
+                return False
+            if timeout is None or timeout < 0:
+                got = self._inner.acquire(True)
+            else:
+                got = self._inner.acquire(True, timeout)
+            if not got:
+                _bump(self.name, contended=1)
+                return False
+        self._depth += 1
+        if self._depth == 1:
+            self._acquired_at = time.monotonic()
+            with _registry_lock:
+                _live_holds.append((self.name, threading.current_thread().name))
+        _held_stack().append(self)
+        _bump(self.name, contended=contended, acquired=1)
+        return True
+
+    def release(self) -> None:
+        stack = _held_stack()
+        if self in stack:
+            # remove the innermost entry of THIS lock (out-of-order releases
+            # are legal threading; the stack is for edge recording only)
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] is self:
+                    del stack[i]
+                    break
+        self._depth -= 1
+        if self._depth == 0:
+            hold_ms = (time.monotonic() - self._acquired_at) * 1e3
+            _bump(self.name, hold_ms=hold_ms)
+            tname = threading.current_thread().name
+            with _registry_lock:
+                for i in range(len(_live_holds) - 1, -1, -1):
+                    if _live_holds[i] == (self.name, tname):
+                        del _live_holds[i]
+                        break
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+
+class InstrumentedLock(_InstrumentedBase):
+    def __init__(self, name: str):
+        super().__init__(name, threading.Lock())
+
+
+class InstrumentedRLock(_InstrumentedBase):
+    def __init__(self, name: str):
+        super().__init__(name, threading.RLock())
+
+    def locked(self) -> bool:  # RLock has no .locked() before 3.12
+        return self._depth > 0
+
+
+class InstrumentedCondition(_InstrumentedBase):
+    """Condition over an instrumented lock: wait/notify keep the held-stack
+    honest (wait releases the lock, so its entry leaves the stack for the
+    duration — a watchdog snapshot during a wait must not claim the lock is
+    held)."""
+
+    def __init__(self, name: str):
+        lock = threading.Lock()
+        super().__init__(name, lock)
+        self._cond = threading.Condition(lock)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        self._pause_hold()
+        try:
+            return self._cond.wait(timeout)  # gta: disable=GTL205 — pass-through wrapper; the predicate loop is the call site's contract
+        finally:
+            self._resume_hold()
+
+    def wait_for(self, predicate, timeout: Optional[float] = None) -> bool:
+        self._pause_hold()
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            self._resume_hold()
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def _pause_hold(self) -> None:
+        stack = _held_stack()
+        if self in stack:
+            stack.remove(self)
+        self._depth -= 1
+        hold_ms = (time.monotonic() - self._acquired_at) * 1e3
+        _bump(self.name, hold_ms=hold_ms)
+        tname = threading.current_thread().name
+        with _registry_lock:
+            for i in range(len(_live_holds) - 1, -1, -1):
+                if _live_holds[i] == (self.name, tname):
+                    del _live_holds[i]
+                    break
+
+    def _resume_hold(self) -> None:
+        self._depth += 1
+        self._acquired_at = time.monotonic()
+        _held_stack().append(self)
+        with _registry_lock:
+            _live_holds.append((self.name, threading.current_thread().name))
+
+
+# --- factories (the public API) ----------------------------------------------
+
+
+def make_lock(name: str):
+    """A named mutex: plain ``threading.Lock`` normally, instrumented under
+    ``GALVATRON_LOCK_CHECK=1``."""
+    if lock_check_armed():
+        return InstrumentedLock(name)
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    if lock_check_armed():
+        return InstrumentedRLock(name)
+    return threading.RLock()
+
+
+def make_condition(name: str):
+    if lock_check_armed():
+        return InstrumentedCondition(name)
+    return threading.Condition()

@@ -48,6 +48,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from galvatron_tpu.analysis.locks import make_lock
+
 #: child-side env vars set by the elastic supervisor under --peer_replicate
 ADDRS_ENV = "GALVATRON_PEER_STORE"
 RANK_ENV = "GALVATRON_PEER_RANK"
@@ -198,7 +200,7 @@ class _Handler(socketserver.BaseRequestHandler):
         elif op == "list":
             _send_frame(self.request, {"ok": True, "replicas": store.stats()})
         elif op == "ping":
-            _send_frame(self.request, {"ok": True, "replicas": len(store._replicas)})
+            _send_frame(self.request, {"ok": True, "replicas": store.replica_count()})
         else:
             _send_frame(self.request, {"ok": False, "error": f"bad op {op!r}"})
 
@@ -220,8 +222,8 @@ class PeerStoreServer:
         self._srv = _Server((host, port), _Handler)
         self._srv.peer_store = self  # type: ignore[attr-defined]
         self.host, self.port = self._srv.server_address[:2]
-        self._lock = threading.Lock()
-        self._replicas: Dict[int, Tuple[Dict[str, Any], bytes]] = {}
+        self._lock = make_lock("peer_store.replicas")
+        self._replicas: Dict[int, Tuple[Dict[str, Any], bytes]] = {}  # guarded-by: self._lock
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -258,6 +260,13 @@ class PeerStoreServer:
                 if best is None or int(rec[0].get("step", -1)) > int(best[0].get("step", -1)):
                     best = rec
             return best
+
+    def replica_count(self) -> int:
+        """Locked read for the ping handler — handler threads run
+        concurrently with pushes, and a bare ``len(self._replicas)`` there
+        raced dict growth in ``_put``."""
+        with self._lock:
+            return len(self._replicas)
 
     def stats(self) -> List[Dict[str, Any]]:
         with self._lock:

@@ -111,6 +111,21 @@ def dump_all_stacks() -> str:
             f"--- thread {names.get(tid, '?')} (ident {tid}) ---\n"
             + "".join(traceback.format_stack(frame))
         )
+    try:
+        # with GALVATRON_LOCK_CHECK=1 armed, say which thread holds which
+        # named lock — the stacks show WHERE threads are blocked, this shows
+        # WHY (the other half of every deadlock forensic)
+        from galvatron_tpu.analysis.locks import held_snapshot, lock_check_armed
+
+        if lock_check_armed():
+            held = held_snapshot()
+            if held:
+                parts.append("--- held locks ---\n" + "\n".join(
+                    f"{tname}: {', '.join(locks)}"
+                    for tname, locks in sorted(held.items())
+                ))
+    except Exception:
+        pass
     return "\n".join(parts)
 
 

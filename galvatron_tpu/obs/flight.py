@@ -56,6 +56,15 @@ def dump_flight(
             doc["hbm_bytes"] = hbm_gauges()
         except Exception:
             pass
+        try:
+            # under GALVATRON_LOCK_CHECK=1 the dump answers "which thread
+            # holds what" directly — the first question of any hang forensic
+            from galvatron_tpu.analysis.locks import held_snapshot, lock_check_armed
+
+            if lock_check_armed():
+                doc["held_locks"] = held_snapshot()
+        except Exception:
+            pass
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f)

@@ -25,7 +25,7 @@ import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -284,6 +284,21 @@ def server_metrics_text(service) -> str:
         out.add("serving_quant_greedy_agree_frac", qp.get("greedy_agree_frac"),
                 help_="fraction of parity-probe positions whose int8 "
                 "greedy token matches fp")
+        # runtime lock validator counters (analysis/locks.py) — present only
+        # when GALVATRON_LOCK_CHECK armed the instrumented primitives; lock
+        # name in a label so one family covers the whole control plane
+        for lname, row in sorted((s.get("lock_stats") or {}).items()):
+            labels = {"lock": lname}
+            out.add("lock_hold_ms", row.get("hold_ms"), labels=labels,
+                    mtype="counter",
+                    help_="cumulative milliseconds each named lock was held "
+                    "(GALVATRON_LOCK_CHECK=1 only)")
+            out.add("lock_contended_total", row.get("contended_total"),
+                    labels=labels, mtype="counter",
+                    help_="acquisitions that had to wait (uncontended "
+                    "fast path failed)")
+            out.add("lock_acquired_total", row.get("acquired_total"),
+                    labels=labels, mtype="counter")
     render_slo(out, getattr(service, "slo", None))
     c = service.cfg
     out.add("model_info", 1, labels={
@@ -401,6 +416,28 @@ def fleet_metrics_text(router) -> str:
                 help_="fleet-level distribution: per-replica bucket counts "
                 "summed (the reason histograms exist beside the quantile "
                 "gauges)")
+    # lock validator rollup: per-(replica, lock) rows plus a fleet-level sum
+    # per lock name — a lock hot on ONE replica (skewed traffic) and a lock
+    # hot on ALL of them (systemic contention) read differently
+    lock_rollup: Dict[str, List[float]] = {}
+    for r, s in replica_stats:
+        for lname, row in sorted((s.get("lock_stats") or {}).items()):
+            labels = {"replica": r.idx, "lock": lname}
+            out.add("fleet_lock_hold_ms", row.get("hold_ms"), labels=labels,
+                    mtype="counter",
+                    help_="cumulative lock hold milliseconds per replica "
+                    "(GALVATRON_LOCK_CHECK=1 replicas only)")
+            out.add("fleet_lock_contended_total", row.get("contended_total"),
+                    labels=labels, mtype="counter")
+            agg = lock_rollup.setdefault(lname, [0.0, 0.0])
+            agg[0] += float(row.get("hold_ms") or 0.0)
+            agg[1] += float(row.get("contended_total") or 0.0)
+    for lname, (hold, cont) in sorted(lock_rollup.items()):
+        out.add("fleet_lock_hold_ms_sum", hold, labels={"lock": lname},
+                mtype="counter",
+                help_="sum over currently-reachable replicas")
+        out.add("fleet_lock_contended_sum_total", cont,
+                labels={"lock": lname}, mtype="counter")
     render_slo(out, getattr(router, "slo", None))
     return out.render()
 

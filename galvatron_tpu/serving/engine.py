@@ -55,6 +55,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from galvatron_tpu.analysis.locks import lock_check_armed, lock_metrics, make_condition
 from galvatron_tpu.core import faults
 from galvatron_tpu.models import generation
 from galvatron_tpu.models.generation import KVCache
@@ -308,8 +309,8 @@ class Engine:
         # as growth, so arm it on single-engine runs only.
         self._guard_armed = os.environ.get("GALVATRON_RECOMPILE_GUARD", "") not in ("", "0")
         self._guard_baseline = None
-        self._cond = threading.Condition()
-        self._stop = False
+        self._cond = make_condition("engine.cond")
+        self._stop = False  # guarded-by: self._cond
         self._draining = False
         self._closed = False
         self._working = False  # loop thread inside one admit+step iteration
@@ -423,6 +424,11 @@ class Engine:
                 for slot, req in self._by_slot.items()
             }
         steps = ec["steps"]
+        if lock_check_armed():
+            # per-lock hold/contention counters from the runtime validator
+            # (analysis/locks.py); the fleet router rolls these into
+            # galvatron_lock_* /metrics families per replica
+            extra["lock_stats"] = lock_metrics()
         return {
             "kv_backend": "paged" if self.paged else "slot",
             # the replica's numerics contract rides /healthz: the fleet

@@ -67,6 +67,7 @@ import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Sequence, Set
 
+from galvatron_tpu.analysis.locks import make_lock
 from galvatron_tpu.core import faults
 from galvatron_tpu.core.restart_policy import RestartPolicy
 from galvatron_tpu.obs.tracing import tracer
@@ -154,33 +155,46 @@ class Replica:
         self.log_path = os.path.join(fleet_dir, f"replica-{idx}.log")
         self.env = env
         self.proc: Optional[subprocess.Popen] = None
-        self.state = DEAD  # spawn() advances DEAD → STARTING
+        self._state_lock = make_lock("replica.state")
+        self._state = DEAD  # guarded-by: self._state_lock — spawn() advances DEAD → STARTING
         self.reachable = False
         self.last_health: Dict[str, Any] = {}
-        self.outstanding = 0  # router-side in-flight dispatches
-        self._lock = threading.Lock()
+        self._lock = make_lock("replica.dispatch")
+        self._outstanding = 0  # guarded-by: self._lock — router-side in-flight dispatches
         self.policy = restart_policy or RestartPolicy()
-        self.restarts_total = 0
+        self._restarts_total = 0  # guarded-by: self._lock
         self.gave_up = False
         self.last_exit_code: Optional[int] = None
-        self._state_lock = threading.Lock()
-        self._spawn_lock = threading.Lock()
+        self._spawn_lock = make_lock("replica.spawn")
 
     # -- state machine ------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._state_lock:
+            return self._state
+
+    @state.setter
+    def state(self, value: str) -> None:
+        # raw assignment, no transition validation — the pre-property API
+        # (tests/harnesses force lifecycle states); real transitions go
+        # through advance()
+        with self._state_lock:
+            self._state = value
 
     def advance(self, state: str, **info) -> None:
         """Validated state transition. Same-state advances are no-ops: the
         monitor and a drain can both observe the same exit — DEAD twice is
         one fact seen from two threads, not a bookkeeping bug."""
         with self._state_lock:
-            if state == self.state:
+            if state == self._state:
                 return
-            if state not in REPLICA_TRANSITIONS.get(self.state, frozenset()):
+            if state not in REPLICA_TRANSITIONS.get(self._state, frozenset()):
                 raise IllegalReplicaTransition(
                     f"replica {self.idx}: illegal transition "
-                    f"{self.state} → {state}"
+                    f"{self._state} → {state}"
                 )
-            self.state = state
+            self._state = state
         tracer.instant(f"replica_{state.lower()}", idx=self.idx,
                        port=self.port, **info)
 
@@ -192,9 +206,9 @@ class Replica:
         :class:`IllegalReplicaTransition` on perfectly legal races (a
         replica dying between the check and the advance)."""
         with self._state_lock:
-            if self.state not in only_from:
+            if self._state not in only_from:
                 return False
-            self.state = state
+            self._state = state
         tracer.instant(f"replica_{state.lower()}", idx=self.idx,
                        port=self.port, **info)
         return True
@@ -265,11 +279,38 @@ class Replica:
 
     def begin_dispatch(self) -> None:
         with self._lock:
-            self.outstanding += 1
+            self._outstanding += 1
 
     def end_dispatch(self) -> None:
         with self._lock:
-            self.outstanding -= 1
+            self._outstanding -= 1
+
+    @property
+    def outstanding(self) -> int:
+        with self._lock:
+            return self._outstanding
+
+    @outstanding.setter
+    def outstanding(self, value: int) -> None:
+        # pre-property API (tests seed load levels); the dispatch path uses
+        # begin_dispatch/end_dispatch
+        with self._lock:
+            self._outstanding = value
+
+    @property
+    def restarts_total(self) -> int:
+        with self._lock:
+            return self._restarts_total
+
+    def note_restart(self) -> int:
+        """Count one respawn of this replica. Serialized under the dispatch
+        lock: the monitor's crash respawn and a rolling drain's deploy
+        respawn run on different threads, and the former bare ``+= 1`` on
+        both sides could lose an increment (read-modify-write race). Returns
+        the new total (callers log it)."""
+        with self._lock:
+            self._restarts_total += 1
+            return self._restarts_total
 
     @property
     def load(self) -> float:
@@ -317,8 +358,8 @@ class _FleetGate:
     def __init__(self, capacity: int):
         self.capacity = max(1, int(capacity))
         self._sem = threading.BoundedSemaphore(self.capacity)
-        self._lock = threading.Lock()
-        self.in_use = 0
+        self._lock = make_lock("fleet.gate")
+        self.in_use = 0  # guarded-by: self._lock
 
     def acquire(self) -> bool:
         ok = self._sem.acquire(blocking=False)
@@ -412,8 +453,8 @@ class FleetRouter:
         # its own engine and /healthz unions their degraded_reasons here)
         self.slo = None
         self.draining = False
-        self._drain_lock = threading.Lock()
-        self._rolling_lock = threading.Lock()
+        self._drain_lock = make_lock("fleet.drain")
+        self._rolling_lock = make_lock("fleet.rolling")
         self.drain_audit: Dict[str, Any] = {}
         self._drained = threading.Event()
         self._stop = False
@@ -523,10 +564,10 @@ class FleetRouter:
                 # proceeds from DEAD — a rolling drain's deploy respawn
                 # racing this crash respawn yields exactly one incarnation
                 if r.spawn():
-                    r.restarts_total += 1
+                    n_restarts = r.note_restart()
                     self.counters.inc("replica_restarts")
                     print(f"fleet: replica {r.idx} crashed (exit {rc}); "
-                          f"restart {r.restarts_total} after "
+                          f"restart {n_restarts} after "
                           f"{decision.backoff_s:.2f}s backoff", flush=True)
                 continue
             if rc is None and r.port is not None:
@@ -852,8 +893,15 @@ class FleetRouter:
         """Zero-downtime deploy: drain each replica in turn (the rest keep
         serving — router admission stays OPEN), audit its exit, respawn it,
         wait for READY, then move to the next. Serialized: two concurrent
-        rolls would drain the fleet from both ends."""
-        with self._rolling_lock:
+        rolls would drain the fleet from both ends — but a roll takes
+        minutes, so a second request must NOT park its handler thread on
+        the lock for that long (the GTL203 class: a roll blocks on
+        ``proc.wait`` and readiness sleeps while holding it). The losing
+        caller gets an immediate ``in_progress`` report instead."""
+        if not self._rolling_lock.acquire(blocking=False):
+            return {"rolling": False, "in_progress": True, "ok": False,
+                    "error": "a rolling drain is already running"}
+        try:
             audits = []
             for r in self.replicas:
                 if r.gave_up:
@@ -869,7 +917,7 @@ class FleetRouter:
                 if self._stop or self.draining:
                     break  # a fleet shutdown raced the roll: stop respawning
                 if r.spawn():
-                    r.restarts_total += 1
+                    r.note_restart()
                     self.counters.inc("replica_restarts")
                     r.policy.reset()  # a deploy is a fresh incarnation, not a crash
                 # else: the monitor's crash respawn won the race — either
@@ -888,6 +936,8 @@ class FleetRouter:
             print(f"fleet rolling drain: ok={out['ok']} "
                   f"audit={json.dumps(out)}", flush=True)
             return out
+        finally:
+            self._rolling_lock.release()
 
     def _wait_replica_ready(self, r: Replica) -> bool:
         deadline = time.monotonic() + self.startup_timeout_s

@@ -24,6 +24,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from galvatron_tpu.analysis.locks import make_lock
 from galvatron_tpu.models import generation
 from galvatron_tpu.models.modeling import ModelConfig
 
@@ -63,55 +64,66 @@ class SlotKVCache:
         # device arrays; reassigned by the engine after every jitted step
         self.cache = generation.init_kv_cache(cfg, self.num_slots, self.max_seq_len)
         # host bookkeeping: length = tokens materialized in the slot so far
-        # (prompt + generated); the next token lands at position == length
+        # (prompt + generated); the next token lands at position == length.
+        # The allocator lock covers the free list + active set: the engine
+        # loop allocates/frees while handler threads read the occupancy
+        # views through stats()/healthz
+        self._lock = make_lock("kv_slots")
         self.lengths = np.zeros((self.num_slots,), np.int32)
-        self._free: List[int] = list(range(self.num_slots - 1, -1, -1))
-        self._active: set = set()
+        self._free: List[int] = list(range(self.num_slots - 1, -1, -1))  # guarded-by: self._lock
+        self._active: set = set()  # guarded-by: self._lock
 
     # -- allocator ----------------------------------------------------------
 
     def alloc(self) -> Optional[int]:
         """Claim a free slot (length reset to 0); None when fully occupied."""
-        if not self._free:
-            return None
-        slot = self._free.pop()
-        self._active.add(slot)
-        self.lengths[slot] = 0
-        return slot
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop()
+            self._active.add(slot)
+            self.lengths[slot] = 0
+            return slot
 
     def free(self, slot: int) -> None:
-        if slot not in self._active:
-            raise ValueError(f"slot {slot} is not active")
-        self._active.discard(slot)
-        self.lengths[slot] = 0
-        self._free.append(slot)
+        with self._lock:
+            if slot not in self._active:
+                raise ValueError(f"slot {slot} is not active")
+            self._active.discard(slot)
+            self.lengths[slot] = 0
+            self._free.append(slot)
 
     def reset(self) -> None:
         """Release every slot and reallocate the device cache (engine
         failure recovery / drain). The engine's jitted steps DONATE the
         cache buffers — after a step that died mid-call the old arrays may
         already be invalidated, so a fresh cache is the only safe state."""
-        self._active.clear()
-        self.lengths[:] = 0
-        self._free = list(range(self.num_slots - 1, -1, -1))
-        self.cache = generation.init_kv_cache(self.cfg, self.num_slots, self.max_seq_len)
+        with self._lock:
+            self._active.clear()
+            self.lengths[:] = 0
+            self._free = list(range(self.num_slots - 1, -1, -1))
+            self.cache = generation.init_kv_cache(self.cfg, self.num_slots, self.max_seq_len)
 
     # -- views --------------------------------------------------------------
 
     @property
     def free_slots(self) -> int:
-        return len(self._free)
+        with self._lock:
+            return len(self._free)
 
     @property
     def active_count(self) -> int:
-        return len(self._active)
+        with self._lock:
+            return len(self._active)
 
     def active_slots(self) -> List[int]:
-        return sorted(self._active)
+        with self._lock:
+            return sorted(self._active)
 
     @property
     def occupancy(self) -> float:
-        return len(self._active) / self.num_slots
+        with self._lock:
+            return len(self._active) / self.num_slots
 
     def fits(self, prompt_len: int, max_new_tokens: int) -> bool:
         """Whole lifetime of the request stays inside the slot: the last
@@ -122,15 +134,16 @@ class SlotKVCache:
         """Allocator invariant check (the drain/chaos harness's zero-leak
         proof): the free list and the active set partition the slot range
         exactly — no double-frees, no leaks, no phantom slots."""
-        free_set = set(self._free)
-        ok = (
-            len(free_set) == len(self._free)          # no duplicate frees
-            and not (free_set & self._active)         # disjoint
-            and (free_set | self._active) == set(range(self.num_slots))
-        )
-        return {
-            "ok": ok,
-            "free": len(self._free),
-            "active": len(self._active),
-            "num_slots": self.num_slots,
-        }
+        with self._lock:
+            free_set = set(self._free)
+            ok = (
+                len(free_set) == len(self._free)          # no duplicate frees
+                and not (free_set & self._active)         # disjoint
+                and (free_set | self._active) == set(range(self.num_slots))
+            )
+            return {
+                "ok": ok,
+                "free": len(self._free),
+                "active": len(self._active),
+                "num_slots": self.num_slots,
+            }

@@ -47,6 +47,7 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import numpy as np
 
+from galvatron_tpu.analysis.locks import make_rlock
 from galvatron_tpu.models import generation
 from galvatron_tpu.models.modeling import ModelConfig
 
@@ -130,58 +131,67 @@ class PagedKVCache:
             )
         self.prefix_cache_enabled = bool(prefix_cache)
 
+        # allocator bookkeeping lock: the engine loop owns the device pool
+        # and the per-slot arrays (lengths/tables/pool), but allocator state
+        # is read from handler threads (stats/can_admit) while the loop
+        # mutates it — an RLock because public methods nest (fork → alloc,
+        # append → reserve → _append_block)
+        self._lock = make_rlock("paged_kv")
+
         # device pool: (L, num_blocks, block_size, kv_heads, head_dim) —
         # same layout as a slot cache with batch=num_blocks, len=block_size
         self.pool = generation.init_kv_cache(cfg, self.num_blocks, self.block_size)
 
         # slot bookkeeping (mirrors SlotKVCache exactly)
         self.lengths = np.zeros((self.num_slots,), np.int32)
-        self._free_slots: List[int] = list(range(self.num_slots - 1, -1, -1))
-        self._active: set = set()
+        self._free_slots: List[int] = list(range(self.num_slots - 1, -1, -1))  # guarded-by: self._lock
+        self._active: set = set()  # guarded-by: self._lock
 
         # block bookkeeping
         self.tables = np.zeros((self.num_slots, self.max_blocks), np.int32)
-        self._refcount = np.zeros((self.num_blocks,), np.int32)
-        self._free_blocks: List[int] = list(range(self.num_blocks - 1, 0, -1))
-        self._slot_blocks: Dict[int, List[int]] = {}
+        self._refcount = np.zeros((self.num_blocks,), np.int32)  # guarded-by: self._lock
+        self._free_blocks: List[int] = list(range(self.num_blocks - 1, 0, -1))  # guarded-by: self._lock
+        self._slot_blocks: Dict[int, List[int]] = {}  # guarded-by: self._lock
 
         # prefix cache: chunk hash -> block, block -> chunk hash, plus an
         # LRU over CACHED (refcount-0, registered) blocks only
-        self._registry: Dict[bytes, int] = {}
-        self._block_hash: Dict[int, bytes] = {}
-        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self._registry: Dict[bytes, int] = {}  # guarded-by: self._lock
+        self._block_hash: Dict[int, bytes] = {}  # guarded-by: self._lock
+        self._lru: "OrderedDict[int, None]" = OrderedDict()  # guarded-by: self._lock
 
         # cumulative counters (survive reset — they are lifetime totals)
-        self.prefix_hits = 0
-        self.prefix_misses = 0
-        self.prefix_evictions = 0
-        self.cow_copies = 0
+        self.prefix_hits = 0  # guarded-by: self._lock
+        self.prefix_misses = 0  # guarded-by: self._lock
+        self.prefix_evictions = 0  # guarded-by: self._lock
+        self.cow_copies = 0  # guarded-by: self._lock
 
     # -- slot allocator (SlotKVCache-compatible surface) ---------------------
 
     def alloc(self) -> Optional[int]:
         """Claim a free slot with an empty block table; None when occupied."""
-        if not self._free_slots:
-            return None
-        slot = self._free_slots.pop()
-        self._active.add(slot)
-        self.lengths[slot] = 0
-        self.tables[slot, :] = NULL_BLOCK
-        self._slot_blocks[slot] = []
-        return slot
+        with self._lock:
+            if not self._free_slots:
+                return None
+            slot = self._free_slots.pop()
+            self._active.add(slot)
+            self.lengths[slot] = 0
+            self.tables[slot, :] = NULL_BLOCK
+            self._slot_blocks[slot] = []
+            return slot
 
     def free(self, slot: int) -> None:
         """Release a slot and drop one reference from each of its blocks.
         Blocks reaching refcount 0 return to the free list, unless they are
         registered prefix blocks — those become CACHED (LRU-evictable)."""
-        if slot not in self._active:
-            raise ValueError(f"slot {slot} is not active")
-        for b in self._slot_blocks.pop(slot):
-            self._unref(b)
-        self._active.discard(slot)
-        self.lengths[slot] = 0
-        self.tables[slot, :] = NULL_BLOCK
-        self._free_slots.append(slot)
+        with self._lock:
+            if slot not in self._active:
+                raise ValueError(f"slot {slot} is not active")
+            for b in self._slot_blocks.pop(slot):
+                self._unref(b)
+            self._active.discard(slot)
+            self.lengths[slot] = 0
+            self.tables[slot, :] = NULL_BLOCK
+            self._free_slots.append(slot)
 
     def reset(self) -> None:
         """Release everything and reallocate the device pool (engine crash
@@ -189,34 +199,39 @@ class PagedKVCache:
         after a step that died mid-call a fresh pool is the only safe
         state; the prefix registry is cleared with it — its blocks' device
         contents are gone."""
-        self._active.clear()
-        self.lengths[:] = 0
-        self._free_slots = list(range(self.num_slots - 1, -1, -1))
-        self.tables[:] = NULL_BLOCK
-        self._refcount[:] = 0
-        self._free_blocks = list(range(self.num_blocks - 1, 0, -1))
-        self._slot_blocks = {}
-        self._registry.clear()
-        self._block_hash.clear()
-        self._lru.clear()
-        self.pool = generation.init_kv_cache(self.cfg, self.num_blocks, self.block_size)
+        with self._lock:
+            self._active.clear()
+            self.lengths[:] = 0
+            self._free_slots = list(range(self.num_slots - 1, -1, -1))
+            self.tables[:] = NULL_BLOCK
+            self._refcount[:] = 0
+            self._free_blocks = list(range(self.num_blocks - 1, 0, -1))
+            self._slot_blocks = {}
+            self._registry.clear()
+            self._block_hash.clear()
+            self._lru.clear()
+            self.pool = generation.init_kv_cache(self.cfg, self.num_blocks, self.block_size)
 
     # -- views ---------------------------------------------------------------
 
     @property
     def free_slots(self) -> int:
-        return len(self._free_slots)
+        with self._lock:
+            return len(self._free_slots)
 
     @property
     def active_count(self) -> int:
-        return len(self._active)
+        with self._lock:
+            return len(self._active)
 
     def active_slots(self) -> List[int]:
-        return sorted(self._active)
+        with self._lock:
+            return sorted(self._active)
 
     @property
     def occupancy(self) -> float:
-        return len(self._active) / self.num_slots
+        with self._lock:
+            return len(self._active) / self.num_slots
 
     @property
     def blocks_total(self) -> int:
@@ -225,18 +240,22 @@ class PagedKVCache:
 
     @property
     def blocks_free(self) -> int:
-        return len(self._free_blocks)
+        with self._lock:
+            return len(self._free_blocks)
 
     @property
     def blocks_cached(self) -> int:
-        return len(self._lru)
+        with self._lock:
+            return len(self._lru)
 
     @property
     def blocks_active(self) -> int:
-        return self.blocks_total - self.blocks_free - self.blocks_cached
+        with self._lock:
+            return self.blocks_total - len(self._free_blocks) - len(self._lru)
 
     def blocks_held(self, slot: int) -> int:
-        return len(self._slot_blocks.get(slot, ()))
+        with self._lock:
+            return len(self._slot_blocks.get(slot, ()))
 
     def fits(self, prompt_len: int, max_new_tokens: int) -> bool:
         """Same per-request capacity bound as the slot cache."""
@@ -244,7 +263,7 @@ class PagedKVCache:
 
     # -- block allocator core ------------------------------------------------
 
-    def _take_block(self) -> int:
+    def _take_block(self) -> int:  # holds: self._lock
         """Pop a free block, evicting the least-recently-used CACHED prefix
         block if the free list is dry. Raises NoFreeBlocks when neither
         source has a block — admission gating makes that unreachable in the
@@ -261,7 +280,7 @@ class PagedKVCache:
             f"block pool exhausted ({self.blocks_total} blocks, 0 free, 0 evictable)"
         )
 
-    def _unref(self, b: int) -> None:
+    def _unref(self, b: int) -> None:  # holds: self._lock
         if self._refcount[b] <= 0:
             raise ValueError(f"block {b} refcount underflow")
         self._refcount[b] -= 1
@@ -271,14 +290,14 @@ class PagedKVCache:
             else:
                 self._free_blocks.append(b)  # OWNED -> FREE
 
-    def _claim_cached(self, b: int) -> None:
+    def _claim_cached(self, b: int) -> None:  # holds: self._lock
         """CACHED -> OWNED: first re-attachment of a refcount-0 registered
         block pulls it out of the eviction queue."""
         if self._refcount[b] == 0:
             del self._lru[b]
         self._refcount[b] += 1
 
-    def _append_block(self, slot: int) -> None:
+    def _append_block(self, slot: int) -> None:  # holds: self._lock
         blocks = self._slot_blocks[slot]
         if len(blocks) >= self.max_blocks:
             raise ValueError(f"slot {slot} already holds max_blocks={self.max_blocks}")
@@ -293,8 +312,9 @@ class PagedKVCache:
         max_new_tokens) at admission so decode never allocates and can
         never fail on pool pressure mid-request."""
         need = -(-int(upto_len) // self.block_size)
-        while len(self._slot_blocks[slot]) < need:
-            self._append_block(slot)
+        with self._lock:
+            while len(self._slot_blocks[slot]) < need:
+                self._append_block(slot)
 
     def ensure_writable(self, slot: int, lo: int, hi: int) -> None:
         """Copy-on-write guard for a pending write to positions ``[lo, hi)``:
@@ -303,54 +323,57 @@ class PagedKVCache:
         never corrupt another request's context or a cached prefix."""
         if hi <= lo:
             return
-        blocks = self._slot_blocks[slot]
-        first = lo // self.block_size
-        last = min(-(-hi // self.block_size), len(blocks))
-        for i in range(first, last):
-            b = blocks[i]
-            if self._refcount[b] == 1 and b not in self._block_hash:
-                continue  # sole un-registered owner: write in place
-            nb = self._take_block()
-            self.pool = generation.KVCache(
-                *_copy_block(self.pool.k, self.pool.v, np.int32(b), np.int32(nb))
-            )
-            self._refcount[nb] = 1
-            self._unref(b)
-            blocks[i] = nb
-            self.tables[slot, i] = nb
-            self.cow_copies += 1
+        with self._lock:
+            blocks = self._slot_blocks[slot]
+            first = lo // self.block_size
+            last = min(-(-hi // self.block_size), len(blocks))
+            for i in range(first, last):
+                b = blocks[i]
+                if self._refcount[b] == 1 and b not in self._block_hash:
+                    continue  # sole un-registered owner: write in place
+                nb = self._take_block()
+                self.pool = generation.KVCache(
+                    *_copy_block(self.pool.k, self.pool.v, np.int32(b), np.int32(nb))
+                )
+                self._refcount[nb] = 1
+                self._unref(b)
+                blocks[i] = nb
+                self.tables[slot, i] = nb
+                self.cow_copies += 1
 
     def append(self, slot: int, n: int = 1) -> None:
         """Advance a slot by ``n`` positions, allocating and COW-protecting
         blocks as needed (allocator-level surface for tests/fuzzing; the
         engine reserves worst-case up front instead)."""
-        lo = int(self.lengths[slot])
-        hi = lo + int(n)
-        if hi > self.max_seq_len:
-            raise ValueError(f"slot {slot} overflow: {hi} > {self.max_seq_len}")
-        self.reserve(slot, hi)
-        self.ensure_writable(slot, lo, hi)
-        self.lengths[slot] = hi
+        with self._lock:
+            lo = int(self.lengths[slot])
+            hi = lo + int(n)
+            if hi > self.max_seq_len:
+                raise ValueError(f"slot {slot} overflow: {hi} > {self.max_seq_len}")
+            self.reserve(slot, hi)
+            self.ensure_writable(slot, lo, hi)
+            self.lengths[slot] = hi
 
     def fork(self, src: int) -> Optional[int]:
         """Clone a slot by reference: the new slot shares every block of
         ``src`` (refcount bump, zero copies); the first divergent write on
         either side triggers COW. None when no slot is free."""
-        if src not in self._active:
-            raise ValueError(f"slot {src} is not active")
-        slot = self.alloc()
-        if slot is None:
-            return None
-        for b in self._slot_blocks[src]:
-            self._refcount[b] += 1
-        self._slot_blocks[slot] = list(self._slot_blocks[src])
-        self.tables[slot, :] = self.tables[src, :]
-        self.lengths[slot] = self.lengths[src]
-        return slot
+        with self._lock:
+            if src not in self._active:
+                raise ValueError(f"slot {src} is not active")
+            slot = self.alloc()
+            if slot is None:
+                return None
+            for b in self._slot_blocks[src]:
+                self._refcount[b] += 1
+            self._slot_blocks[slot] = list(self._slot_blocks[src])
+            self.tables[slot, :] = self.tables[src, :]
+            self.lengths[slot] = self.lengths[src]
+            return slot
 
     # -- prefix cache --------------------------------------------------------
 
-    def _match_len(self, tokens: Sequence[int]) -> int:
+    def _match_len(self, tokens: Sequence[int]) -> int:  # holds: self._lock
         """Longest registered prefix of ``tokens`` in full blocks, capped so
         at least one prompt token is always re-prefilled (the engine needs
         the request's own last-position logits to sample the first token)."""
@@ -370,20 +393,21 @@ class PagedKVCache:
         multiple of block_size); the engine prefills from there."""
         if not self.prefix_cache_enabled:
             return 0
-        cap = (len(tokens) - 1) // self.block_size
-        matched = self._match_len(tokens)
-        blocks = self._slot_blocks[slot]
-        if blocks:
-            raise ValueError(f"slot {slot} already holds blocks; attach first")
-        hashes = prefix_hashes(tokens[: matched * self.block_size], self.block_size)
-        for i, h in enumerate(hashes):
-            b = self._registry[h]
-            self._claim_cached(b)
-            self.tables[slot, i] = b
-            blocks.append(b)
-        self.prefix_hits += matched
-        self.prefix_misses += cap - matched
-        return matched * self.block_size
+        with self._lock:
+            cap = (len(tokens) - 1) // self.block_size
+            matched = self._match_len(tokens)
+            blocks = self._slot_blocks[slot]
+            if blocks:
+                raise ValueError(f"slot {slot} already holds blocks; attach first")
+            hashes = prefix_hashes(tokens[: matched * self.block_size], self.block_size)
+            for i, h in enumerate(hashes):
+                b = self._registry[h]
+                self._claim_cached(b)
+                self.tables[slot, i] = b
+                blocks.append(b)
+            self.prefix_hits += matched
+            self.prefix_misses += cap - matched
+            return matched * self.block_size
 
     def register_prefix(self, slot: int, tokens: Sequence[int]) -> int:
         """Publish the slot's full prompt blocks into the prefix registry
@@ -398,19 +422,20 @@ class PagedKVCache:
         decode appends at ``len`` and beyond, which lands in later blocks."""
         if not self.prefix_cache_enabled:
             return 0
-        cap = len(tokens) // self.block_size
-        blocks = self._slot_blocks[slot]
-        added = 0
-        for i, h in enumerate(prefix_hashes(tokens[: cap * self.block_size], self.block_size)):
-            if h in self._registry:
-                continue
-            b = blocks[i]
-            if b in self._block_hash:
-                continue  # block already backs a different registered chunk
-            self._registry[h] = b
-            self._block_hash[b] = h
-            added += 1
-        return added
+        with self._lock:
+            cap = len(tokens) // self.block_size
+            blocks = self._slot_blocks[slot]
+            added = 0
+            for i, h in enumerate(prefix_hashes(tokens[: cap * self.block_size], self.block_size)):
+                if h in self._registry:
+                    continue
+                b = blocks[i]
+                if b in self._block_hash:
+                    continue  # block already backs a different registered chunk
+                self._registry[h] = b
+                self._block_hash[b] = h
+                added += 1
+            return added
 
     # -- admission gate ------------------------------------------------------
 
@@ -434,10 +459,11 @@ class PagedKVCache:
         prompt_len = len(tokens)
         if not self.fits(prompt_len, max_new_tokens):
             return False
-        matched = self._match_len(tokens)
-        need = -(-(prompt_len + max_new_tokens) // self.block_size) - matched
-        need += self.cow_overlap_blocks(matched * self.block_size, prompt_len, chunk)
-        return need <= len(self._free_blocks) + len(self._lru)
+        with self._lock:
+            matched = self._match_len(tokens)
+            need = -(-(prompt_len + max_new_tokens) // self.block_size) - matched
+            need += self.cow_overlap_blocks(matched * self.block_size, prompt_len, chunk)
+            return need <= len(self._free_blocks) + len(self._lru)
 
     # -- audit ---------------------------------------------------------------
 
@@ -446,56 +472,58 @@ class PagedKVCache:
         audit to blocks: every non-null block is FREE xor OWNED xor CACHED,
         refcounts equal the number of slot tables referencing each block,
         and registry/LRU bookkeeping is bijective."""
-        free_set = set(self._free_slots)
-        slots_ok = (
-            len(free_set) == len(self._free_slots)
-            and not (free_set & self._active)
-            and (free_set | self._active) == set(range(self.num_slots))
-        )
+        with self._lock:
+            free_set = set(self._free_slots)
+            slots_ok = (
+                len(free_set) == len(self._free_slots)
+                and not (free_set & self._active)
+                and (free_set | self._active) == set(range(self.num_slots))
+            )
 
-        free_blocks = set(self._free_blocks)
-        owned = {b for b in range(1, self.num_blocks) if self._refcount[b] > 0}
-        cached = set(self._lru)
-        refs = np.zeros((self.num_blocks,), np.int32)
-        for blocks in self._slot_blocks.values():
-            for b in blocks:
-                refs[b] += 1
-        blocks_ok = (
-            len(free_blocks) == len(self._free_blocks)  # no duplicate frees
-            and NULL_BLOCK not in free_blocks | owned | cached
-            and not (free_blocks & owned)
-            and not (free_blocks & cached)
-            and not (owned & cached)
-            and (free_blocks | owned | cached) == set(range(1, self.num_blocks))
-            and bool(np.all(self._refcount >= 0))
-            and bool(np.all(refs == self._refcount))
-            and set(self._registry.values()) == set(self._block_hash)
-            and all(self._registry[h] == b for b, h in self._block_hash.items())
-            and cached == {b for b in self._block_hash if self._refcount[b] == 0}
-            and set(self._slot_blocks) == self._active
-        )
-        return {
-            "ok": slots_ok and blocks_ok,
-            "free": len(self._free_slots),
-            "active": len(self._active),
-            "num_slots": self.num_slots,
-            "blocks_ok": blocks_ok,
-            "blocks_total": self.blocks_total,
-            "blocks_free": self.blocks_free,
-            "blocks_cached": self.blocks_cached,
-            "blocks_active": self.blocks_active,
-        }
+            free_blocks = set(self._free_blocks)
+            owned = {b for b in range(1, self.num_blocks) if self._refcount[b] > 0}
+            cached = set(self._lru)
+            refs = np.zeros((self.num_blocks,), np.int32)
+            for blocks in self._slot_blocks.values():
+                for b in blocks:
+                    refs[b] += 1
+            blocks_ok = (
+                len(free_blocks) == len(self._free_blocks)  # no duplicate frees
+                and NULL_BLOCK not in free_blocks | owned | cached
+                and not (free_blocks & owned)
+                and not (free_blocks & cached)
+                and not (owned & cached)
+                and (free_blocks | owned | cached) == set(range(1, self.num_blocks))
+                and bool(np.all(self._refcount >= 0))
+                and bool(np.all(refs == self._refcount))
+                and set(self._registry.values()) == set(self._block_hash)
+                and all(self._registry[h] == b for b, h in self._block_hash.items())
+                and cached == {b for b in self._block_hash if self._refcount[b] == 0}
+                and set(self._slot_blocks) == self._active
+            )
+            return {
+                "ok": slots_ok and blocks_ok,
+                "free": len(self._free_slots),
+                "active": len(self._active),
+                "num_slots": self.num_slots,
+                "blocks_ok": blocks_ok,
+                "blocks_total": self.blocks_total,
+                "blocks_free": len(self._free_blocks),
+                "blocks_cached": len(self._lru),
+                "blocks_active": self.blocks_total - len(self._free_blocks) - len(self._lru),
+            }
 
     def block_stats(self) -> dict:
-        return {
-            "kv_block_size": self.block_size,
-            "kv_blocks_total": self.blocks_total,
-            "kv_blocks_free": self.blocks_free,
-            "kv_blocks_cached": self.blocks_cached,
-            "kv_blocks_active": self.blocks_active,
-            "prefix_cache_enabled": self.prefix_cache_enabled,
-            "prefix_cache_hits": self.prefix_hits,
-            "prefix_cache_misses": self.prefix_misses,
-            "prefix_cache_evictions": self.prefix_evictions,
-            "cow_copies": self.cow_copies,
-        }
+        with self._lock:
+            return {
+                "kv_block_size": self.block_size,
+                "kv_blocks_total": self.blocks_total,
+                "kv_blocks_free": len(self._free_blocks),
+                "kv_blocks_cached": len(self._lru),
+                "kv_blocks_active": self.blocks_total - len(self._free_blocks) - len(self._lru),
+                "prefix_cache_enabled": self.prefix_cache_enabled,
+                "prefix_cache_hits": self.prefix_hits,
+                "prefix_cache_misses": self.prefix_misses,
+                "prefix_cache_evictions": self.prefix_evictions,
+                "cow_copies": self.cow_copies,
+            }

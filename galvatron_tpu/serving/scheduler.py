@@ -16,13 +16,13 @@ traffic needs.
 from __future__ import annotations
 
 import itertools
-import threading
 import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Deque, List, Optional
 
+from galvatron_tpu.analysis.locks import make_lock
 from galvatron_tpu.serving import resilience as rz
 from galvatron_tpu.utils.metrics import Counters
 
@@ -88,8 +88,8 @@ class Scheduler:
     def __init__(self, max_queue: int = 64, default_ttl_s: Optional[float] = 30.0):
         self.max_queue = max(1, int(max_queue))
         self.default_ttl_s = default_ttl_s
-        self._q: Deque[Request] = deque()
-        self._lock = threading.Lock()
+        self._lock = make_lock("scheduler.q")
+        self._q: Deque[Request] = deque()  # guarded-by: self._lock
         self.counters = self.new_counters()
 
     @staticmethod

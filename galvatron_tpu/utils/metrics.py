@@ -11,10 +11,11 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 import warnings
 from typing import Any, Dict, Optional
+
+from galvatron_tpu.analysis.locks import make_lock
 
 
 #: version stamped as a ``schema`` field on versioned JSONL records
@@ -154,8 +155,8 @@ class Counters:
     handler threads and the engine loop increment concurrently)."""
 
     def __init__(self, *names: str):
-        self._lock = threading.Lock()
-        self._c: Dict[str, int] = {n: 0 for n in names}
+        self._lock = make_lock("metrics.counters")
+        self._c: Dict[str, int] = {n: 0 for n in names}  # guarded-by: self._lock
 
     def inc(self, name: str, n: int = 1) -> int:
         with self._lock:
@@ -192,11 +193,11 @@ class Histogram:
         if not bs:
             raise ValueError("Histogram needs at least one bucket bound")
         self.buckets = tuple(bs)
-        self._counts = [0] * len(bs)  # per-bucket (non-cumulative) counts
-        self._overflow = 0            # observations above the last bound
-        self._sum = 0.0
-        self._count = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.histogram")
+        self._counts = [0] * len(bs)  # guarded-by: self._lock — per-bucket (non-cumulative) counts
+        self._overflow = 0            # guarded-by: self._lock — observations above the last bound
+        self._sum = 0.0               # guarded-by: self._lock
+        self._count = 0               # guarded-by: self._lock
 
     def observe(self, x: float) -> None:
         x = float(x)
@@ -282,10 +283,10 @@ class QuantileWindow:
 
     def __init__(self, size: int = 512):
         self.size = max(1, size)
-        self._buf: list = []
-        self._i = 0
-        self._n = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.quantile_window")
+        self._buf: list = []  # guarded-by: self._lock
+        self._i = 0           # guarded-by: self._lock
+        self._n = 0           # guarded-by: self._lock
 
     def add(self, x: float) -> None:
         with self._lock:
@@ -313,4 +314,6 @@ class QuantileWindow:
         return buf[idx]
 
     def summary(self) -> Dict[str, Any]:
-        return {"n": self._n, "p50": self.quantile(0.5), "p95": self.quantile(0.95)}
+        with self._lock:
+            n = self._n
+        return {"n": n, "p50": self.quantile(0.5), "p95": self.quantile(0.95)}

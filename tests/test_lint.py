@@ -230,7 +230,10 @@ def test_repo_lints_clean():
 def test_all_lint_codes_registered():
     gtl = [c for c in CODES if c.startswith("GTL")]
     assert set(gtl) == {
-        "GTL100", "GTL101", "GTL102", "GTL103", "GTL104", "GTL105", "GTL106"
+        # trace hygiene (lint.py)
+        "GTL100", "GTL101", "GTL102", "GTL103", "GTL104", "GTL105", "GTL106",
+        # lock discipline (concurrency.py)
+        "GTL200", "GTL201", "GTL202", "GTL203", "GTL204", "GTL205", "GTL206",
     }
 
 
