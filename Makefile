@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test test-all bench dryrun lint check-plan chaos serving-chaos fleet-chaos data-smoke warmup clean
+.PHONY: all native test test-all bench dryrun lint check-plan audit-comm chaos serving-chaos fleet-chaos data-smoke warmup clean
 
 all: native
 
@@ -21,15 +21,28 @@ test:
 test-all:
 	$(PY) -m pytest tests/ -q -m ""
 
-# static analysis (docs/DESIGN.md § Static analysis): trace-hygiene linter
-# + concurrency (lock-discipline) linter + plan checker over the checked-in
-# strategy configs — the CI gate
+# static analysis (docs/DESIGN.md § Static analysis) — four passes, one
+# suppression contract:
+#   GTA0xx plan checker      (`make check-plan`: plan × model × topology)
+#   GTL1xx trace hygiene     (this target: JAX footguns in host code)
+#   GTL2xx lock discipline   (this target: guarded-by / order / leaks)
+#   GTC0xx collective audit  (`make audit-comm`: lowered-HLO comm footprint)
 lint:
 	$(PY) -m galvatron_tpu.analysis.lint galvatron_tpu
 	$(PY) -m galvatron_tpu.analysis.concurrency galvatron_tpu
 
 check-plan:
 	$(PY) -m galvatron_tpu.cli check-plan configs/strategies/*.json --strict 1
+
+# HLO collective auditor (docs/DESIGN.md § Static analysis): AOT-lower every
+# registered program per exemplar plan (no compile, no execute) and gate
+# predicted_over_lowered per cost-model comm term; one invocation per plan —
+# the audit world is forced from each plan's own num_devices
+audit-comm:
+	for p in configs/strategies/*.json; do \
+	  env JAX_PLATFORMS=cpu $(PY) -m galvatron_tpu.cli audit-comm $$p \
+	    --strict 1 --report $$(basename $$p .json).footprint.jsonl || exit 1; \
+	done
 
 # one elastic chaos scenario (docs/DESIGN.md § Elastic training): an 8→4
 # simulated shrink under the supervisor must end in a committed checkpoint

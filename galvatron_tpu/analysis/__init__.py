@@ -25,11 +25,27 @@ Two pillars (neither compiles anything):
   per-lock hold/contention counters for /metrics, held-lock snapshots for
   the flight recorder and watchdog.
 
+- **Collective auditor** (``comm_audit``): AOT-lowers every registered
+  program for a plan (eval_shape inputs — no devices, no compile, no
+  execute) and walks the StableHLO text: which collectives, over which
+  mesh axes, moving how many wire-MB. Three products, all ``GTC…``
+  codes: a plan-vs-lowered fidelity gate (each ``comm_volume_breakdown``
+  term vs what XLA actually materialized), a resharding lint (stray
+  axes, silent replication, undeclared seams, dead tp_overlap), and the
+  comm-footprint JSONL that ``cli warmup --report`` writes beside the
+  memory report. ``python -m galvatron_tpu.cli audit-comm <plan.json>``.
+
 Plus ``recompile_guard`` (``guards``): a context manager generalizing the
 ``generate._cache_size()`` test pins so tests and the serving engine can
 assert bounded jit-cache growth.
 """
 
+from galvatron_tpu.analysis.comm_audit import (
+    CollectiveSite,
+    CommFootprint,
+    audit_plan,
+    extract_footprint,
+)
 from galvatron_tpu.analysis.diagnostics import Diagnostic, format_report
 from galvatron_tpu.analysis.guards import RecompileError, recompile_guard
 from galvatron_tpu.analysis.locks import (
@@ -44,11 +60,15 @@ from galvatron_tpu.analysis.locks import (
 from galvatron_tpu.analysis.plan_check import PlanError, check_plan
 
 __all__ = [
+    "CollectiveSite",
+    "CommFootprint",
     "Diagnostic",
     "LockOrderError",
     "PlanError",
     "RecompileError",
+    "audit_plan",
     "check_plan",
+    "extract_footprint",
     "format_report",
     "held_snapshot",
     "lock_check_armed",

@@ -164,13 +164,30 @@ def lint_paths_with(lint_source: LintFn, paths: Sequence[str]) -> Tuple[List[Dia
 
 def cli_main(lint_source: LintFn, doc: str,
              argv: Optional[Sequence[str]] = None) -> int:
-    """The shared ``python -m …`` entry: paths (files or trees) → exit 1 on
-    any unsuppressed finding, with the suppression count always printed so
-    a silently-suppressed tree is visible in the CI log."""
+    """The shared ``python -m …`` entry for every analysis pass.
+
+    Exit-code contract (identical for analysis.lint and
+    analysis.concurrency, pinned by tests/test_lint.py):
+
+    - 0 — clean, INCLUDING suppressed-only findings (a suppression is an
+      explicit reviewed decision; the count is always printed so a
+      silently-suppressed tree stays visible in the CI log);
+    - 1 — at least one unsuppressed finding;
+    - 2 — usage error: no paths given, or the given paths match no ``.py``
+      file (a typo'd path must not masquerade as a clean run).
+
+    ``-h``/``--help`` prints the pass's doc and exits 0."""
     argv = list(sys.argv[1:] if argv is None else argv)
-    if not argv or argv[0] in ("-h", "--help"):
+    if argv and argv[0] in ("-h", "--help"):
         print(doc)
         return 0
+    if not argv:
+        print(doc)
+        print("error: no paths given", file=sys.stderr)
+        return 2
+    if not walk_py_files(argv):
+        print(f"error: no .py files under {argv}", file=sys.stderr)
+        return 2
     findings, suppressed = lint_paths_with(lint_source, argv)
     if findings:
         print(format_report(findings, clean=""))

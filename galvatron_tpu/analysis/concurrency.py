@@ -1,7 +1,9 @@
 """Lock-discipline linter: AST rules for the threaded host control plane.
 
-``python -m galvatron_tpu.analysis.concurrency galvatron_tpu/`` — exit 1 on
-any unsuppressed finding. The serving engine, fleet router, paged-KV
+``python -m galvatron_tpu.analysis.concurrency galvatron_tpu/`` — exit 0
+when clean (suppressed-only findings are clean), 1 on any unsuppressed
+finding, 2 on a usage error (no paths, or paths matching no .py files).
+The serving engine, fleet router, paged-KV
 allocator, peer store and watchdogs are classic multithreaded Python; every
 bug class a chaos harness has caught here is encoded as a static rule, in
 the spirit of ``@GuardedBy``/Clang Thread Safety Analysis (guarded fields)

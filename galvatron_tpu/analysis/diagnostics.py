@@ -2,7 +2,8 @@
 
 Every failure class has a STABLE code — ``GTA0xx`` for plan diagnostics,
 ``GTL1xx`` for trace-hygiene lint rules, ``GTL2xx`` for lock-discipline
-lint rules — so CI can gate on specific codes, suppressions
+lint rules, ``GTC0xx`` for the lowered-HLO collective auditor — so CI can
+gate on specific codes, suppressions
 can name them, and the docs table (DESIGN.md "Static analysis") stays the
 single reference. Codes are append-only: a retired rule keeps its number.
 """
@@ -53,6 +54,15 @@ CODES = {
     "GTL204": ("thread leak: non-daemon thread without a reachable join, or started before __init__ completes", ERROR),
     "GTL205": ("Condition.wait outside a while-predicate loop (lost wakeup)", ERROR),
     "GTL206": ("check-then-act: guarded read and dependent write hold the lock separately", ERROR),
+    # --- HLO collective auditor (GTC0xx, analysis/comm_audit.py) ---
+    "GTC001": ("comm fidelity: predicted/lowered volume ratio outside the tolerance band", ERROR),
+    "GTC002": ("plan term predicts communication but the lowering grounds none", WARN),
+    "GTC003": ("lowered collective attributable to no plan term (unsolicited comm)", WARN),
+    "GTC004": ("program failed to lower during the comm audit", ERROR),
+    "GTC005": ("collective replica groups match no mesh-axis subgroup", WARN),
+    "GTC010": ("silent replication: plan-sharded tensor lowered fully replicated", WARN),
+    "GTC011": ("inter-layer resharding seam the plan never declared", WARN),
+    "GTC012": ("tp_overlap layer still lowers a monolithic (non-overlapped) collective", WARN),
 }
 
 

@@ -1,7 +1,9 @@
 """Trace-hygiene linter: AST rules for JAX footguns, run in CI.
 
-``python -m galvatron_tpu.analysis.lint galvatron_tpu/`` — exit 1 on any
-unsuppressed finding. Rules (codes in diagnostics.CODES):
+``python -m galvatron_tpu.analysis.lint galvatron_tpu/`` — exit 0 when clean
+(suppressed-only findings are clean), 1 on any unsuppressed finding, 2 on a
+usage error (no paths, or paths matching no .py files).
+Rules (codes in diagnostics.CODES):
 
   GTL101  host-device sync (``float()``/``int()``/``.item()``/``np.asarray``/
           ``.tolist()``/``jax.device_get``/``.block_until_ready()``) on a

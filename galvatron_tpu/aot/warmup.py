@@ -105,13 +105,20 @@ def compile_program(
     model_cfg: Any = None,
     serialize: bool = False,
     verbose: bool = True,
+    footprint_sink: Any = None,
 ) -> Dict[str, Any]:
     """AOT-lower + compile ONE program, failure-isolated.
 
-    Returns ``{program, key, status: compiled|failed, cache_hit,
-    compile_ms, memory, error}``.  ``cache_hit`` is manifest-based: the key
-    was recorded by an earlier warmup/run, so the persistent cache serves
-    the executable and ``compile_ms`` is deserialization, not XLA."""
+    Returns ``{program, key, status: compiled|failed, cache_hit, lower_ms,
+    compile_ms, memory, error}``.  ``lower_ms`` (tracing + StableHLO
+    emission) is split from ``compile_ms`` (XLA) so these rows are directly
+    comparable with the lower-only comm auditor's numbers.  ``cache_hit`` is
+    manifest-based: the key was recorded by an earlier warmup/run, so the
+    persistent cache serves the executable and ``compile_ms`` is
+    deserialization, not XLA.  ``footprint_sink``, when given, is called
+    with each program's lowered StableHLO text as
+    ``footprint_sink(spec, text)`` — the warmup comm-footprint hook
+    (sink failures are isolated like everything else here)."""
     from galvatron_tpu.obs.tracing import tracer
 
     key = None
@@ -137,6 +144,7 @@ def compile_program(
         "key": key,
         "cache_hit": hit,
         "status": "compiled",
+        "lower_ms": None,
         "compile_ms": None,
         "memory": None,
         "error": None,
@@ -144,17 +152,29 @@ def compile_program(
     t0 = time.perf_counter()
     try:
         with tracer.span("aot_compile", program=spec.name, hit=hit):
-            compiled = spec.fn.lower(*spec.args, **spec.kwargs).compile()
+            lowered = spec.fn.lower(*spec.args, **spec.kwargs)
+            report["lower_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
+            if footprint_sink is not None:
+                try:
+                    footprint_sink(spec, lowered.as_text())
+                except Exception as e:  # noqa: BLE001 — the footprint is
+                    # advisory: losing it must never cost the warmup
+                    if verbose:
+                        print(f"aot: WARNING — footprint sink failed for "
+                              f"{spec.name}: {type(e).__name__}: {e}")
+            t1 = time.perf_counter()
+            compiled = lowered.compile()
+            report["compile_ms"] = round((time.perf_counter() - t1) * 1000.0, 1)
     except Exception as e:  # noqa: BLE001 — per-program isolation IS the contract
         # e.g. this container's protobuf pipeline-compile crash: warn, move on
         report["status"] = "failed"
         report["error"] = f"{type(e).__name__}: {str(e)[:300]}"
-        report["compile_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
+        if report["compile_ms"] is None:
+            report["compile_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
         if verbose:
             print(f"aot: WARNING — {spec.name} failed to compile "
                   f"({report['error']}); continuing the sweep")
         return report
-    report["compile_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
     report["memory"] = memory_stats(compiled)
     if store is not None and key is not None:
         try:
@@ -178,6 +198,7 @@ def compile_program(
         mem_s = f", peak {mem['total_mb']:.0f} MB" if mem else ""
         print(
             f"aot: {spec.name}: {'hit' if hit else 'miss'}, "
+            f"lower {report['lower_ms']:.0f} ms, "
             f"compile {report['compile_ms']:.0f} ms{mem_s}"
         )
     return report
@@ -191,6 +212,7 @@ def warmup_programs(
     model_cfg: Any = None,
     serialize: bool = False,
     verbose: bool = True,
+    footprint_sink: Any = None,
 ) -> List[Dict[str, Any]]:
     """Compile every spec (failure-isolated); one report per program."""
     from galvatron_tpu.obs.tracing import tracer
@@ -200,6 +222,7 @@ def warmup_programs(
             compile_program(
                 s, store, plan=plan, model_cfg=model_cfg,
                 serialize=serialize, verbose=verbose,
+                footprint_sink=footprint_sink,
             )
             for s in specs
         ]
@@ -222,6 +245,7 @@ def warmup_plan(
     adam: Any = None,
     serialize: bool = False,
     verbose: bool = True,
+    footprint_sink: Any = None,
 ) -> List[Dict[str, Any]]:
     """Warm every registered program of one (plan × model × live mesh):
     enumerate from the registry, compile each, attach the GTA015 analytic
@@ -242,11 +266,12 @@ def warmup_plan(
                   f"{type(e).__name__}: {str(e)[:300]}")
         return [{
             "program": "<enumerate>", "key": None, "cache_hit": False,
-            "status": "failed", "compile_ms": None, "memory": None,
-            "error": f"{type(e).__name__}: {str(e)[:300]}",
+            "status": "failed", "lower_ms": None, "compile_ms": None,
+            "memory": None, "error": f"{type(e).__name__}: {str(e)[:300]}",
         }]
     reports = warmup_programs(
-        specs, store, plan=hp, model_cfg=cfg, serialize=serialize, verbose=verbose
+        specs, store, plan=hp, model_cfg=cfg, serialize=serialize,
+        verbose=verbose, footprint_sink=footprint_sink,
     )
     pred = (
         predicted_train_memory_mb(cfg, hp, jax.device_count(), global_bsz)

@@ -34,8 +34,17 @@ models/<name>/{train_dist,search_dist,profiler}.py + profile_hardware):
                     compile-artifact cache (galvatron_tpu/aot): a later
                     trainer start / elastic restart / serving cold-start on
                     the same plan pays a cache lookup instead of XLA
-                    compiles; per-program compile_ms + memory_analysis
-                    peak-buffer stats land in a JSONL report
+                    compiles; per-program lower_ms/compile_ms +
+                    memory_analysis peak-buffer stats land in a JSONL
+                    report, with the comm footprint beside it
+  audit-comm        static HLO collective audit (analysis/comm_audit.py):
+                    AOT-lower (never compile/execute) every program of the
+                    given plan JSON(s) on a forced CPU world, extract the
+                    collective footprint from the StableHLO text, gate the
+                    cost model's per-term comm volumes against it
+                    (predicted_over_lowered, GTC001) and lint for
+                    partitioner-inserted resharding the plan never asked
+                    for (GTC003/010/011/012); CI runs it over configs/
   trace-export      convert a crash flight-recorder dump (flight_<ts>.json)
                     or raw span records into Chrome trace-event JSON loadable
                     in Perfetto / chrome://tracing (obs/tracing.py);
@@ -347,6 +356,10 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
         ns = initialize_galvatron("warmup", rest, model_default)
         return _warmup_mode(ns)
 
+    if mode == "audit-comm":
+        ns = initialize_galvatron("audit_comm", rest, model_default)
+        return _audit_comm_mode(ns)
+
     if mode == "trace-export":
         ns = initialize_galvatron("trace_export", rest, model_default)
         return _trace_export_mode(ns)
@@ -503,7 +516,8 @@ def main(argv: Optional[List[str]] = None, model_default: Optional[str] = None) 
     print(
         f"unknown mode {mode!r}; expected "
         "train|run-elastic|peer-store|search|profile|profile-hardware|"
-        "check-plan|warmup|trace-export|generate|serve|serve-fleet|export-hf"
+        "check-plan|warmup|audit-comm|trace-export|generate|serve|serve-fleet|"
+        "export-hf"
     )
     return 2
 
@@ -595,6 +609,16 @@ def _warmup_mode(ns) -> int:
     world = jax.device_count()
     paths = list(ns.config_paths or []) + list(ns.galvatron_config_path or [])
     all_reports = []
+    # when a report is requested, ride the lowering we are doing anyway:
+    # extract each program's collective footprint from the StableHLO text
+    # (zero extra lower/compile work) and write it beside the report
+    footprints = []
+    sink = None
+    if ns.report:
+        from galvatron_tpu.analysis import comm_audit
+
+        def sink(spec, text):  # noqa: E306
+            footprints.append(comm_audit.extract_footprint(text, program=spec.name))
     if not paths:
         # plan-free warmup: serving/generate families from the model flags
         from galvatron_tpu.aot import registry as aot_registry
@@ -621,6 +645,7 @@ def _warmup_mode(ns) -> int:
         specs = aot_registry.enumerate_programs(ctx, include=include)
         all_reports += aot_warmup.warmup_programs(
             specs, store, model_cfg=cfg, serialize=bool(ns.serialize),
+            footprint_sink=sink,
         )
     for path in paths:
         print(f"== {path}")
@@ -667,6 +692,7 @@ def _warmup_mode(ns) -> int:
             spec_decode_k=getattr(ns, "spec_decode_k", 0),
             adam=adam_config_from_args(ns),
             serialize=bool(ns.serialize),
+            footprint_sink=sink,
         )
     summary = aot_warmup.summarize(all_reports)
     manifest_note = (
@@ -681,6 +707,12 @@ def _warmup_mode(ns) -> int:
     if ns.report:
         aot_warmup.write_report(ns.report, all_reports)
         print(f"report → {ns.report}")
+        if footprints:
+            from galvatron_tpu.analysis import comm_audit
+
+            fp_path = ns.report + ".footprint.jsonl"
+            comm_audit.write_footprint_jsonl(fp_path, footprints)
+            print(f"comm footprint → {fp_path}")
     return 0 if summary["compiled"] > 0 else 1
 
 
@@ -719,6 +751,97 @@ def _warmup_model_config(ns, d: dict, path: str):
     if getattr(ns, "pack_sequences", 0):
         cfg = cfg.replace(pack_sequences=True)
     return resolve_execution_config(cfg, ns)
+
+
+def _audit_comm_mode(ns) -> int:
+    """Static HLO collective audit of strategy JSON(s) — lower-only.
+
+    Forces a CPU world of the first plan's ``num_devices`` before the first
+    backend touch (no hardware, no compile, no execute), then per plan:
+    AOT-lower every program, extract the collective footprint, run the
+    fidelity gate and the resharding lint.  rc 0 = every audited plan
+    clean, 1 = GTC errors (or any GTC finding under --strict), 2 = usage
+    error (no configs, unreadable JSON, unresolvable model)."""
+    from galvatron_tpu.aot import warmup as aot_warmup
+
+    paths = list(ns.config_paths or []) + list(ns.galvatron_config_path or [])
+    if not paths:
+        print("audit-comm: no strategy JSONs given")
+        return 2
+    plans = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                plans.append((path, json.load(f)))
+        except (OSError, ValueError) as e:
+            print(f"audit-comm: cannot read {path}: {e}")
+            return 2
+    # the audit world comes from the plans themselves: force the CPU
+    # platform before the first backend touch (lower-only — any host works)
+    world = int(plans[0][1].get("num_devices") or 0) or 8
+    aot_warmup.force_cpu_world(world)
+    import jax
+
+    from galvatron_tpu.analysis import comm_audit, plan_check
+    from galvatron_tpu.analysis.diagnostics import errors, format_report
+    from galvatron_tpu.core.strategy import HybridParallelConfig
+
+    include = [s.strip() for s in (ns.include or "").split(",") if s.strip()] or None
+    world = jax.device_count()
+    all_footprints = []
+    rc = 0
+    audited = 0
+    for path, d in plans:
+        print(f"== {path}")
+        plan_world = int(d.get("num_devices") or 0) or world
+        if plan_world != world:
+            # one process = one forced world; same skip rule as warmup so a
+            # sweep over mixed-world configs audits what it can (the final
+            # audited/total line keeps the gap visible)
+            print(
+                f"audit-comm: {path} targets {plan_world} devices but this "
+                f"audit world is {world}; skipping (audit it in its own "
+                f"invocation)"
+            )
+            continue
+        cfg = _warmup_model_config(ns, d, path)
+        if cfg is None:
+            rc = max(rc, 2)
+            continue
+        bsz = ns.global_train_batch_size or int(d.get("global_bsz") or 8)
+        diags = plan_check.check_plan(
+            d, source=path, model_config=cfg, world_size=world, global_bsz=bsz,
+        )
+        if errors(diags):
+            print(format_report(diags))
+            print(f"audit-comm: {path} fails static validation")
+            rc = max(rc, 1)
+            continue
+        try:
+            hp = HybridParallelConfig.from_json_dict(d)
+        except (ValueError, KeyError) as e:
+            print(f"audit-comm: {path} does not decode: {e}")
+            rc = max(rc, 2)
+            continue
+        res = comm_audit.audit_plan(
+            cfg, hp, world=world, global_bsz=bsz, include=include,
+            tolerance=ns.tolerance, source=path, verbose=True,
+        )
+        audited += 1
+        print(comm_audit.format_fidelity_table(res.rows))
+        if res.diagnostics:
+            print(format_report(res.diagnostics, clean=""))
+        all_footprints += res.footprints
+        if errors(res.diagnostics) or (ns.strict and res.diagnostics):
+            rc = max(rc, 1)
+    if ns.report and all_footprints:
+        comm_audit.write_footprint_jsonl(ns.report, all_footprints)
+        print(f"comm footprint → {ns.report}")
+    if not audited and rc == 0:
+        print("audit-comm: no plan audited")
+        return 2
+    print(f"audit-comm: {audited}/{len(plans)} plan(s) audited, rc {rc}")
+    return rc
 
 
 def _trace_export_mode(ns) -> int:

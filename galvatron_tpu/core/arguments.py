@@ -642,6 +642,30 @@ def _add_warmup_args(p: argparse.ArgumentParser):
                    "the serve flag or the warm artifacts miss")
 
 
+def _add_audit_comm_args(p: argparse.ArgumentParser):
+    """HLO collective audit (analysis/comm_audit.py): lower-only, no compile."""
+    g = p.add_argument_group("audit-comm")
+    g.add_argument("config_paths", nargs="*",
+                   help="strategy JSON files to audit (self-describing "
+                   "search-emitted configs resolve their own model/bsz/world)")
+    g.add_argument("--galvatron_config_path", type=str, action="append",
+                   default=None, help="additional strategy JSON (repeatable)")
+    g.add_argument("--global_train_batch_size", type=int, default=0,
+                   help="0 = each plan's own global_bsz provenance key")
+    g.add_argument("--tolerance", type=float, default=3.0,
+                   help="fidelity band: predicted/lowered outside "
+                   "[1/t, t] is a GTC001")
+    g.add_argument("--include", type=str, default="",
+                   help="comma list of families/programs to lower "
+                   "(default: trainer)")
+    g.add_argument("--report", type=str, default=None,
+                   help="write the per-program comm-footprint JSONL to this "
+                   "path (the artifact CI uploads)")
+    g.add_argument("--strict", type=int, default=0,
+                   help="1 = warnings (GTC002/003/005/010/011/012) also "
+                   "fail the audit")
+
+
 def _add_trace_export_args(p: argparse.ArgumentParser):
     """Span/flight dump → Chrome trace-event JSON (obs/tracing.py)."""
     g = p.add_argument_group("trace-export")
@@ -698,6 +722,11 @@ def build_parser(mode: str, model_default: Optional[str] = None) -> argparse.Arg
         # every step-program flag is a program_key term: the warmup surface
         # must be able to express the exact run it is warming for
         _add_step_program_args(p)
+        # same self-describing-plan default as check-plan
+        if not model_default:
+            p.set_defaults(model_size=None)
+    elif mode == "audit_comm":
+        _add_audit_comm_args(p)
         # same self-describing-plan default as check-plan
         if not model_default:
             p.set_defaults(model_size=None)

@@ -224,16 +224,28 @@ class ProfiledHardware:
 _HBM_GBPS = 800.0
 
 
-def _allreduce_ms(msg_mb: float, size: int, bw_gbps: float) -> float:
+def _allreduce_wire_mb(msg_mb: float, size: int) -> float:
+    """On-wire MB per participant for a ring all-reduce of a ``msg_mb``
+    message over ``size`` devices (reduce-scatter + all-gather halves)."""
     if size <= 1 or msg_mb == 0:
         return 0.0
-    return 2.0 * (size - 1) / size * msg_mb / bw_gbps  # MB / (GB/s) = ms
+    return 2.0 * (size - 1) / size * msg_mb
+
+
+def _allgather_wire_mb(msg_mb: float, size: int) -> float:
+    """On-wire MB per participant for an all-gather whose FULL (gathered)
+    message is ``msg_mb`` — each device receives the other size-1 shards."""
+    if size <= 1 or msg_mb == 0:
+        return 0.0
+    return (size - 1) / size * msg_mb
+
+
+def _allreduce_ms(msg_mb: float, size: int, bw_gbps: float) -> float:
+    return _allreduce_wire_mb(msg_mb, size) / bw_gbps  # MB / (GB/s) = ms
 
 
 def _allgather_ms(msg_mb: float, size: int, bw_gbps: float) -> float:
-    if size <= 1 or msg_mb == 0:
-        return 0.0
-    return (size - 1) / size * msg_mb / bw_gbps
+    return _allgather_wire_mb(msg_mb, size) / bw_gbps
 
 
 # ---------------------------------------------------------------------------
@@ -533,9 +545,9 @@ def other_time_cost(
     dp_bw = hw.bw(dp, dp_consec)
     # grad allreduce (ddp) / reduce-scatter+gathers (zero3 ≈ allreduce + 2
     # param all-gathers), same shape as the layer cost model
-    comm = _allreduce_ms(p_mb * comm_bytes * 2.0, dp, dp_bw)
+    comm = _allreduce_ms(p_mb * comm_bytes * GRAD_REDUCE_FP32_FACTOR, dp, dp_bw)
     if embed_dp_type == "zero3":
-        comm += 2.0 * _allgather_ms(p_mb * comm_bytes, dp, dp_bw)
+        comm += ZERO3_GATHER_PASSES * _allgather_ms(p_mb * comm_bytes, dp, dp_bw)
     fit = costs.vocab_measurement_for(vocab_tp, mixed_precision) if use_measured else None
     if fit is not None:
         slope, const = fit
@@ -594,6 +606,15 @@ REMAT_SELECTIVE_FACTOR = 3.25
 # collective hidden on TPU ICI for transformer projection shapes; priced
 # conservatively until a measured profile replaces it.
 TP_OVERLAP_RESIDUAL = 0.4
+# Comm-volume conventions the analytic terms below price — named (instead of
+# inline literals) because analysis/comm_audit.py replays them as
+# ``comm_volume_breakdown`` and gates predicted-vs-lowered fidelity on the
+# ratio: a re-tuned constant here moves the predicted side ONLY, so the GTC001
+# gate catches a mispricing instead of a step-time regression doing it later.
+TP_BOUNDARY_COLLECTIVES = 4.0  # Megatron f/g: 2 fwd + 2 bwd boundary allreduces
+REMAT_TP_REPLAY = 1.5  # full-remat forward replay repeats the 2 fwd collectives
+ZERO3_GATHER_PASSES = 2.0  # fwd + bwd param all-gathers per iteration
+GRAD_REDUCE_FP32_FACTOR = 2.0  # grads reduce at fp32 = 2x the bf16 wire bytes
 
 
 def layer_time_cost(
@@ -649,9 +670,9 @@ def layer_time_cost(
     # with SP the all-gather+reduce-scatter pair moves the same volume)
     act_msg = lt.boundary_activation_mb_per_sample * local_bsz * comm_bytes_factor
     tp_bw = hw.bw(s.tp, s.tp_consec)
-    tp_ms = 4.0 * _allreduce_ms(act_msg, s.tp, tp_bw)
+    tp_ms = TP_BOUNDARY_COLLECTIVES * _allreduce_ms(act_msg, s.tp, tp_bw)
     if s.ckpt == "full" or recompute_factor is not None:
-        tp_ms *= 1.5  # forward-replay schedules replay the fwd collectives
+        tp_ms *= REMAT_TP_REPLAY  # forward-replay schedules replay the fwd collectives
     if s.tp_overlap and s.tp > 1:
         # decomposed collective-matmul pipelines the projection collectives
         # behind the GEMM chunks — only the residual exposure is priced
@@ -684,11 +705,11 @@ def layer_time_cost(
     dp_exp = max(1, dp // max(1, s.ep))
     dp_consec = not s.tp_consec if s.tp > 1 else True
     dp_bw = hw.bw(dp, dp_consec)
-    dp_ms = _allreduce_ms(dense_mb * comm_bytes_factor * 2.0, dp, dp_bw)
-    dp_ms += _allreduce_ms(exp_mb * comm_bytes_factor * 2.0, dp_exp, dp_bw)
+    dp_ms = _allreduce_ms(dense_mb * comm_bytes_factor * GRAD_REDUCE_FP32_FACTOR, dp, dp_bw)
+    dp_ms += _allreduce_ms(exp_mb * comm_bytes_factor * GRAD_REDUCE_FP32_FACTOR, dp_exp, dp_bw)
     if s.dp_type == "zero3":
-        dp_ms += 2.0 * _allgather_ms(dense_mb * comm_bytes_factor, dp, dp_bw)
-        dp_ms += 2.0 * _allgather_ms(exp_mb * comm_bytes_factor, dp_exp, dp_bw)
+        dp_ms += ZERO3_GATHER_PASSES * _allgather_ms(dense_mb * comm_bytes_factor, dp, dp_bw)
+        dp_ms += ZERO3_GATHER_PASSES * _allgather_ms(exp_mb * comm_bytes_factor, dp_exp, dp_bw)
 
     # overlap model: DP traffic overlaps compute at a slowdown coefficient
     # (reference bct_dp_overlap, cost_model.py:230-246)
@@ -735,3 +756,98 @@ def pipeline_time_cost(
     if pipeline_type == "pipedream_flush":
         extra = (pp - 1) if vpp == 1 else vpp * pp
     return sum(per_tick) + bottleneck * (vpp * chunks - 1 + extra)
+
+
+# ---------------------------------------------------------------------------
+# Comm-volume replay (the predicted side of the GTC fidelity gate)
+# ---------------------------------------------------------------------------
+
+
+def comm_volume_breakdown(
+    costs: ProfiledModelCosts,
+    hp,
+    world: int,
+    global_bsz: int,
+    mixed_precision: str = "bf16",
+) -> Dict[str, float]:
+    """Per-term analytic comm VOLUME (on-wire MB per device per iteration,
+    every term — ``pp_p2p`` sums all of an iteration's boundary crossings)
+    for one plan — the exact message sizes and multiplicities
+    ``layer_time_cost`` / ``other_time_cost`` / ``pipeline_time_cost``
+    price, with the bandwidth divided back out.
+
+    This is the *predicted* side of ``analysis/comm_audit.py``'s
+    ``predicted_over_lowered`` gate: the audited (lowered) side re-derives
+    the same volumes from the program's actual abstract shapes and lowered
+    collectives with its own first-principles constants, so a drift in any
+    constant above (TP_BOUNDARY_COLLECTIVES, ZERO3_GATHER_PASSES, …) or in a
+    message-size formula here moves only this side and trips GTC001.
+
+    Terms absent from the plan (degree 1) are omitted.  Multi-layer-type
+    models (vision towers, MoE stacks) price every layer with its own
+    strategy but layer type 0's sizes — the fidelity gate tolerance absorbs
+    the approximation, and the audit report marks the basis.
+    """
+    f = 0.5 if mixed_precision in ("bf16", "fp16") else 1.0
+    lt = costs.layer_types[min(costs.layer_types)] if costs.layer_types else None
+    out: Dict[str, float] = {}
+
+    def add(term: str, mb: float) -> None:
+        if mb > 0.0:
+            out[term] = out.get(term, 0.0) + mb
+
+    pp = hp.pp
+    for s in hp.layer_strategies:
+        if lt is None:
+            break
+        dp = max(1, world // (pp * s.tp * max(1, s.cp)))
+        local_bsz = global_bsz / dp / max(1, s.cp)
+        act_msg = lt.boundary_activation_mb_per_sample * local_bsz * f
+        if s.tp > 1:
+            tp_mb = TP_BOUNDARY_COLLECTIVES * _allreduce_wire_mb(act_msg, s.tp)
+            if s.ckpt == "full":
+                tp_mb *= REMAT_TP_REPLAY
+            add("tp_boundary", tp_mb)
+        if s.cp > 1:
+            add("cp_ring", 2.0 * _allgather_wire_mb(act_msg / s.cp * 2.0, s.cp) * s.cp)
+        frac = lt.moe_expert_param_fraction
+        ep = max(1, s.ep)
+        if s.ep > 1 and lt.moe_a2a_mb_per_sample > 0:
+            a2a_msg = lt.moe_a2a_mb_per_sample * local_bsz * f
+            add("ep_a2a", 2.0 * _allgather_wire_mb(a2a_msg, s.ep))
+        dense_mb = lt.parameter_mb * (1.0 - frac) / s.tp
+        exp_mb = lt.parameter_mb * frac / (s.tp * ep)
+        dp_exp = max(1, dp // ep)
+        add("dp_grad", _allreduce_wire_mb(dense_mb * f * GRAD_REDUCE_FP32_FACTOR, dp))
+        add("dp_grad", _allreduce_wire_mb(exp_mb * f * GRAD_REDUCE_FP32_FACTOR, dp_exp))
+        if s.dp_type == "zero3":
+            add("zero3_gather", ZERO3_GATHER_PASSES * _allgather_wire_mb(dense_mb * f, dp))
+            add("zero3_gather", ZERO3_GATHER_PASSES * _allgather_wire_mb(exp_mb * f, dp_exp))
+
+    # embedding / head / loss under the vocab strategy (other_time_cost's
+    # analytic comm block, volumes only)
+    vocab_tp = max(1, hp.vocab_tp)
+    dp_o = max(1, world // (pp * vocab_tp))
+    p_mb = costs.other_param_mb / vocab_tp
+    add("embed_dp", _allreduce_wire_mb(p_mb * f * GRAD_REDUCE_FP32_FACTOR, dp_o))
+    if hp.embed_dp_type == "zero3":
+        add("embed_dp", ZERO3_GATHER_PASSES * _allgather_wire_mb(p_mb * f, dp_o))
+    if vocab_tp > 1 and lt is not None:
+        act_msg_v = lt.boundary_activation_mb_per_sample * (global_bsz / dp_o) * f
+        add("vocab_embed", 2.0 * _allreduce_wire_mb(act_msg_v, vocab_tp))
+        h = costs.hidden_size or 4096
+        add("vocab_embed", _allreduce_wire_mb(
+            lt.boundary_activation_mb_per_sample * (global_bsz / dp_o) * (8.0 / h),
+            vocab_tp,
+        ))
+
+    if pp > 1 and lt is not None:
+        # per-iteration per-device boundary p2p: every micro-batch crosses
+        # each boundary fwd (activation out) and bwd (grad in), so chunks ×
+        # the per-tick message pipeline_time_cost prices = the full local
+        # batch, twice
+        s0 = hp.layer_strategies[0]
+        dp0 = max(1, world // (pp * s0.tp * max(1, s0.cp)))
+        add("pp_p2p",
+            2.0 * lt.boundary_activation_mb_per_sample * (global_bsz / dp0) * f)
+    return out

@@ -221,6 +221,34 @@ def test_negative_table(case):
         )
 
 
+def test_gta016_unsharded_dim_coexists_with_valid_sibling_specs():
+    """An annotated-but-unsharded dim (mlp/w2's 102 is not divisible by
+    tp=4) next to siblings whose specs ARE valid (attn, w1, norms: 64 and
+    128 divide 4): exactly the offending leaf warns, the valid siblings
+    stay silent — and the same silent-replication condition is what the
+    GTC resharding lint (GTC010) flags on the LOWERED program when the
+    annotations never reach the jit at all."""
+    from galvatron_tpu.analysis import comm_audit
+
+    cfg = ModelConfig(num_layers=2, num_heads=4, hidden_size=64,
+                      vocab_size=1024, max_seq_len=64, ffn_dim=102)
+    hp = HybridParallelConfig.uniform(2, tp=4)
+    diags = check_plan(hp, model_config=cfg, world_size=8)
+    assert codes(diags) == ["GTA016"], format_report(diags)
+    assert all("mlp/w2" in d.field for d in diags), [d.field for d in diags]
+    assert all(d.severity == "warn" for d in diags)
+    # the abstract pass is per-annotation; the lowered-reality twin: if the
+    # jit's entry shardings come out fully replicated despite the plan's
+    # tp=4, GTC010 fires on the same fixture
+    rep = comm_audit.parse_sharding_attr("{replicated}")
+    fp = comm_audit.CommFootprint(program="train_step", shardings=[
+        comm_audit.ShardingSite(site="arg", shape=(102, 64), dtype="f32",
+                                tensor_mb=0.026, sharding=rep, count=6),
+    ])
+    gtc = comm_audit.resharding_lint(hp, [fp])
+    assert [d.code for d in gtc] == ["GTC010"]
+
+
 def test_clean_plan_zero_diagnostics_under_one_second():
     cfg = PRESETS["llama-0.3b"]
     hp = HybridParallelConfig.uniform(
@@ -542,3 +570,10 @@ def test_diagnostic_codes_documented():
         text = f.read()
     missing = [c for c in CODES if c not in text]
     assert not missing, f"codes missing from DESIGN.md: {missing}"
+    # the "which linter catches what" matrix must name every pass and
+    # every code family it routes to
+    matrix = text.split("Which linter catches what", 1)
+    assert len(matrix) == 2, "DESIGN.md lost the four-linter matrix"
+    for needle in ("GTA0xx", "GTL1xx", "GTL2xx", "GTC0xx",
+                   "plan_check", "lint", "concurrency", "comm_audit"):
+        assert needle in matrix[1], f"matrix row missing: {needle}"

@@ -295,6 +295,35 @@ def test_warmup_isolates_per_program_failure(tmp_path):
     assert reports[1]["status"] == "compiled"  # the sweep continued
 
 
+def test_warmup_report_splits_lower_ms_from_compile_ms(tmp_path):
+    """The auditor is lower-only, warmup is lower+compile: the report must
+    carry the two phases separately so their numbers are comparable — and
+    the footprint sink sees the lowered StableHLO text of every program,
+    with a sink failure degrading to a warning, never killing the sweep."""
+    store = aot_cache.ArtifactStore(str(tmp_path))
+    spec = aot_registry.enumerate_programs(
+        aot_registry.ProgramContext(cfg=tiny_cfg()), include=("serving_decode",)
+    )[0]
+    texts = []
+    [r] = aot_warmup.warmup_programs(
+        [spec], store, model_cfg=tiny_cfg(), verbose=False,
+        footprint_sink=lambda s, t: texts.append((s.name, t)),
+    )
+    assert r["status"] == "compiled"
+    assert r["lower_ms"] is not None and r["lower_ms"] >= 0.0
+    assert r["compile_ms"] is not None and r["compile_ms"] >= 0.0
+    assert [n for n, _ in texts] == ["serving_decode"]
+    assert "func.func" in texts[0][1]  # lowered StableHLO, not a repr
+
+    def boom(s, t):
+        raise RuntimeError("sink exploded")
+
+    [r2] = aot_warmup.warmup_programs(
+        [spec], store, model_cfg=tiny_cfg(), verbose=False, footprint_sink=boom,
+    )
+    assert r2["status"] == "compiled"
+
+
 def test_manifest_write_failure_does_not_abort_sweep(tmp_path, monkeypatch):
     """The manifest is advisory: a store write failure (disk full, read-only
     mount) after an expensive compile degrades to a warning, never kills the

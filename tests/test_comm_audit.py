@@ -1,0 +1,383 @@
+"""HLO collective auditor (analysis/comm_audit): StableHLO parsing pinned on
+canned module text, replica-group → mesh-axis attribution, the plan-vs-lowered
+fidelity gate, the resharding lint, and the `cli audit-comm` surface.
+
+The gate's acceptance claim is pinned here end-to-end: a deliberately
+mis-priced cost-model constant moves ONLY the predicted side and trips
+GTC001 — the exact CI failure an unnoticed pricing drift would produce.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from galvatron_tpu.analysis import comm_audit as ca
+from galvatron_tpu.core.strategy import HybridParallelConfig
+from galvatron_tpu.models.modeling import ModelConfig
+
+TINY = dict(
+    num_layers=2, num_heads=4, hidden_size=64, vocab_size=256,
+    max_seq_len=32, ffn_dim=128,
+)
+
+
+def tiny_cfg(**kw):
+    return ModelConfig(**{**TINY, **kw})
+
+
+# ---------------------------------------------------------------------------
+# parser units: canned StableHLO text, no jax
+# ---------------------------------------------------------------------------
+
+
+def test_parse_tensor_type():
+    shape, dtype, mb = ca.parse_tensor_type("tensor<8x16xbf16>")
+    # the 'x' separators must not leak into the dtype (a lazy regex parsed
+    # this as shape (8,) dtype 'x16xbf16' once)
+    assert (shape, dtype) == ((8, 16), "bf16")
+    assert mb == pytest.approx(8 * 16 * 2 / 1e6)
+    assert ca.parse_tensor_type("tensor<f32>") == ((), "f32", 4.0 / 1e6)
+    assert ca.parse_tensor_type("tensor<4x!quant.uniform>") is None
+    assert ca.parse_tensor_type("no tensors here") is None
+
+
+def test_parse_groups_list_and_splat():
+    line = "replica_groups = dense<[[0, 1], [2, 3]]> : tensor<2x2xi64>"
+    assert ca.parse_groups(line) == ((0, 1), (2, 3))
+    # splat form: one value broadcast over the dense shape
+    assert ca.parse_groups(
+        "source_target_pairs = dense<0> : tensor<1x2xi64>"
+    ) == ((0, 0),)
+    assert ca.parse_groups("nothing") is None
+
+
+def test_parse_sharding_attr():
+    assert ca.parse_sharding_attr("{replicated}").replicated
+    s = ca.parse_sharding_attr("{devices=[4,2,1]<=[8]}")
+    assert s.tile == (4, 2, 1) and s.sharded and not s.replicated
+    # a trailing last_tile_dim_replicate entry is a replication factor, not
+    # a tensor-dim shard
+    s = ca.parse_sharding_attr(
+        "{devices=[1,2,4]<=[4,2]T(1,0) last_tile_dim_replicate}"
+    )
+    assert s.tile == (1, 2) and s.sharded
+    assert ca.parse_sharding_attr("{devices=[1,1,8]<=[8] last_tile_dim_replicate}").replicated
+
+
+def test_wire_mb_conventions():
+    def site(kind, g, mb=1.0, count=1):
+        return ca.CollectiveSite(kind=kind, shape=(1,), dtype="f32",
+                                 tensor_mb=mb, groups=(), group_size=g,
+                                 count=count)
+
+    assert site("all_reduce", 4).wire_mb == pytest.approx(2 * 3 / 4)
+    # all_gather's operand is the SHARD: each device receives g-1 shards
+    assert site("all_gather", 4).wire_mb == pytest.approx(3.0)
+    assert site("reduce_scatter", 4).wire_mb == pytest.approx(3 / 4)
+    assert site("all_to_all", 4).wire_mb == pytest.approx(3 / 4)
+    assert site("collective_permute", 2).wire_mb == pytest.approx(1.0)
+    assert site("all_reduce", 4, count=3).wire_mb == pytest.approx(3 * 2 * 3 / 4)
+
+
+_EXPLICIT = """\
+module @jit_step attributes {mhlo.num_partitions = 8 : i32} {
+  func.func public @main(%arg0: tensor<128x32xf32> {mhlo.sharding = "{replicated}"}, %arg1: tensor<8x17xi32> {mhlo.sharding = "{devices=[8,1]<=[8]}"}) -> tensor<f32> {
+    %0 = "stablehlo.collective_permute"(%arg0) <{channel_handle = #stablehlo.channel_handle<handle = 1, type = 1>, source_target_pairs = dense<[[0, 1], [1, 0]]> : tensor<2x2xi64>}> : (tensor<128x32xf32>) -> tensor<128x32xf32>
+    %1 = "stablehlo.all_reduce"(%0) <{replica_groups = dense<[[0, 1, 2, 3], [4, 5, 6, 7]]> : tensor<2x4xi64>, use_global_device_ids}> ({
+    ^bb0(%arg2: tensor<f32>, %arg3: tensor<f32>):
+      %8 = stablehlo.add %arg2, %arg3 : tensor<f32>
+      stablehlo.return %8 : tensor<f32>
+    }) : (tensor<4x8xbf16>) -> tensor<4x8xbf16>
+    %2 = stablehlo.custom_call @Sharding(%1) {backend_config = "", mhlo.sharding = "{devices=[1,8,1]<=[8]}"} : (tensor<4x16x32xbf16>) -> tensor<4x16x32xbf16>
+    return %9 : tensor<f32>
+  }
+}
+"""
+
+
+def test_extract_explicit_collectives_and_shardings():
+    fp = ca.extract_footprint(_EXPLICIT, program="p")
+    assert fp.module_lines == len(_EXPLICIT.splitlines())
+    by_kind = {c.kind: c for c in fp.collectives}
+    assert set(by_kind) == {"collective_permute", "all_reduce"}
+    assert by_kind["collective_permute"].shape == (128, 32)
+    assert by_kind["collective_permute"].groups == ((0, 1), (1, 0))
+    ar = by_kind["all_reduce"]
+    # the region-form all_reduce prints its operand type lines below the op
+    # — AND its attr carries `dense<...> : tensor<2x4xi64>`, which must not
+    # be mistaken for the operand
+    assert (ar.shape, ar.dtype, ar.group_size) == ((4, 8), "bf16", 4)
+    assert ar.groups == ((0, 1, 2, 3), (4, 5, 6, 7))
+    assert not ar.in_loop
+    sites = {s.site for s in fp.shardings}
+    assert sites == {"constraint", "arg"}
+    cons = [s for s in fp.shardings if s.site == "constraint"]
+    assert cons[0].shape == (4, 16, 32) and cons[0].sharding.tile == (1, 8, 1)
+    args = {s.shape: s for s in fp.shardings if s.site == "arg"}
+    assert args[(128, 32)].sharding.replicated
+    assert args[(8, 17)].sharding.tile == (8, 1)
+
+
+_LOOPED = """\
+module @jit_loop {
+  func.func public @main(%arg0: tensor<2x4xf32>) -> tensor<2x4xf32> {
+    %0 = "stablehlo.collective_permute"(%arg0) <{source_target_pairs = dense<[[0, 1]]> : tensor<1x2xi64>}> : (tensor<2x4xf32>) -> tensor<2x4xf32>
+    %1:2 = stablehlo.while(%iterArg = %c, %iterArg_0 = %0) : tensor<i32>, tensor<2x4xf32>
+     cond {
+      %2 = stablehlo.compare LT, %iterArg, %c8 : (tensor<i32>, tensor<i32>) -> tensor<i1>
+      stablehlo.return %2 : tensor<i1>
+    } do {
+      %3 = "stablehlo.collective_permute"(%iterArg_0) <{source_target_pairs = dense<[[0, 1]]> : tensor<1x2xi64>}> : (tensor<2x4xf32>) -> tensor<2x4xf32>
+      stablehlo.return %4, %3 : tensor<i32>, tensor<2x4xf32>
+    }
+    %5 = "stablehlo.collective_permute"(%1#1) <{source_target_pairs = dense<[[1, 0]]> : tensor<1x2xi64>}> : (tensor<2x4xf32>) -> tensor<2x4xf32>
+    return %5 : tensor<2x4xf32>
+  }
+}
+"""
+
+
+def test_while_loop_flags_in_loop_and_closes():
+    fp = ca.extract_footprint(_LOOPED, program="p")
+    # 3 static sites: before (not in loop), inside (in loop), after (the
+    # loop region must CLOSE — a leaked loop_stack would flag it too)
+    flags = sorted((c.groups, c.in_loop) for c in fp.collectives)
+    assert flags == [
+        (((0, 1),), False), (((0, 1),), True), (((1, 0),), False),
+    ]
+
+
+def test_identical_sites_collapse_via_count():
+    line = ('    %9 = "stablehlo.all_gather"(%8) <{all_gather_dim = 0 : i64, '
+            "replica_groups = dense<[[0, 1, 2, 3, 4, 5, 6, 7]]> : tensor<1x8xi64>}> "
+            ": (tensor<16x4xf32>) -> tensor<128x4xf32>\n")
+    fp = ca.extract_footprint("module {\n" + line * 5 + "}\n", program="p")
+    [c] = fp.collectives
+    assert c.kind == "all_gather" and c.count == 5 and c.group_size == 8
+    # wire convention: the operand is the shard, each device receives g-1
+    assert c.wire_mb == pytest.approx(5 * 7 * (16 * 4 * 4 / 1e6))
+
+
+# ---------------------------------------------------------------------------
+# replica-group → mesh-axis attribution
+# ---------------------------------------------------------------------------
+
+
+def _grid_2x4():
+    import numpy as np
+
+    return np.arange(8).reshape(2, 4)
+
+
+def test_mesh_axis_groups_partitions():
+    table = dict(ca.mesh_axis_groups(_grid_2x4(), ("pp", "dp")))
+    assert table[("pp",)] == frozenset(
+        frozenset(g) for g in [(0, 4), (1, 5), (2, 6), (3, 7)]
+    )
+    assert table[("dp",)] == frozenset(
+        frozenset(g) for g in [(0, 1, 2, 3), (4, 5, 6, 7)]
+    )
+    assert table[("pp", "dp")] == frozenset({frozenset(range(8))})
+
+
+def _site(kind, groups, gsize):
+    return ca.CollectiveSite(kind=kind, shape=(4,), dtype="f32",
+                             tensor_mb=1.0, groups=groups, group_size=gsize)
+
+
+def test_attribute_collectives_exact_and_permute():
+    fp = ca.CommFootprint(program="p", collectives=[
+        _site("all_reduce", ((0, 1, 2, 3), (4, 5, 6, 7)), 4),
+        # permute pairs that stay inside the pp subgroups → smallest subset
+        _site("collective_permute", ((0, 4), (4, 0)), 2),
+    ])
+    diags = ca.attribute_collectives(fp, _grid_2x4(), ("pp", "dp"))
+    assert diags == []
+    assert [c.axes for c in fp.collectives] == [("dp",), ("pp",)]
+
+
+def test_unattributable_groups_emit_gtc005():
+    fp = ca.CommFootprint(program="p", collectives=[
+        # groups that match no axis partition of the 2x4 grid
+        _site("all_reduce", ((0, 3), (1, 2), (4, 7), (5, 6)), 2),
+    ])
+    diags = ca.attribute_collectives(fp, _grid_2x4(), ("pp", "dp"))
+    assert [d.code for d in diags] == ["GTC005"]
+    assert fp.collectives[0].axes == ()
+
+
+# ---------------------------------------------------------------------------
+# the fidelity gate, end-to-end on the 8-device CPU mesh (lower-only)
+# ---------------------------------------------------------------------------
+
+
+def test_audit_plan_gspmd_all_terms_in_band():
+    hp = HybridParallelConfig.uniform(
+        2, tp=2, dp_type="zero3", vocab_tp=2, mixed_precision="bf16"
+    )
+    res = ca.audit_plan(tiny_cfg(), hp, world=8, global_bsz=8)
+    assert [fp.error for fp in res.footprints] == [None] * len(res.footprints)
+    assert {r.term for r in res.rows} >= {"dp_grad", "tp_boundary", "zero3_gather"}
+    bad = [r.term for r in res.rows if not r.within]
+    assert not bad, ca.format_fidelity_table(res.rows)
+    assert res.diagnostics == [], [d.code for d in res.diagnostics]
+
+
+def test_audit_plan_pipeline_grounds_pp_permutes():
+    hp = HybridParallelConfig.uniform(4, pp=2, tp=2, chunks=2,
+                                      mixed_precision="bf16")
+    res = ca.audit_plan(tiny_cfg(num_layers=4), hp, world=8, global_bsz=8)
+    train = next(fp for fp in res.footprints if fp.program == "train_step")
+    assert train.error is None
+    # the shard_map pipeline lowers EXPLICIT pp-axis collectives
+    assert any("pp" in c.axes for c in train.collectives)
+    assert all(r.within for r in res.rows), ca.format_fidelity_table(res.rows)
+    assert res.diagnostics == [], [d.code for d in res.diagnostics]
+
+
+def test_mispriced_cost_model_constant_trips_gtc001(monkeypatch):
+    """The acceptance claim: drift a cost-model pricing constant and ONLY
+    the predicted side moves — the gate flags that term as GTC001."""
+    from galvatron_tpu.search import cost_model
+
+    hp = HybridParallelConfig.uniform(2, dp_type="zero3",
+                                      mixed_precision="bf16")
+    monkeypatch.setattr(cost_model, "ZERO3_GATHER_PASSES", 40.0)
+    res = ca.audit_plan(tiny_cfg(), hp, world=8, global_bsz=8)
+    [row] = [r for r in res.rows if r.term == "zero3_gather"]
+    assert not row.within and row.ratio > 3.0
+    assert "GTC001" in [d.code for d in res.diagnostics]
+    [d] = [d for d in res.diagnostics if d.code == "GTC001"]
+    assert d.field == "zero3_gather" and d.hint
+
+
+def test_failed_lowering_degrades_to_gtc004_and_suppresses_gtc002():
+    hp = HybridParallelConfig.uniform(2, dp_type="zero3",
+                                      mixed_precision="bf16")
+    fps = [ca.CommFootprint(program="train_step", error="Boom: no lowering")]
+    rows, diags = ca.fidelity_report(tiny_cfg(), hp, 8, 8, fps)
+    codes = [d.code for d in diags]
+    assert codes.count("GTC004") == 1
+    # the failure already explains every ungrounded term
+    assert "GTC002" not in codes
+
+
+# ---------------------------------------------------------------------------
+# resharding lint
+# ---------------------------------------------------------------------------
+
+
+def _fp_with(shardings=(), collectives=()):
+    return ca.CommFootprint(program="train_step",
+                            shardings=list(shardings),
+                            collectives=list(collectives))
+
+
+def test_gtc010_silent_replication_of_plan_sharded_params():
+    """GTA016 generalized to lowered reality (same fixture shape as
+    test_analysis's annotated-but-unsharded case): the plan shards params,
+    but every lowered entry argument came out fully replicated."""
+    hp = HybridParallelConfig.uniform(2, tp=4)
+    rep = ca.parse_sharding_attr("{replicated}")
+    fp = _fp_with(shardings=[
+        ca.ShardingSite(site="arg", shape=(102, 64), dtype="f32",
+                        tensor_mb=0.026, sharding=rep, count=4),
+    ])
+    diags = ca.resharding_lint(hp, [fp])
+    assert [d.code for d in diags] == ["GTC010"]
+    # one sharded arg → the annotations DID reach the jit → clean
+    ok = ca.parse_sharding_attr("{devices=[4,1]<=[8] last_tile_dim_replicate}")
+    fp2 = _fp_with(shardings=[
+        ca.ShardingSite(site="arg", shape=(102, 64), dtype="f32",
+                        tensor_mb=0.026, sharding=rep, count=3),
+        ca.ShardingSite(site="arg", shape=(64, 64), dtype="f32",
+                        tensor_mb=0.016, sharding=ok),
+    ])
+    assert ca.resharding_lint(hp, [fp2]) == []
+
+
+def test_gtc003_stray_axis_collective():
+    hp = HybridParallelConfig.uniform(2, tp=2)  # roles: tp=(x2,), dp, pp
+    stray = ca.CollectiveSite(kind="all_to_all", shape=(8,), dtype="f32",
+                              tensor_mb=1.0, groups=((0, 2), (1, 3)),
+                              group_size=2, axes=("x1",))
+    diags = ca.resharding_lint(hp, [_fp_with(collectives=[stray])], world=8)
+    assert "GTC003" in [d.code for d in diags]
+
+
+def test_gtc011_undeclared_seam():
+    hp = HybridParallelConfig.uniform(2, tp=2)  # uniform: zero declared seams
+    mk = ca.parse_sharding_attr
+    sites = [
+        ca.ShardingSite(site="constraint", shape=(4, 16, 32), dtype="bf16",
+                        tensor_mb=0.004, sharding=mk(raw))
+        for raw in ("{devices=[1,8,1]<=[8]}", "{devices=[1,1,8]<=[8]}")
+    ]
+    diags = ca.resharding_lint(hp, [_fp_with(shardings=sites)])
+    assert any(d.code == "GTC011" for d in diags)
+
+
+def test_gtc012_tp_overlap_without_ring():
+    from galvatron_tpu.core.strategy import LayerStrategy
+
+    hp = HybridParallelConfig(layer_strategies=[
+        LayerStrategy(tp=2, tp_overlap=True), LayerStrategy(tp=2, tp_overlap=True),
+    ])
+    mono = ca.CollectiveSite(kind="all_gather", shape=(16, 4), dtype="bf16",
+                             tensor_mb=0.128, groups=((0, 1),), group_size=2,
+                             axes=("x2",), count=4)
+    diags = ca.resharding_lint(hp, [_fp_with(collectives=[mono])])
+    assert [d.code for d in diags] == ["GTC012"]
+    # a permute ring present → the collective-matmul fired → clean
+    ring = ca.CollectiveSite(kind="collective_permute", shape=(8, 4),
+                             dtype="bf16", tensor_mb=0.064,
+                             groups=((0, 1), (1, 0)), group_size=2,
+                             axes=("x2",))
+    assert ca.resharding_lint(hp, [_fp_with(collectives=[mono, ring])]) == []
+
+
+# ---------------------------------------------------------------------------
+# artifacts + CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_footprint_jsonl_roundtrip(tmp_path):
+    fp = ca.extract_footprint(_EXPLICIT, program="train_step")
+    p = tmp_path / "fp.jsonl"
+    ca.write_footprint_jsonl(str(p), [fp], extra={"plan": "x.json"})
+    recs = [json.loads(l) for l in p.read_text().splitlines()]
+    assert recs[-1] == {"plan": "x.json"}
+    assert recs[0]["program"] == "train_step"
+    kinds = {c["kind"] for c in recs[0]["collectives"]}
+    assert kinds == {"collective_permute", "all_reduce"}
+    assert all("wire_mb" in c for c in recs[0]["collectives"])
+
+
+def _run_cli(args, timeout=600):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "galvatron_tpu.cli", "audit-comm", *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+
+
+def test_cli_audit_comm_usage_error_is_rc2():
+    r = _run_cli([])
+    assert r.returncode == 2, r.stdout + r.stderr
+
+
+@pytest.mark.slow
+def test_cli_audit_comm_exemplar_plan(tmp_path):
+    """The checked-in llama-0.3b exemplar audits clean: per-term table, every
+    term in band, footprint JSONL artifact — exactly what the CI job runs."""
+    report = tmp_path / "fp.jsonl"
+    r = _run_cli(["configs/strategies/llama-0.3b_8dev_16gb.json",
+                  "--strict", "1", "--report", str(report)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "pred/lowered" in r.stdout and "OUT-OF-BAND" not in r.stdout
+    progs = {json.loads(l)["program"] for l in report.read_text().splitlines()}
+    assert "train_step" in progs

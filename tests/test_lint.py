@@ -216,6 +216,50 @@ def serve(chunks):
     assert findings == [] and suppressed == 1
 
 
+def test_cli_exit_code_contract(tmp_path, capsys):
+    """The documented 0/1/2 contract, identical for BOTH analysis passes
+    (the shared _lintcore.cli_main): 0 clean — including suppressed-only
+    findings — 1 on any unsuppressed finding, 2 on a usage error."""
+    from galvatron_tpu.analysis.concurrency import main as conc_main
+    from galvatron_tpu.analysis.lint import main as lint_main
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    reasonless = tmp_path / "reasonless.py"
+    reasonless.write_text("x = 1  # gta: disable=GTL101\n")
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(
+        "import jax\n"
+        "def hot(xs):\n"
+        "    for x in xs:\n"
+        "        x = jax.jit(lambda v: v + 1)(x)\n"
+        "    return x\n"
+    )
+    suppressed = tmp_path / "sup.py"
+    suppressed.write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x\n"
+        "def hot(xs):\n"
+        "    for x in xs:\n"
+        "        v = float(f(x))  # gta: disable=GTL101 — windowed, test pin\n"
+        "    return v\n"
+    )
+    for main in (lint_main, conc_main):
+        assert main(["-h"]) == 0
+        assert main([]) == 2  # no paths
+        assert main([str(tmp_path / "no_such_dir")]) == 2  # no .py matched
+        assert main([str(clean)]) == 0
+        assert main([str(reasonless)]) == 1  # GTL100 fires in both passes
+    assert lint_main([str(dirty)]) == 1
+    # suppressed-only runs are CLEAN in both passes — a suppression is a
+    # reviewed decision, not a pending finding
+    assert lint_main([str(suppressed)]) == 0
+    assert conc_main([str(suppressed)]) == 0
+    capsys.readouterr()
+
+
 def test_repo_lints_clean():
     """The CI gate: galvatron_tpu/ has no unsuppressed findings."""
     pkg = os.path.join(
